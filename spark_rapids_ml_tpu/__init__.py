@@ -1,0 +1,21 @@
+#
+# spark_rapids_ml_tpu: a TPU-native distributed classical-ML framework with the
+# API surface and capabilities of spark-rapids-ml (reference at /root/reference),
+# built on JAX/XLA: solvers are SPMD programs over a `jax.sharding.Mesh` with
+# explicit collectives, data lives as row-sharded HBM-resident `jax.Array`s, and
+# the hot inner loops use pallas TPU kernels.
+#
+__version__ = "0.1.0"
+
+from .linalg import DenseVector, SparseVector, Vectors  # noqa: F401
+
+__all__ = [
+    "DenseVector",
+    "SparseVector",
+    "Vectors",
+    "__version__",
+]
+
+
+def _lazy_imports():  # populated as model families land
+    pass

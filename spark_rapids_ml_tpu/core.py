@@ -1,0 +1,516 @@
+#
+# Core execution framework: everything shared by all algorithms.
+#
+# This is the TPU-native re-design of the reference's L5 (reference core.py, 1661
+# LoC): `_CumlCaller`/`_CumlEstimator`/`_CumlModel`. The reference's shape —
+# driver builds a barrier RDD of pandas UDF tasks, one per GPU, each task
+# bootstraps NCCL and calls a cuML MG solver — collapses on TPU into a
+# single-controller SPMD program: the features are laid out once as a row-sharded
+# global `jax.Array` over a device `Mesh`, and the solver is a jitted function
+# whose collectives (`psum` etc.) XLA lowers onto ICI. The estimator/model
+# contracts, param flow, persistence format, fitMultiple single-pass semantics,
+# and transform batching all mirror the reference 1:1 so the API stays drop-in.
+#
+# Reference call-stack parity (SURVEY.md §3.1): fit(df) -> _fit_internal ->
+# _call_fit_func -> [extract cols (core.py:458-557) -> partition/pad
+# (core.py:452-456) -> process-group context (core.py:768-774) ->
+# per-algo fit closure (core.py:781)] -> _create_model (core.py:1040-1052).
+#
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from abc import abstractmethod
+from collections import namedtuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .data import ExtractedData, as_pandas, extract_dataset, vectors_to_pandas_column
+from .params import Param, Params, _TpuParams
+from .utils import get_logger
+
+# Global framework configuration — the analog of the reference's Spark-conf tier
+# (`spark.sql.execution.arrow.maxRecordsPerBatch`, `spark.rapids.ml.uvm.enabled`;
+# reference core.py:660-665, clustering.py:775-779).
+config: Dict[str, Any] = {
+    "max_records_per_batch": 1 << 16,  # rows per transform batch
+    "broadcast_chunk_bytes": 8 << 30,  # 8GB broadcast chunking parity (clustering.py:1013-1091)
+}
+
+# Output-column naming contract shared by all predictive models
+# (reference core.py:146-160 `pred` namedtuple).
+pred = namedtuple("pred", ("prediction", "probability", "raw_prediction", "model_index"))(
+    "prediction", "probability", "rawPrediction", "model_index"
+)
+
+# Internal column aliases used during pre-processing (reference core.py:123-144).
+alias = namedtuple("alias", ("data", "label", "weight", "row_number"))(
+    "tpu_values", "tpu_label", "tpu_weight", "unique_id"
+)
+
+
+@dataclass
+class FitInputs:
+    """Device-resident inputs handed to every algorithm's fit function.
+
+    The analog of the reference MG calling convention `(parts, m, n,
+    parts_rank_size, rank)` + raft handle (reference feature.py:234-241): here the
+    "handle" is the mesh, and the ragged partition layout is replaced by
+    pad-to-equal row blocks with zero weights on padding (SURVEY.md §7 hard parts).
+    """
+
+    mesh: Any  # jax.sharding.Mesh
+    X: Any  # row-sharded jax.Array [n_pad, d], or None when sparse
+    y: Any  # row-sharded jax.Array [n_pad] or None
+    w: Any  # row-sharded jax.Array [n_pad]; 0.0 on padding rows
+    n_valid: int
+    n_cols: int
+    desc: Any  # PartitionDescriptor
+    dtype: Any
+    X_sparse: Any = None  # host scipy CSR when the sparse path is active
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+# A fit function maps (inputs, solver_params) -> model-attribute dict.
+FitFunc = Callable[[FitInputs, Dict[str, Any]], Dict[str, Any]]
+# A transform triple: (construct_state, predict(state, X_batch), optional evaluate)
+# mirroring the reference's (construct, transform, evaluate) closures
+# (reference core.py:1434-1488).
+TransformFuncs = Tuple[Callable[[], Any], Callable[[Any, np.ndarray], Any], Optional[Callable]]
+
+
+class _TpuCommon(_TpuParams):
+    """Input pre-processing shared by estimators (fit side) and models
+    (transform side) — reference core.py:458-557 and 1205-1328 respectively."""
+
+    _supports_sparse_input: bool = False
+    _supervised: bool = False
+    _use_weight_col: bool = True
+
+    def _pre_process_data(self, dataset: Any, for_fit: bool = True) -> ExtractedData:
+        """Column selection + dense/CSR extraction (reference core.py:458-557)."""
+        input_col, input_cols = self._get_input_columns()
+        label_col = None
+        if for_fit and self._supervised:
+            label_col = self.getOrDefault("labelCol")
+        weight_col = None
+        if (
+            for_fit
+            and self._use_weight_col
+            and self.hasParam("weightCol")
+            and self.isDefined("weightCol")
+        ):
+            weight_col = self.getOrDefault("weightCol")
+        id_col = None
+        if self.hasParam("idCol") and self.isDefined("idCol"):
+            id_col = self.getOrDefault("idCol")
+        sparse_optim = (
+            self.getOrDefault("enable_sparse_data_optim")
+            if self.hasParam("enable_sparse_data_optim")
+            else None
+        )
+        if sparse_optim is None and not self._supports_sparse_input:
+            sparse_optim = False  # densify for algorithms without a CSR path
+        extracted = extract_dataset(
+            dataset,
+            input_col=input_col,
+            input_cols=input_cols,
+            label_col=label_col,
+            weight_col=weight_col,
+            id_col=id_col,
+            float32_inputs=self._float32_inputs,
+            enable_sparse_data_optim=sparse_optim,
+        )
+        if for_fit and extracted.n_rows == 0:
+            # reference raises the same way when a rank gets no rows (core.py:762-765)
+            raise RuntimeError("Dataset is empty — cannot fit")
+        return extracted
+
+
+class _TpuCaller(_TpuCommon):
+    """Shared fit-orchestration machinery (reference `_CumlCaller`, core.py:430-806)."""
+
+    def _build_fit_inputs(self, extracted: ExtractedData) -> FitInputs:
+        """Lay the host blocks out on the mesh (pad-and-mask; SURVEY.md §7)."""
+        import jax.numpy as jnp
+
+        from .parallel import PartitionDescriptor, get_mesh, make_global_rows
+        from .parallel.mesh import default_devices
+
+        n_dev = min(self.num_workers, len(default_devices()))
+        mesh = get_mesh(n_dev)
+        dtype = np.float32 if self._float32_inputs else np.float64
+
+        desc = PartitionDescriptor.build(
+            [extracted.n_rows // n_dev + (1 if i < extracted.n_rows % n_dev else 0) for i in range(n_dev)],
+            extracted.n_cols,
+        )
+
+        weights = extracted.weight
+        if extracted.is_sparse:
+            X = None
+            X_sparse = extracted.features
+            import numpy as _np
+
+            w_np = weights if weights is not None else _np.ones(extracted.n_rows, dtype=dtype)
+            w, _, n_valid = (w_np, None, extracted.n_rows)
+            y = extracted.label
+            return FitInputs(
+                mesh=mesh, X=None, y=y, w=w, n_valid=n_valid, n_cols=extracted.n_cols,
+                desc=desc, dtype=dtype, X_sparse=X_sparse,
+            )
+
+        X, w, n_valid = make_global_rows(mesh, extracted.features.astype(dtype, copy=False), weights=weights)
+        y = None
+        if extracted.label is not None:
+            from .parallel import make_global_rows as _mgr
+
+            y, _, _ = _mgr(mesh, extracted.label.astype(dtype, copy=False))
+        return FitInputs(
+            mesh=mesh, X=X, y=y, w=w, n_valid=n_valid, n_cols=extracted.n_cols,
+            desc=desc, dtype=dtype,
+        )
+
+    @abstractmethod
+    def _get_tpu_fit_func(self, extracted: ExtractedData) -> FitFunc:
+        """Per-algorithm fit closure factory (reference `_get_cuml_fit_func`)."""
+        raise NotImplementedError
+
+    def _call_fit_func(
+        self, dataset: Any, param_maps: Optional[List[Dict[Param, Any]]]
+    ) -> List[Dict[str, Any]]:
+        """Run the (possibly multi-model) fit: ONE data layout, N solver calls.
+
+        Parity with the reference's single-pass `fitMultiple` (core.py:877-911):
+        the feature block is placed in HBM once; each param-map's solver call
+        reuses it. Returns one model-attribute dict per param map (or a single
+        one when param_maps is None).
+        """
+        logger = get_logger(type(self))
+        extracted = self._pre_process_data(dataset, for_fit=True)
+        fit_func = self._get_tpu_fit_func(extracted)
+
+        from .parallel import TpuContext
+
+        with TpuContext(0, 1, num_devices=None) as _ctx:
+            inputs = self._build_fit_inputs(extracted)
+            logger.info(
+                "fit: %d rows x %d cols on %d-device mesh (%s)",
+                inputs.n_valid, inputs.n_cols, inputs.mesh.devices.size,
+                "sparse" if inputs.X_sparse is not None else "dense",
+            )
+            if param_maps is None:
+                solver_param_sets = [dict(self._solver_params)]
+            else:
+                solver_param_sets = []
+                for pm in param_maps:
+                    est = self.copy(pm)
+                    # re-sync spark params -> solver params for overridden entries
+                    mapping = est._param_mapping()
+                    for p, v in pm.items():
+                        name = p.name if isinstance(p, Param) else p
+                        mapped = mapping.get(name, None)
+                        if mapped:
+                            est._set_solver_param(mapped, v, silent=True)
+                    solver_param_sets.append(dict(est._solver_params))
+            rows = [fit_func(inputs, sp) for sp in solver_param_sets]
+        return rows
+
+
+class _TpuEstimator(_TpuCaller):
+    """Estimator base (reference `_CumlEstimator`, core.py:853-1074)."""
+
+    def fit(self, dataset: Any, params: Optional[Union[Dict, List[Dict]]] = None):
+        if isinstance(params, (list, tuple)):
+            return [m for _, m in sorted(self.fitMultiple(dataset, list(params)))]
+        if isinstance(params, dict) and params:
+            return self.copy(params).fit(dataset)
+        models = self._fit_internal(dataset, None)
+        return models[0]
+
+    def fitMultiple(self, dataset: Any, paramMaps: Sequence[Dict[Param, Any]]) -> "_FitMultipleIterator":
+        """Train all param maps in ONE pass over the data (reference core.py:877-911)."""
+
+        def fitMultipleModels() -> List["_TpuModel"]:
+            return self._fit_internal(dataset, list(paramMaps))
+
+        return _FitMultipleIterator(fitMultipleModels, len(paramMaps))
+
+    def _fit_internal(self, dataset: Any, paramMaps: Optional[List[Dict[Param, Any]]]) -> List["_TpuModel"]:
+        attr_rows = self._call_fit_func(dataset, paramMaps)
+        models = []
+        for i, attrs in enumerate(attr_rows):
+            model = self._create_model(attrs)
+            model._model_attributes = attrs
+            self._copyValues(model, paramMaps[i] if paramMaps else None)
+            self._copy_solver_params(model)
+            if paramMaps:
+                est = self.copy(paramMaps[i])
+                est._copy_solver_params(model)
+                model._solver_params.update(
+                    {k: v for k, v in est._solver_params.items()}
+                )
+            models.append(model)
+        return models
+
+    @abstractmethod
+    def _create_model(self, attrs: Dict[str, Any]) -> "_TpuModel":
+        raise NotImplementedError
+
+    # persistence ---------------------------------------------------------
+    def write(self) -> "_TpuWriter":
+        return _TpuWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> "_TpuReader":
+        return _TpuReader(cls)
+
+    @classmethod
+    def load(cls, path: str):
+        return cls.read().load(path)
+
+
+class _TpuEstimatorSupervised(_TpuEstimator):
+    """Adds label handling (reference `_CumlEstimatorSupervised`, core.py:1075-1114)."""
+
+    _supervised = True
+
+
+class _FitMultipleIterator:
+    """Thread-safe (index, model) iterator; ALL models come from one fit pass
+    (reference `_FitMultipleIterator`, core.py:808-850)."""
+
+    def __init__(self, fitMultipleModels: Callable[[], List["_TpuModel"]], numModels: int):
+        self.fitMultipleModels = fitMultipleModels
+        self.numModels = numModels
+        self.counter = 0
+        self.lock = threading.Lock()
+        self.models: Optional[List["_TpuModel"]] = None
+
+    def __iter__(self) -> Iterator[Tuple[int, "_TpuModel"]]:
+        return self
+
+    def __next__(self) -> Tuple[int, "_TpuModel"]:
+        with self.lock:
+            index = self.counter
+            if index >= self.numModels:
+                raise StopIteration()
+            self.counter += 1
+            if self.models is None:
+                self.models = self.fitMultipleModels()
+        return index, self.models[index]
+
+    next = __next__
+
+
+class _TpuModel(_TpuCommon):
+    """Model base (reference `_CumlModel`, core.py:1117-1488)."""
+
+    def __init__(self, **model_attrs: Any) -> None:
+        super().__init__()
+        self._model_attributes: Dict[str, Any] = model_attrs
+
+    @property
+    def hasSummary(self) -> bool:
+        return False
+
+    def transform(self, dataset: Any):
+        raise NotImplementedError
+
+    def _transform_evaluate(self, dataset: Any, evaluator: Any) -> List[float]:
+        raise NotImplementedError(f"{type(self).__name__} does not support transform-evaluate")
+
+    @classmethod
+    def _transformEvaluate_supported(cls, evaluator: Any) -> bool:
+        return False
+
+    def _combine(self, models: List["_TpuModel"]) -> "_TpuModel":
+        raise NotImplementedError
+
+    # persistence ---------------------------------------------------------
+    def write(self) -> "_TpuWriter":
+        return _TpuWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> "_TpuReader":
+        return _TpuReader(cls)
+
+    @classmethod
+    def load(cls, path: str):
+        return cls.read().load(path)
+
+
+class _TpuModelWithColumns(_TpuModel):
+    """Transform = append prediction column(s), batched over rows
+    (reference `_CumlModelWithColumns`, core.py:1490-1649).
+
+    The per-batch loop is the analog of the reference's pandas_udf Arrow-batch
+    loop (core.py:1562-1572); `construct` runs once (model attrs -> device
+    arrays), `predict` is jitted and reused across batches.
+    """
+
+    @abstractmethod
+    def _get_transform_func(self) -> TransformFuncs:
+        raise NotImplementedError
+
+    def _out_column_names(self) -> List[str]:
+        """Names of appended columns; single-entry list for plain predictors."""
+        return [self.getOrDefault("outputCol") if self.hasParam("outputCol") and self.isDefined("outputCol") else pred.prediction]
+
+    def _transform_arrays(self, features: Any) -> Any:
+        construct, predict, _ = self._get_transform_func()
+        state = construct()
+        n = features.shape[0]
+        batch = int(config["max_records_per_batch"])
+        outs = []
+        for start in range(0, n, batch):
+            stop = min(start + batch, n)
+            xb = features[start:stop]
+            if hasattr(xb, "todense"):
+                xb = np.asarray(xb.todense())
+            outs.append(np.asarray(predict(state, xb)))
+        if not outs:
+            return np.zeros((0,), dtype=np.float64)
+        return np.concatenate(outs, axis=0)
+
+    def transform(self, dataset: Any):
+        pdf = as_pandas(dataset)
+        extracted = self._pre_process_data(dataset, for_fit=False)
+        result = self._transform_arrays(extracted.features)
+        out = pdf.copy(deep=False)
+        names = self._out_column_names()
+        values_by_col = self._split_output(result, names, extracted)
+        for name, vals in values_by_col.items():
+            out[name] = vals
+        return out
+
+    def _split_output(
+        self, result: Any, names: List[str], extracted: ExtractedData
+    ) -> Dict[str, Any]:
+        """Map raw predict output to output columns. Default: single column;
+        2-D output becomes a vector column when the input was vectors
+        (core.py:1577-1593 parity)."""
+        name = names[0]
+        if result.ndim > 1:
+            if extracted.feature_kind == "vector":
+                return {name: vectors_to_pandas_column(result)}
+            return {name: list(result)}
+        return {name: result}
+
+
+# ---------------------------------------------------------------------------
+# Persistence (reference core.py:253-340): metadata JSON + npz array sidecar.
+# ---------------------------------------------------------------------------
+
+
+class _TpuWriter:
+    def __init__(self, instance: Union[_TpuEstimator, _TpuModel]):
+        self.instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_TpuWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        inst = self.instance
+        if os.path.exists(path):
+            if not self._overwrite:
+                raise FileExistsError(f"Path {path} already exists; use write().overwrite().save()")
+            shutil.rmtree(path)
+        os.makedirs(path)
+        metadata = {
+            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
+            "uid": inst.uid,
+            "paramMap": {p.name: v for p, v in inst._paramMap.items() if _jsonable(v)},
+            "defaultParamMap": {p.name: v for p, v in inst._defaultParamMap.items() if _jsonable(v)},
+            "solver_params": {k: v for k, v in inst._solver_params.items() if _jsonable(v)},
+            "num_workers": inst._num_workers,
+            "float32_inputs": inst._float32_inputs,
+            "is_model": isinstance(inst, _TpuModel),
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(metadata, f, indent=2)
+        if isinstance(inst, _TpuModel):
+            arrays = {}
+            scalars = {}
+            for k, v in inst._model_attributes.items():
+                if isinstance(v, np.ndarray):
+                    arrays[k] = v
+                elif isinstance(v, (list, tuple)) and len(v) and isinstance(v[0], np.ndarray):
+                    for i, a in enumerate(v):
+                        arrays[f"{k}__list{i}"] = a
+                    scalars[f"{k}__listlen"] = len(v)
+                else:
+                    scalars[k] = v
+            np.savez(os.path.join(path, "arrays.npz"), **arrays)
+            with open(os.path.join(path, "attributes.json"), "w") as f:
+                json.dump(scalars, f, default=_np_default)
+
+
+class _TpuReader:
+    def __init__(self, cls: type):
+        self.cls = cls
+
+    def load(self, path: str):
+        with open(os.path.join(path, "metadata.json")) as f:
+            metadata = json.load(f)
+        cls = self.cls
+        if metadata["is_model"]:
+            scalars: Dict[str, Any] = {}
+            attrs_path = os.path.join(path, "attributes.json")
+            if os.path.exists(attrs_path):
+                with open(attrs_path) as f:
+                    scalars = json.load(f)
+            arrays_path = os.path.join(path, "arrays.npz")
+            attrs: Dict[str, Any] = {}
+            if os.path.exists(arrays_path):
+                with np.load(arrays_path, allow_pickle=False) as npz:
+                    attrs.update({k: npz[k] for k in npz.files})
+            # reassemble list-of-array attributes
+            list_lens = {k[: -len("__listlen")]: v for k, v in scalars.items() if k.endswith("__listlen")}
+            for base, ln in list_lens.items():
+                attrs[base] = [attrs.pop(f"{base}__list{i}") for i in range(ln)]
+                scalars.pop(f"{base}__listlen")
+            attrs.update(scalars)
+            inst = cls(**attrs)  # reference `_from_row` pattern (core.py:1150-1157)
+            inst._model_attributes = attrs
+        else:
+            inst = cls()
+        for name, v in metadata["defaultParamMap"].items():
+            if inst.hasParam(name):
+                inst._defaultParamMap[inst.getParam(name)] = v
+        for name, v in metadata["paramMap"].items():
+            if inst.hasParam(name):
+                inst._paramMap[inst.getParam(name)] = v
+        inst._solver_params.update(metadata["solver_params"])
+        inst._num_workers = metadata["num_workers"]
+        inst._float32_inputs = metadata["float32_inputs"]
+        inst.uid = metadata["uid"]
+        return inst
+
+
+def _jsonable(v: Any) -> bool:
+    try:
+        json.dumps(v, default=_np_default)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def _np_default(o: Any):
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")

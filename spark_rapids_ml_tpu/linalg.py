@@ -1,0 +1,138 @@
+#
+# TPU-native reimplementation of the minimal `pyspark.ml.linalg` vector surface the
+# reference framework consumes (VectorUDT columns, Vectors.dense/sparse factories).
+# The reference relies on pyspark for these (e.g. /root/reference/python/src/
+# spark_rapids_ml/core.py:205-250 decodes unwrapped Spark vectors); since this
+# framework is Spark-optional, the vector types live in-tree and are recognised by
+# the data-ingest layer (data.py) inside object columns of any DataFrame-like input.
+#
+from __future__ import annotations
+
+from typing import Iterable, Sequence, Union
+
+import numpy as np
+
+__all__ = ["DenseVector", "SparseVector", "Vector", "Vectors"]
+
+
+class Vector:
+    """Abstract vector: a 1-D float64 feature container."""
+
+    def toArray(self) -> np.ndarray:
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return self.size
+
+
+class DenseVector(Vector):
+    """Dense column vector backed by a float64 numpy array."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: Union[Sequence[float], np.ndarray]):
+        self.values = np.asarray(values, dtype=np.float64).reshape(-1)
+
+    def toArray(self) -> np.ndarray:
+        return self.values
+
+    @property
+    def size(self) -> int:
+        return int(self.values.shape[0])
+
+    def dot(self, other) -> float:
+        if isinstance(other, SparseVector):
+            return other.dot(self)
+        other = other.toArray() if isinstance(other, Vector) else np.asarray(other)
+        return float(np.dot(self.values, other))
+
+    def squared_distance(self, other) -> float:
+        other = other.toArray() if isinstance(other, Vector) else np.asarray(other)
+        diff = self.values - other
+        return float(np.dot(diff, diff))
+
+    def __getitem__(self, item):
+        return self.values[item]
+
+    def __eq__(self, other):
+        if isinstance(other, DenseVector):
+            return np.array_equal(self.values, other.values)
+        if isinstance(other, SparseVector):
+            return self.size == other.size and np.array_equal(self.values, other.toArray())
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"DenseVector({self.values.tolist()!r})"
+
+
+class SparseVector(Vector):
+    """Sparse vector in (size, indices, values) COO-for-one-row form.
+
+    Accepts the same construction styles as ``pyspark.ml.linalg.SparseVector``:
+    ``SparseVector(4, [1, 3], [2.0, 3.0])``, ``SparseVector(4, {1: 2.0, 3: 3.0})``,
+    or ``SparseVector(4, [(1, 2.0), (3, 3.0)])``.
+    """
+
+    __slots__ = ("_size", "indices", "values")
+
+    def __init__(self, size: int, *args):
+        self._size = int(size)
+        if len(args) == 1:
+            pairs = args[0]
+            if isinstance(pairs, dict):
+                pairs = sorted(pairs.items())
+            pairs = list(pairs)
+            self.indices = np.array([p[0] for p in pairs], dtype=np.int32)
+            self.values = np.array([p[1] for p in pairs], dtype=np.float64)
+        elif len(args) == 2:
+            self.indices = np.asarray(args[0], dtype=np.int32).reshape(-1)
+            self.values = np.asarray(args[1], dtype=np.float64).reshape(-1)
+        else:
+            raise TypeError("SparseVector expects (size, pairs) or (size, indices, values)")
+        if self.indices.shape != self.values.shape:
+            raise ValueError("indices and values must have the same length")
+        if np.any(np.diff(self.indices) < 0):
+            order = np.argsort(self.indices, kind="stable")
+            self.indices = self.indices[order]
+            self.values = self.values[order]
+        if self.indices.size and int(self.indices[-1]) >= self._size:
+            raise ValueError("index out of bounds")
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def toArray(self) -> np.ndarray:
+        arr = np.zeros(self._size, dtype=np.float64)
+        arr[self.indices] = self.values
+        return arr
+
+    def dot(self, other) -> float:
+        other_arr = other.toArray() if isinstance(other, Vector) else np.asarray(other)
+        return float(np.dot(self.values, other_arr[self.indices]))
+
+    def __eq__(self, other):
+        if isinstance(other, (DenseVector, SparseVector)):
+            return self.size == other.size and np.array_equal(self.toArray(), other.toArray())
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"SparseVector({self._size}, {self.indices.tolist()!r}, {self.values.tolist()!r})"
+
+
+class Vectors:
+    """Factory namespace matching ``pyspark.ml.linalg.Vectors``."""
+
+    @staticmethod
+    def dense(*elements) -> DenseVector:
+        if len(elements) == 1 and isinstance(elements[0], (Iterable, np.ndarray)):
+            return DenseVector(elements[0])
+        return DenseVector(elements)
+
+    @staticmethod
+    def sparse(size: int, *args) -> SparseVector:
+        return SparseVector(size, *args)

@@ -1,0 +1,144 @@
+#
+# Distributed process-group context — the TPU-native replacement for the
+# reference's `CumlContext` (reference common/cuml_context.py:36-167), which
+# builds a NCCL clique (rank0 mints a uid, BarrierTaskContext.allGather
+# broadcasts it, each rank nccl.init) plus an optional UCX endpoint mesh.
+#
+# On TPU there is no uid/endpoint plumbing: each worker process calls
+# `jax.distributed.initialize(coordinator, num_processes, process_id)` and XLA
+# compiles collectives onto ICI/DCN. What remains of the reference design is the
+# *rendezvous pattern*: rank0 picks the coordinator endpoint and an
+# allgather-of-strings control plane distributes it — exactly where the
+# reference broadcasts the NCCL uid. Teardown mirrors destroy-on-success /
+# abort-on-exception (cuml_context.py:150-167).
+#
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import List, Optional
+
+__all__ = ["Rendezvous", "LocalRendezvous", "TpuContext"]
+
+
+class Rendezvous:
+    """Control-plane interface: allgather small strings + barrier.
+
+    Implementations: `LocalRendezvous` (in-process threads, for tests and
+    single-controller mode), and — when running under Spark barrier stages — a
+    thin wrapper over `BarrierTaskContext` (see spark/integration module) whose
+    `allGather` this API is shaped after.
+    """
+
+    rank: int
+    nranks: int
+
+    def allgather(self, payload: str) -> List[str]:
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        self.allgather("")
+
+
+class LocalRendezvous(Rendezvous):
+    """Thread-barrier rendezvous for N ranks inside one process (test harness).
+
+    The analog of running the reference's barrier stage in Spark local mode
+    (tests/conftest.py:44-70 there): real collective code paths, one machine.
+    """
+
+    class _Shared:
+        def __init__(self, nranks: int):
+            self.barrier = threading.Barrier(nranks)
+            self.slots: List[Optional[str]] = [None] * nranks
+            self.lock = threading.Lock()
+
+    def __init__(self, rank: int, shared: "_Shared"):
+        self.rank = rank
+        self.nranks = shared.barrier.parties
+        self._shared = shared
+
+    @classmethod
+    def create(cls, nranks: int) -> List["LocalRendezvous"]:
+        shared = cls._Shared(nranks)
+        return [cls(r, shared) for r in range(nranks)]
+
+    def allgather(self, payload: str) -> List[str]:
+        self._shared.slots[self.rank] = payload
+        self._shared.barrier.wait()
+        out = list(self._shared.slots)  # type: ignore[arg-type]
+        self._shared.barrier.wait()  # don't let a fast rank overwrite slots early
+        return out  # type: ignore[return-value]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class TpuContext:
+    """Context manager that stands up the per-job process group and mesh.
+
+    Modes:
+      * ``nranks == 1`` or single-controller (one process drives all local
+        devices): no distributed init; mesh spans local devices.
+      * SPMD multi-process: rank0 advertises ``host:port`` through the
+        rendezvous, every rank calls ``jax.distributed.initialize``; the mesh
+        then spans the global device list. ICI carries collectives within a pod
+        slice, DCN across slices — no in-tree data plane is needed (the UCX
+        layer of the reference has no TPU analog).
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        nranks: int,
+        rendezvous: Optional[Rendezvous] = None,
+        *,
+        require_distributed: bool = False,
+        num_devices: Optional[int] = None,
+    ):
+        self.rank = rank
+        self.nranks = nranks
+        self.rendezvous = rendezvous
+        self.require_distributed = require_distributed
+        self.num_devices = num_devices
+        self.mesh = None
+        self._initialized_distributed = False
+
+    def __enter__(self) -> "TpuContext":
+        import jax
+
+        if self.require_distributed and self.nranks > 1 and jax.process_count() == 1:
+            assert self.rendezvous is not None, "multi-process TpuContext needs a rendezvous"
+            if self.rank == 0:
+                coordinator = json.dumps({"addr": f"{socket.gethostname()}:{_free_port()}"})
+            else:
+                coordinator = json.dumps({})
+            gathered = self.rendezvous.allgather(coordinator)
+            addr = json.loads(gathered[0])["addr"]
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=self.nranks, process_id=self.rank
+            )
+            self._initialized_distributed = True
+
+        from .mesh import get_mesh
+
+        self.mesh = get_mesh(self.num_devices)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        import jax
+
+        if self._initialized_distributed:
+            # destroy on success, abort-equivalent on exception
+            # (reference cuml_context.py:150-167)
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+        if self.rendezvous is not None and exc_type is None:
+            self.rendezvous.barrier()
+        return False

@@ -1,0 +1,122 @@
+#
+# Device-mesh helpers: the substrate every solver runs on.
+#
+# Design: all solvers are SPMD programs over a 1-D mesh axis `rows` (data
+# parallelism over row blocks — the reference's only data-plane parallelism, see
+# SURVEY.md §2.4). Row counts are padded to a multiple of the mesh size and the
+# padding is neutralized with zero sample-weights, which unifies the reference's
+# ragged `parts_rank_size` handling (cuML MG accepts ragged blocks; SPMD XLA
+# wants equal ones) with `weightCol` support.
+#
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS_AXIS = "rows"
+
+# Device-resolution hook: which devices the framework runs on. Overridable for
+# tests (virtual multi-device CPU mesh while a real TPU backend is registered)
+# and for pinning a subset of chips. Resolution order: explicit override ->
+# SRML_PLATFORM env var -> jax.devices().
+_DEVICE_OVERRIDE: Optional[list] = None
+
+
+def set_devices(devices_or_platform: Union[str, list, None]) -> None:
+    """Override the framework's device pool ('cpu', 'tpu', a device list, or None)."""
+    global _DEVICE_OVERRIDE
+    if devices_or_platform is None:
+        _DEVICE_OVERRIDE = None
+    elif isinstance(devices_or_platform, str):
+        _DEVICE_OVERRIDE = list(jax.devices(devices_or_platform))
+    else:
+        _DEVICE_OVERRIDE = list(devices_or_platform)
+
+
+def default_devices() -> list:
+    import os
+
+    if _DEVICE_OVERRIDE is not None:
+        return _DEVICE_OVERRIDE
+    platform = os.environ.get("SRML_PLATFORM")
+    if platform:
+        return list(jax.devices(platform))
+    return list(jax.devices())
+
+
+def get_mesh(num_workers: Optional[int] = None, devices=None) -> Mesh:
+    """Build a 1-D `rows` mesh over the first `num_workers` visible devices.
+
+    In multi-process (multi-host) runs `jax.devices()` is the global device list,
+    so the same call yields the global mesh on every process — the direct analog
+    of the reference's NCCL clique of `num_workers` ranks
+    (reference common/cuml_context.py:36-148).
+    """
+    if devices is None:
+        devices = default_devices()
+    if num_workers is None:
+        num_workers = len(devices)
+    if num_workers > len(devices):
+        raise ValueError(
+            f"num_workers={num_workers} exceeds visible devices ({len(devices)}); "
+            "set num_workers or start more processes"
+        )
+    return Mesh(np.asarray(devices[:num_workers]), (ROWS_AXIS,))
+
+
+def row_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """NamedSharding that shards axis 0 over `rows` and replicates the rest."""
+    return NamedSharding(mesh, P(ROWS_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
+    """Zero-pad axis 0 of `x` to a multiple of `multiple`; returns (padded, n_valid)."""
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_widths = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_widths), n
+
+
+def make_global_rows(
+    mesh: Mesh,
+    x: np.ndarray,
+    *,
+    weights: Optional[np.ndarray] = None,
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Place a host row-block on the mesh as a row-sharded global array.
+
+    Pads rows to a multiple of the mesh size; returns ``(X, w, n_valid)`` where
+    `w` is a row-weight vector with zeros on padding rows (and the user's sample
+    weights elsewhere). Solvers MUST use `w` for any per-row reduction so padding
+    never contaminates results.
+
+    Single-controller path: `jax.device_put` with a NamedSharding splits the host
+    array across local devices. Under multi-process SPMD each process passes its
+    local block and we assemble the global array from per-process shards.
+    """
+    n_dev = mesh.devices.size
+    x = np.ascontiguousarray(x)
+    if weights is None:
+        weights = np.ones(x.shape[0], dtype=x.dtype if x.dtype.kind == "f" else np.float32)
+    xp, n_valid = pad_rows(x, n_dev)
+    wp, _ = pad_rows(np.asarray(weights, dtype=xp.dtype if xp.dtype.kind == "f" else np.float32), n_dev)
+
+    if jax.process_count() == 1:
+        X = jax.device_put(xp, row_sharding(mesh, xp.ndim))
+        w = jax.device_put(wp, row_sharding(mesh, 1))
+    else:  # multi-process: xp is this process's local block
+        from jax.experimental import multihost_utils
+
+        X = multihost_utils.host_local_array_to_global_array(xp, mesh, P(ROWS_AXIS))
+        w = multihost_utils.host_local_array_to_global_array(wp, mesh, P(ROWS_AXIS))
+    return X, w, n_valid

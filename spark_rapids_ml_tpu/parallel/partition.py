@@ -1,0 +1,59 @@
+#
+# Partition layout bookkeeping — the calling-convention analog of the reference's
+# `PartitionDescriptor` (reference utils.py:173-210), which allGathers
+# `(rank, rows)` pairs so every rank knows the global row layout `(m, n,
+# parts_rank_size, rank)` before invoking an MG solver.
+#
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclass
+class PartitionDescriptor:
+    """Global row layout: which rank holds how many rows, plus (m, n)."""
+
+    parts_rank_size: List[Tuple[int, int]]  # [(rank, rows_in_that_rank_chunk), ...]
+    m: int  # total rows
+    n: int  # cols
+    rank: int
+
+    @classmethod
+    def build(
+        cls,
+        partition_rows: Sequence[int],
+        total_cols: int,
+        rank: int = 0,
+        rendezvous=None,
+    ) -> "PartitionDescriptor":
+        """Build the descriptor.
+
+        Single-controller mode passes every partition's row count directly.
+        SPMD mode passes this rank's counts and a `rendezvous` whose
+        ``allgather`` merges them across ranks (same shape as the reference's
+        BarrierTaskContext.allGather of JSON strings, utils.py:192-210).
+        """
+        if rendezvous is not None:
+            payload = json.dumps({"rank": rank, "rows": list(partition_rows)})
+            gathered = rendezvous.allgather(payload)
+            pairs: List[Tuple[int, int]] = []
+            for msg in gathered:
+                obj = json.loads(msg)
+                pairs.extend((obj["rank"], r) for r in obj["rows"])
+            pairs.sort()
+        else:
+            pairs = [(i, r) for i, r in enumerate(partition_rows)]
+        m = sum(r for _, r in pairs)
+        return cls(parts_rank_size=pairs, m=m, n=total_cols, rank=rank)
+
+    def rows_of(self, rank: int) -> int:
+        return sum(r for rk, r in self.parts_rank_size if rk == rank)
+
+    def row_offset_of(self, rank: int) -> int:
+        off = 0
+        for rk, r in self.parts_rank_size:
+            if rk < rank:
+                off += r
+        return off

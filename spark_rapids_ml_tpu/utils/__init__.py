@@ -1,0 +1,75 @@
+#
+# Shared utilities (reference utils.py analog, minus the JVM/py4j pieces which
+# have no meaning in the TPU build).
+#
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+_LOGGERS: Dict[str, logging.Logger] = {}
+
+
+def get_logger(cls_or_name, level: str = "INFO") -> logging.Logger:
+    """Per-class stderr logger (reference utils.py:281-302)."""
+    name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+    name = f"spark_rapids_ml_tpu.{name}"
+    if name in _LOGGERS:
+        return _LOGGERS[name]
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(handler)
+        logger.propagate = False
+    _LOGGERS[name] = logger
+    return logger
+
+
+def concat_and_free(chunks: List[np.ndarray]) -> np.ndarray:
+    """Memory-frugal concat: frees source chunks as it copies
+    (reference utils.py:213-252 `_concat_and_free`)."""
+    if len(chunks) == 1:
+        return chunks[0]
+    total = sum(c.shape[0] for c in chunks)
+    first = chunks[0]
+    out = np.empty((total,) + first.shape[1:], dtype=first.dtype)
+    off = 0
+    while chunks:
+        c = chunks.pop(0)
+        out[off : off + c.shape[0]] = c
+        off += c.shape[0]
+        del c
+    return out
+
+
+def dtype_to_pytype(dtype) -> type:
+    """numpy dtype -> python scalar type for schema-ish introspection
+    (reference utils.py:265-277)."""
+    kind = np.dtype(dtype).kind
+    if kind == "f":
+        return float
+    if kind in "iu":
+        return int
+    if kind == "b":
+        return bool
+    return object
+
+
+def get_default_params_from_func(func: Callable, unsupported: Iterable[str] = ()) -> Dict[str, Any]:
+    """Introspect keyword defaults of a solver entry point, dropping unsupported
+    names (reference utils.py:46-71 `_get_default_params_from_func`)."""
+    import inspect
+
+    sig = inspect.signature(func)
+    out = {}
+    for name, p in sig.parameters.items():
+        if name in ("self", "X", "y", "sample_weight") or name in unsupported:
+            continue
+        if p.default is not inspect.Parameter.empty:
+            out[name] = p.default
+    return out

@@ -1,0 +1,197 @@
+#
+# Framework unit test with a zero-math dummy backend — proves the entire
+# estimator/model plumbing (param mapping incl. None/""-mapped params, fit-side
+# runtime asserts, persistence round-trip, fitMultiple overrides, num_workers
+# validation) with no real algorithm, exactly the reference's
+# tests/test_common_estimator.py `CumlDummy`/`SparkRapidsMLDummy` pattern
+# (reference test_common_estimator.py:46-113, 185-227, 462-512, 528-558).
+#
+from typing import Any, Dict
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.core import (
+    FitInputs,
+    _TpuEstimator,
+    _TpuModelWithColumns,
+)
+from spark_rapids_ml_tpu.params import HasFeaturesCol, HasFeaturesCols, Param, TypeConverters
+
+
+class TpuDummy:
+    """Stand-in solver: records what it was called with (reference CumlDummy)."""
+
+    def __init__(self, a=10.0, b=20, k=30, x=40):
+        self.a, self.b, self.k, self.x = a, b, k, x
+
+
+class DummyEstimator(_TpuEstimator, HasFeaturesCol, HasFeaturesCols):
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._set_params(**kwargs)
+
+    # Spark param "fake_alpha" maps to solver "a"; "fake_beta" is unsupported
+    # (None); "fake_drop" accepted but dropped ("").
+    fake_alpha = Param("fake_alpha", "maps to solver param a", TypeConverters.toFloat)
+    fake_beta = Param("fake_beta", "unsupported on TPU", TypeConverters.toInt)
+    fake_drop = Param("fake_drop", "accepted and ignored", TypeConverters.toString)
+
+    @classmethod
+    def _param_mapping(cls):
+        return {"fake_alpha": "a", "fake_beta": None, "fake_drop": ""}
+
+    def _get_solver_params_default(self) -> Dict[str, Any]:
+        return {"a": 10.0, "b": 20, "k": 30, "x": 40}
+
+    def setFeaturesCol(self, value):
+        return self._set_params(featuresCol=value)
+
+    def _get_tpu_fit_func(self, extracted):
+        n_cols = extracted.n_cols
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            # runtime asserts inside the "barrier" body (reference :185-227)
+            assert inputs.desc.n == n_cols
+            assert inputs.desc.m == inputs.n_valid
+            assert inputs.mesh is not None
+            assert set(params.keys()) == {"a", "b", "k", "x"}
+            dummy = TpuDummy(**params)
+            return {
+                "model_attr": float(dummy.a) * 100,
+                "n_cols": n_cols,
+                "coefs": np.arange(n_cols, dtype=np.float64),
+            }
+
+        return _fit
+
+    def _create_model(self, attrs):
+        return DummyModel(**attrs)
+
+
+class DummyModel(_TpuModelWithColumns, HasFeaturesCol, HasFeaturesCols):
+    def __init__(self, model_attr=None, n_cols=None, coefs=None, **kwargs):
+        super().__init__(model_attr=model_attr, n_cols=n_cols, coefs=coefs)
+        self.model_attr = model_attr
+        self.n_cols = n_cols
+        self.coefs = np.asarray(coefs) if coefs is not None else None
+
+    @classmethod
+    def _param_mapping(cls):
+        return DummyEstimator._param_mapping()
+
+    def _get_solver_params_default(self):
+        return {"a": 10.0, "b": 20, "k": 30, "x": 40}
+
+    def _out_column_names(self):
+        return ["dummy_pred"]
+
+    def _get_transform_func(self):
+        coefs = self.coefs
+
+        def construct():
+            return np.asarray(coefs)
+
+        def predict(state, xb):
+            return xb @ state
+
+        return construct, predict, None
+
+
+def _df(n=16, d=4):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d))
+    return pd.DataFrame({"features": list(x)}), x
+
+
+def test_params_mapping_and_defaults():
+    est = DummyEstimator(featuresCol="features")
+    assert est.solver_params == {"a": 10.0, "b": 20, "k": 30, "x": 40}
+    assert est.cuml_params == est.solver_params  # drop-in alias
+    est._set_params(fake_alpha=2.5)
+    assert est.solver_params["a"] == 2.5
+    assert est.getOrDefault("fake_alpha") == 2.5
+    # unsupported param raises
+    with pytest.raises(ValueError, match="not supported"):
+        est._set_params(fake_beta=1)
+    # dropped param accepted, not forwarded
+    est._set_params(fake_drop="anything")
+    assert "fake_drop" not in est.solver_params
+    # direct solver param
+    est._set_params(k=7)
+    assert est.solver_params["k"] == 7
+    # unknown raises
+    with pytest.raises(ValueError, match="Unknown parameter"):
+        est._set_params(nope=1)
+
+
+def test_fit_and_transform_end_to_end():
+    df, x = _df()
+    est = DummyEstimator(featuresCol="features", num_workers=4)
+    model = est.fit(df)
+    assert model.model_attr == 1000.0
+    assert model.n_cols == 4
+    out = model.transform(df)
+    assert "dummy_pred" in out.columns
+    np.testing.assert_allclose(np.asarray(out["dummy_pred"]), x @ model.coefs, rtol=1e-6)
+
+
+def test_fit_multiple_single_pass_and_overrides():
+    df, _ = _df()
+    est = DummyEstimator(featuresCol="features")
+    pm = [{est.getParam("fake_alpha"): 5.0}, {est.getParam("fake_alpha"): 7.0}]
+    it = est.fitMultiple(df, pm)
+    models = dict(it)
+    assert models[0].model_attr == 500.0
+    assert models[1].model_attr == 700.0
+    # original estimator untouched
+    assert est.solver_params["a"] == 10.0
+
+
+def test_persistence_round_trip(tmp_path):
+    df, x = _df()
+    est = DummyEstimator(featuresCol="features", fake_alpha=3.0, k=9)
+    est_path = str(tmp_path / "est")
+    est.save(est_path)
+    est2 = DummyEstimator.load(est_path)
+    assert est2.solver_params["a"] == 3.0
+    assert est2.solver_params["k"] == 9
+    assert est2.getOrDefault("featuresCol") == "features"
+
+    model = est.fit(df)
+    m_path = str(tmp_path / "model")
+    model.write().overwrite().save(m_path)
+    model2 = DummyModel.load(m_path)
+    assert model2.model_attr == model.model_attr
+    np.testing.assert_array_equal(model2.coefs, model.coefs)
+    out = model2.transform(df)
+    np.testing.assert_allclose(np.asarray(out["dummy_pred"]), x @ model.coefs, rtol=1e-6)
+
+
+def test_num_workers_validation():
+    with pytest.raises(ValueError):
+        DummyEstimator(num_workers=0)
+    est = DummyEstimator(featuresCol="features", num_workers=3)
+    assert est.num_workers == 3
+    est2 = DummyEstimator(featuresCol="features")
+    from spark_rapids_ml_tpu.parallel import default_devices
+
+    assert est2.num_workers == len(default_devices())
+
+
+def test_copy_semantics():
+    est = DummyEstimator(featuresCol="features", fake_alpha=1.5)
+    c = est.copy({est.getParam("fake_alpha"): 9.0})
+    assert c.getOrDefault("fake_alpha") == 9.0
+    assert est.getOrDefault("fake_alpha") == 1.5
+    # solver params are NOT shared dicts
+    c._set_params(k=1)
+    assert est.solver_params["k"] == 30
+
+
+def test_empty_dataset_raises():
+    df = pd.DataFrame({"features": []})
+    est = DummyEstimator(featuresCol="features")
+    with pytest.raises((RuntimeError, ValueError)):
+        est.fit(df)

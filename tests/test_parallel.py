@@ -1,0 +1,112 @@
+#
+# Runtime/communicator layer tests — the analog of the reference's transport
+# test (reference tests/test_ucx.py:36-99: build the communicator clique for
+# 1..N ranks and assert a live allGather). Here: mesh construction, pad-and-mask
+# global array assembly, PartitionDescriptor allgather through the rendezvous,
+# and a live psum over the 8-device mesh via shard_map.
+#
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_ml_tpu.parallel import (
+    ROWS_AXIS,
+    LocalRendezvous,
+    PartitionDescriptor,
+    TpuContext,
+    get_mesh,
+    make_global_rows,
+    pad_rows,
+)
+
+
+def test_pad_rows():
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    xp, n = pad_rows(x, 4)
+    assert n == 5
+    assert xp.shape == (8, 2)
+    np.testing.assert_array_equal(xp[5:], 0)
+    xp2, n2 = pad_rows(x, 5)
+    assert xp2.shape == (5, 2) and n2 == 5
+
+
+def test_make_global_rows_weights_mask_padding(mesh8):
+    x = np.ones((13, 3), dtype=np.float32)
+    X, w, n_valid = make_global_rows(mesh8, x)
+    assert n_valid == 13
+    assert X.shape[0] % 8 == 0
+    # weighted row count sees only valid rows
+    assert float(jnp.sum(w)) == 13.0
+    # weighted column sums ignore padding
+    np.testing.assert_allclose(np.asarray(jnp.sum(X * w[:, None], axis=0)), [13, 13, 13])
+
+
+def test_live_psum_over_mesh(mesh8):
+    from jax import shard_map
+
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    X, w, _ = make_global_rows(mesh8, x)
+
+    @jax.jit
+    def global_sum(X, w):
+        def body(xb, wb):
+            local = jnp.sum(xb * wb[:, None])
+            return jnp.reshape(jax.lax.psum(local, ROWS_AXIS), (1,))
+
+        return shard_map(
+            body, mesh=mesh8, in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS)),
+            out_specs=P(ROWS_AXIS),
+        )(X, w)
+
+    out = np.asarray(global_sum(X, w))
+    np.testing.assert_allclose(out, np.full(8, x.sum()))
+
+
+@pytest.mark.parametrize("nranks", [1, 2, 4])
+def test_local_rendezvous_allgather(nranks):
+    rvs = LocalRendezvous.create(nranks)
+    results = [None] * nranks
+
+    def work(r):
+        results[r] = rvs[r].allgather(f"rank{r}")
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(nranks)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    for r in range(nranks):
+        assert results[r] == [f"rank{i}" for i in range(nranks)]
+
+
+def test_partition_descriptor_via_rendezvous():
+    rvs = LocalRendezvous.create(2)
+    out = [None, None]
+
+    def work(r):
+        out[r] = PartitionDescriptor.build([10 + r], total_cols=5, rank=r, rendezvous=rvs[r])
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(2)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    for r in range(2):
+        assert out[r].m == 21
+        assert out[r].n == 5
+        assert out[r].parts_rank_size == [(0, 10), (1, 11)]
+    assert out[0].rows_of(1) == 11
+    assert out[1].row_offset_of(1) == 10
+
+
+def test_partition_descriptor_single_controller():
+    d = PartitionDescriptor.build([4, 4, 5], total_cols=3)
+    assert d.m == 13 and d.n == 3
+    assert d.rows_of(2) == 5 and d.row_offset_of(2) == 8
+
+
+def test_tpu_context_single_process():
+    with TpuContext(0, 1) as ctx:
+        assert ctx.mesh is not None
+        assert ctx.mesh.devices.size >= 1
