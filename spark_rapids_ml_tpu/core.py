@@ -139,11 +139,12 @@ class _TpuCaller(_TpuCommon):
         import jax.numpy as jnp
 
         from .parallel import PartitionDescriptor, get_mesh, make_global_rows
-        from .parallel.mesh import default_devices
+        from .parallel.mesh import default_devices, ensure_dtype_support
 
         n_dev = min(self.num_workers, len(default_devices()))
         mesh = get_mesh(n_dev)
         dtype = np.float32 if self._float32_inputs else np.float64
+        ensure_dtype_support(dtype)
 
         desc = PartitionDescriptor.build(
             [extracted.n_rows // n_dev + (1 if i < extracted.n_rows % n_dev else 0) for i in range(n_dev)],
@@ -368,6 +369,9 @@ class _TpuModelWithColumns(_TpuModel):
         return [self.getOrDefault("outputCol") if self.hasParam("outputCol") and self.isDefined("outputCol") else pred.prediction]
 
     def _transform_arrays(self, features: Any) -> Any:
+        from .parallel.mesh import ensure_dtype_support
+
+        ensure_dtype_support(np.float32 if self._float32_inputs else np.float64)
         construct, predict, _ = self._get_transform_func()
         state = construct()
         n = features.shape[0]

@@ -1,0 +1,5 @@
+#
+# User-facing estimator/model families — the drop-in PySpark-ML-compatible API
+# surface (reference python/src/spark_rapids_ml/{feature,clustering,regression,
+# classification,knn,umap,tuning}.py).
+#

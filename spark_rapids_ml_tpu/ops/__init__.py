@@ -1,0 +1,10 @@
+#
+# Solver library: pure-JAX SPMD programs over the `rows` mesh axis.
+#
+# This package is the in-tree replacement for the external cuML MG C++/CUDA
+# solvers the reference imports (SURVEY.md L3): every solver consumes
+# row-sharded global `jax.Array`s plus a zero-on-padding weight vector, and its
+# cross-chip reductions are XLA collectives inserted by GSPMD (with `shard_map`
+# where the collective pattern must be explicit). Everything is jit-compiled:
+# static shapes, `lax` control flow, bf16/f32 matmuls on the MXU.
+#

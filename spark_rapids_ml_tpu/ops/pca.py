@@ -1,0 +1,69 @@
+#
+# Distributed PCA solver — the in-tree replacement for `cuml.decomposition.
+# pca_mg.PCAMG` (consumed by reference feature.py:220-241).
+#
+# Algorithm (single pass + local eig, the same math cuML MG runs):
+#   1. weighted mean + covariance of the row-sharded X — one fused MXU
+#      contraction per shard, GSPMD psum across the `rows` mesh axis
+#      (the NCCL-allreduce-of-covariance equivalent);
+#   2. replicated d×d symmetric eigendecomposition, top-k descending;
+#   3. sign canonicalization (reference signFlip kernel parity,
+#      rapidsml_jni.cu:35-61).
+#
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .linalg import sign_flip, topk_eigh_desc, weighted_cov
+
+
+@partial(jax.jit, static_argnames=("k",))
+def pca_fit(X: jax.Array, w: jax.Array, *, k: int) -> Dict[str, jax.Array]:
+    """Fit PCA on a row-sharded global X with padding/sample weights w.
+
+    Returns the model-state dict matching the reference's model attributes
+    (reference feature.py:250-257): mean_, components_, explained_variance_,
+    explained_variance_ratio_, singular_values_. `components_` rows are always
+    unit-norm (cuML/sklearn store unwhitened components; whitening is applied
+    at transform time).
+    """
+    total_w, mean, cov = weighted_cov(X, w, ddof=1)
+    evals, comps = topk_eigh_desc(cov, k)
+    evals = jnp.maximum(evals, 0.0)
+    comps = sign_flip(comps)
+    total_var = jnp.trace(cov)
+    ratio = evals / total_var
+    singular_values = jnp.sqrt(evals * (total_w - 1.0))
+    return {
+        "mean_": mean,
+        "components_": comps,
+        "explained_variance_": evals,
+        "explained_variance_ratio_": ratio,
+        "singular_values_": singular_values,
+    }
+
+
+@partial(jax.jit, static_argnames=("whiten",))
+def pca_transform(
+    X: jax.Array, components: jax.Array, explained_variance: jax.Array, *, whiten: bool = False
+) -> jax.Array:
+    """Project rows onto the principal axes WITHOUT mean-centering.
+
+    Spark ML's PCA.transform does not center; cuML's does, and the reference
+    undoes cuML's centering by adding the mean back (reference
+    feature.py:426-438). Net effect there — and the contract here — is
+    ``X @ componentsᵀ`` (scaled by 1/√eigenvalue when whitening).
+    """
+    T = X @ components.T
+    if whiten:
+        T = T * jax.lax.rsqrt(jnp.maximum(explained_variance, 1e-30))
+    return T
+
+
+@jax.jit
+def pca_inverse_transform(T: jax.Array, components: jax.Array) -> jax.Array:
+    return T @ components

@@ -77,6 +77,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def ensure_dtype_support(dtype) -> None:
+    """Make float64 actually mean float64 on device.
+
+    JAX's default `jax_enable_x64=False` silently downcasts f64 to f32; a user
+    who passed ``float32_inputs=False`` asked for double precision (the
+    reference supports f64 end-to-end; SURVEY.md §7 'float64 parity'), so flip
+    the flag on demand rather than silently degrading.
+    """
+    if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+
 def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
     """Zero-pad axis 0 of `x` to a multiple of `multiple`; returns (padded, n_valid)."""
     n = x.shape[0]

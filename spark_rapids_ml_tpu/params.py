@@ -242,8 +242,8 @@ def _mixin(name: str, doc: str, conv, default=None, has_default: bool = True):
 
     body: Dict[str, Any] = {name: param, f"get{cap}": getter}
 
-    def __init__(self):  # noqa: N807
-        super(cls, self).__init__()
+    def __init__(self, *args, **kwargs):  # noqa: N807  (cooperative MRO chain)
+        super(cls, self).__init__(*args, **kwargs)
         if has_default:
             self._setDefault(**{name: default})
 
@@ -316,8 +316,8 @@ class HasEnableSparseDataOptim(Params):
         TypeConverters.identity,
     )
 
-    def __init__(self) -> None:
-        super().__init__()
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
         self._setDefault(enable_sparse_data_optim=None)
 
 

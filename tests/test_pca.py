@@ -1,0 +1,125 @@
+#
+# PCA compat tests — parameterized over feature type and dtype, compared against
+# sklearn (the reference compares against Spark CPU / single-GPU cuML the same
+# way; reference tests/test_pca.py).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.linalg import Vectors
+from spark_rapids_ml_tpu.models.feature import PCA, PCAModel
+
+
+def _make_df(rng, n=200, d=8, feature_type="array", dtype=np.float32):
+    x = rng.normal(size=(n, d)).astype(dtype)
+    x[:, 0] *= 5  # give PCA something to find
+    x[:, 1] *= 2
+    if feature_type == "array":
+        df = pd.DataFrame({"features": list(x)})
+        cols = dict(inputCol="features")
+    elif feature_type == "vector":
+        df = pd.DataFrame({"features": [Vectors.dense(v) for v in x]})
+        cols = dict(inputCol="features")
+    else:  # multi_cols
+        df = pd.DataFrame({f"c{i}": x[:, i] for i in range(d)})
+        cols = dict(inputCols=[f"c{i}" for i in range(d)])
+    return df, x, cols
+
+
+@pytest.mark.parametrize("feature_type", ["array", "vector", "multi_cols"])
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_pca_vs_sklearn(rng, feature_type, dtype):
+    from sklearn.decomposition import PCA as SkPCA
+
+    df, x, cols = _make_df(rng, feature_type=feature_type, dtype=dtype)
+    k = 3
+    est = PCA(k=k, num_workers=4, float32_inputs=(dtype == np.float32), **cols)
+    assert est.solver_params["n_components"] == 3
+    model = est.fit(df)
+
+    sk = SkPCA(n_components=k, svd_solver="full").fit(x.astype(np.float64))
+    tol = 1e-3 if dtype == np.float32 else 1e-8
+    # components match up to sign; our sign convention = max-|v| positive
+    for i in range(k):
+        ours, theirs = model.components_[i], sk.components_[i]
+        theirs = theirs * np.sign(theirs[np.argmax(np.abs(theirs))])
+        np.testing.assert_allclose(ours, theirs, atol=tol)
+    np.testing.assert_allclose(model.explained_variance_, sk.explained_variance_, rtol=1e-2 if dtype == np.float32 else 1e-8)
+    np.testing.assert_allclose(
+        model.explained_variance_ratio_, sk.explained_variance_ratio_, rtol=1e-2 if dtype == np.float32 else 1e-8
+    )
+    np.testing.assert_allclose(model.mean_, x.mean(axis=0), atol=tol)
+
+    # transform parity: Spark semantics = X @ compsᵀ (no centering)
+    out = model.transform(df)
+    out_col = model._out_column_names()[0]
+    got = np.stack([np.asarray(v.toArray() if hasattr(v, "toArray") else v) for v in out[out_col]])
+    np.testing.assert_allclose(got, x @ model.components_.T, atol=tol * 10)
+
+
+def test_pca_spark_surface(rng):
+    df, x, cols = _make_df(rng)
+    model = PCA(num_workers=2).setK(2).setInputCol("features").setOutputCol("pca_out").fit(df)
+    assert model.pc.shape == (8, 2)
+    assert len(model.mean) == 8
+    assert model.explainedVariance.shape == (2,)
+    out = model.transform(df)
+    assert "pca_out" in out.columns
+    assert model.getK() == 2
+
+
+def test_pca_sign_flip_convention(rng):
+    df, x, cols = _make_df(rng)
+    model = PCA(k=4, inputCol="features").fit(df)
+    for comp in model.components_:
+        assert comp[np.argmax(np.abs(comp))] > 0
+
+
+def test_pca_k_exceeds_cols_raises(rng):
+    df, _, cols = _make_df(rng, d=4)
+    with pytest.raises(ValueError, match="exceeds"):
+        PCA(k=5, inputCol="features").fit(df)
+
+
+def test_pca_persistence(tmp_path, rng):
+    df, x, cols = _make_df(rng)
+    model = PCA(k=3, inputCol="features", outputCol="o").fit(df)
+    p = str(tmp_path / "pca_model")
+    model.write().overwrite().save(p)
+    loaded = PCAModel.load(p)
+    np.testing.assert_array_equal(loaded.components_, model.components_)
+    np.testing.assert_array_equal(loaded.mean_, model.mean_)
+    out1 = model.transform(df)
+    out2 = loaded.transform(df)
+    a = np.stack([np.asarray(v) for v in out1["o"]])
+    b = np.stack([np.asarray(v) for v in out2["o"]])
+    np.testing.assert_allclose(a, b)
+
+
+def test_pca_fit_multiple(rng):
+    df, _, cols = _make_df(rng)
+    est = PCA(inputCol="features")
+    pmaps = [{est.getParam("k"): 1}, {est.getParam("k"): 3}]
+    models = dict(est.fitMultiple(df, pmaps))
+    assert models[0].components_.shape == (1, 8)
+    assert models[1].components_.shape == (3, 8)
+
+
+def test_pca_padding_invariance(rng):
+    # results must not depend on how rows pad onto the mesh: compare a row count
+    # divisible by 8 against one that forces 7 padding rows
+    x = rng.normal(size=(160, 5)).astype(np.float64)
+    m1 = PCA(k=2, inputCol="features", float32_inputs=False, num_workers=8).fit(
+        pd.DataFrame({"features": list(x)})
+    )
+    m2 = PCA(k=2, inputCol="features", float32_inputs=False, num_workers=8).fit(
+        pd.DataFrame({"features": list(x[:153])})
+    )
+    m1b = PCA(k=2, inputCol="features", float32_inputs=False, num_workers=1).fit(
+        pd.DataFrame({"features": list(x[:153])})
+    )
+    # same data on 8 devices (with padding) vs 1 device (no padding) is identical
+    np.testing.assert_allclose(m2.mean_, m1b.mean_, atol=1e-12)
+    np.testing.assert_allclose(m2.components_, m1b.components_, atol=1e-10)
+    assert not np.allclose(m1.mean_, m2.mean_)  # different data actually differs
