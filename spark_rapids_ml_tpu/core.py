@@ -139,12 +139,11 @@ class _TpuCaller(_TpuCommon):
         import jax.numpy as jnp
 
         from .parallel import PartitionDescriptor, get_mesh, make_global_rows
-        from .parallel.mesh import default_devices, ensure_dtype_support
+        from .parallel.mesh import default_devices
 
         n_dev = min(self.num_workers, len(default_devices()))
         mesh = get_mesh(n_dev)
         dtype = np.float32 if self._float32_inputs else np.float64
-        ensure_dtype_support(dtype)
 
         desc = PartitionDescriptor.build(
             [extracted.n_rows // n_dev + (1 if i < extracted.n_rows % n_dev else 0) for i in range(n_dev)],
@@ -196,8 +195,11 @@ class _TpuCaller(_TpuCommon):
         fit_func = self._get_tpu_fit_func(extracted)
 
         from .parallel import TpuContext
+        from .parallel.mesh import dtype_scope
 
-        with TpuContext(0, 1, num_devices=None) as _ctx:
+        with TpuContext(0, 1, num_devices=None) as _ctx, dtype_scope(
+            np.float32 if self._float32_inputs else np.float64
+        ):
             inputs = self._build_fit_inputs(extracted)
             logger.info(
                 "fit: %d rows x %d cols on %d-device mesh (%s)",
@@ -369,23 +371,23 @@ class _TpuModelWithColumns(_TpuModel):
         return [self.getOrDefault("outputCol") if self.hasParam("outputCol") and self.isDefined("outputCol") else pred.prediction]
 
     def _transform_arrays(self, features: Any) -> Any:
-        from .parallel.mesh import ensure_dtype_support
+        from .parallel.mesh import dtype_scope
 
-        ensure_dtype_support(np.float32 if self._float32_inputs else np.float64)
-        construct, predict, _ = self._get_transform_func()
-        state = construct()
-        n = features.shape[0]
-        batch = int(config["max_records_per_batch"])
-        outs = []
-        for start in range(0, n, batch):
-            stop = min(start + batch, n)
-            xb = features[start:stop]
-            if hasattr(xb, "todense"):
-                xb = np.asarray(xb.todense())
-            outs.append(np.asarray(predict(state, xb)))
-        if not outs:
-            return np.zeros((0,), dtype=np.float64)
-        return np.concatenate(outs, axis=0)
+        with dtype_scope(np.float32 if self._float32_inputs else np.float64):
+            construct, predict, _ = self._get_transform_func()
+            state = construct()
+            n = features.shape[0]
+            batch = int(config["max_records_per_batch"])
+            outs = []
+            for start in range(0, n, batch):
+                stop = min(start + batch, n)
+                xb = features[start:stop]
+                if hasattr(xb, "todense"):
+                    xb = np.asarray(xb.todense())
+                outs.append(np.asarray(predict(state, xb)))
+            if not outs:
+                return np.zeros((0,), dtype=np.float64)
+            return np.concatenate(outs, axis=0)
 
     def transform(self, dataset: Any):
         pdf = as_pandas(dataset)

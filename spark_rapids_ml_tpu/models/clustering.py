@@ -1,0 +1,230 @@
+#
+# Clustering algorithms: KMeans (DBSCAN lands in this module too — reference
+# clustering.py holds both).
+#
+# API-parity target: reference clustering.py:67-499 (`KMeans`/`KMeansModel`),
+# drop-in for `pyspark.ml.clustering.KMeans`. Distributed strategy identical in
+# math (row data-parallel Lloyd with center allreduce, SURVEY.md §2.2) but as
+# one jitted while_loop program instead of per-iteration cuML MG calls.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import FitInputs, _TpuEstimator, _TpuModelWithColumns, pred
+from ..data import ExtractedData
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasPredictionCol,
+    HasSeed,
+    HasTol,
+    HasMaxIter,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+)
+
+
+class _KMeansParams(
+    HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasSeed, HasTol, HasMaxIter, HasWeightCol
+):
+    k = Param("k", "the number of clusters to create", TypeConverters.toInt)
+    initMode = Param(
+        "initMode", "the initialization algorithm: 'k-means||' or 'random'", TypeConverters.toString
+    )
+    initSteps = Param("initSteps", "the number of steps for k-means|| initialization", TypeConverters.toInt)
+    distanceMeasure = Param("distanceMeasure", "the distance measure (euclidean only)", TypeConverters.toString)
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def getInitMode(self) -> str:
+        return self.getOrDefault("initMode")
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # mirrors reference clustering.py param mapping (Spark -> cuml kwargs)
+        return {
+            "k": "n_clusters",
+            "maxIter": "max_iter",
+            "tol": "tol",
+            "seed": "random_state",
+            "initMode": "init",
+            "initSteps": "",  # accepted, ignored (cuML has no analog; reference does the same)
+            "distanceMeasure": None,  # only 'euclidean'; validated in _set_params
+            "weightCol": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        return {"tol": lambda v: 1e-16 if v == 0 else v}  # reference clustering.py:96-108 tol=0 remap
+
+    def _get_solver_params_default(self) -> Dict[str, Any]:
+        return {
+            "n_clusters": 8,
+            "max_iter": 300,
+            "tol": 1e-4,
+            "random_state": 1,
+            "init": "scalable-k-means++",
+            "max_samples_per_batch": 32768,
+            "oversampling_factor": 2.0,
+            "verbose": False,
+        }
+
+
+class KMeans(_KMeansParams, _TpuEstimator):
+    """KMeans estimator, drop-in for ``pyspark.ml.clustering.KMeans``.
+
+    Fit is a single XLA program: `lax.while_loop` of Lloyd iterations over the
+    row-sharded mesh, each iteration scanning row tiles of
+    ``max_samples_per_batch`` rows (HBM-bounded) and psum-reducing (k,d) center
+    sums — the TPU-native equivalent of `KMeansMG.fit` (reference
+    clustering.py:339-384).
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(k=2, initMode="k-means||", initSteps=2, maxIter=20, tol=1e-4, seed=1,
+                         distanceMeasure="euclidean")
+        self._set_params(**kwargs)
+
+    def _set_params(self, **kwargs):
+        if "distanceMeasure" in kwargs and kwargs["distanceMeasure"] != "euclidean":
+            raise ValueError("Only distanceMeasure='euclidean' is supported")
+        kwargs.pop("distanceMeasure", None)
+        return super()._set_params(**kwargs)
+
+    def setK(self, value: int) -> "KMeans":
+        return self._set_params(k=value)
+
+    def setMaxIter(self, value: int) -> "KMeans":
+        return self._set_params(maxIter=value)
+
+    def setTol(self, value: float) -> "KMeans":
+        return self._set_params(tol=value)
+
+    def setSeed(self, value: int) -> "KMeans":
+        return self._set_params(seed=value)
+
+    def setInitMode(self, value: str) -> "KMeans":
+        return self._set_params(initMode=value)
+
+    def setFeaturesCol(self, value) -> "KMeans":
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setPredictionCol(self, value: str) -> "KMeans":
+        return self._set_params(predictionCol=value)
+
+    def setWeightCol(self, value: str) -> "KMeans":
+        return self._set_params(weightCol=value)
+
+    def _get_tpu_fit_func(self, extracted: ExtractedData):
+        from ..ops.kmeans import kmeans_fit, kmeans_plus_plus_init, random_init
+
+        x_host = extracted.features
+        w_host = extracted.weight
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            k = int(params["n_clusters"])
+            if k > inputs.n_valid:
+                raise ValueError(f"k={k} exceeds number of rows {inputs.n_valid}")
+            init_mode = params.get("init", "scalable-k-means++")
+            seed = int(params.get("random_state", 1) or 1)
+            if init_mode == "random":
+                centers0 = random_init(x_host, k, seed)
+            else:  # 'k-means||' / 'scalable-k-means++'
+                centers0 = kmeans_plus_plus_init(x_host, k, seed, w_host)
+            centers0 = centers0.astype(inputs.dtype)
+            state = kmeans_fit(
+                inputs.X,
+                inputs.w,
+                centers0,
+                mesh=inputs.mesh,
+                max_iter=int(params["max_iter"]),
+                tol=float(params["tol"]),
+                batch_rows=int(params.get("max_samples_per_batch", 32768)),
+            )
+            return {
+                "cluster_centers_": np.asarray(state["cluster_centers_"]),
+                "inertia_": float(state["inertia_"]),
+                "n_iter_": int(state["n_iter_"]),
+                "n_cols": inputs.n_cols,
+                "dtype": np.dtype(inputs.dtype).name,
+            }
+
+        return _fit
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "KMeansModel":
+        return KMeansModel(**attrs)
+
+
+class KMeansModel(_KMeansParams, _TpuModelWithColumns):
+    """Fitted KMeans model (reference clustering.py:386-499)."""
+
+    def __init__(
+        self,
+        cluster_centers_: Optional[np.ndarray] = None,
+        inertia_: float = 0.0,
+        n_iter_: int = 0,
+        n_cols: int = 0,
+        dtype: str = "float32",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            cluster_centers_=cluster_centers_,
+            inertia_=inertia_,
+            n_iter_=n_iter_,
+            n_cols=n_cols,
+            dtype=dtype,
+        )
+        self.cluster_centers_ = np.asarray(cluster_centers_)
+        self.inertia_ = float(inertia_)
+        self.n_iter_ = int(n_iter_)
+        self.n_cols = int(n_cols)
+        self.dtype = dtype
+        self._setDefault(k=int(self.cluster_centers_.shape[0]) if cluster_centers_ is not None else 2)
+
+    def clusterCenters(self) -> List[np.ndarray]:
+        """Spark ML surface: list of center vectors."""
+        return [c for c in self.cluster_centers_]
+
+    @property
+    def numClusters(self) -> int:
+        return self.cluster_centers_.shape[0]
+
+    def predict(self, value) -> int:
+        """Single-vector predict (Spark ML model surface)."""
+        from ..linalg import Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        d2 = np.sum((self.cluster_centers_ - v[None, :]) ** 2, axis=1)
+        return int(np.argmin(d2))
+
+    def setFeaturesCol(self, value) -> "KMeansModel":
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setPredictionCol(self, value: str) -> "KMeansModel":
+        return self._set_params(predictionCol=value)
+
+    def _out_column_names(self) -> List[str]:
+        return [self.getOrDefault("predictionCol")]
+
+    def _get_transform_func(self):
+        import jax
+
+        from ..ops.kmeans import kmeans_predict
+        from ..parallel.mesh import default_devices
+
+        centers = self.cluster_centers_
+        dtype = np.float32 if self._float32_inputs else np.float64
+
+        def construct():
+            return jax.device_put(centers.astype(dtype), default_devices()[0])
+
+        def predict(state, xb):
+            return kmeans_predict(xb.astype(dtype), state)
+
+        return construct, predict, None

@@ -1,0 +1,248 @@
+#
+# Regression algorithms: LinearRegression (+Ridge/Lasso/ElasticNet via params).
+# RandomForestRegressor joins this module when the tree family lands
+# (mirroring reference regression.py which hosts both).
+#
+# API-parity target: reference regression.py:176-797, drop-in for
+# `pyspark.ml.regression.LinearRegression`. Solver selection by reg params
+# matches the reference (regression.py:510-548): OLS / Ridge(alpha·m) / CD.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithColumns, pred
+from ..data import ExtractedData
+from ..params import (
+    HasElasticNetParam,
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasFitIntercept,
+    HasLabelCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRegParam,
+    HasStandardization,
+    HasTol,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+)
+
+
+class _LinearRegressionParams(
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasMaxIter,
+    HasTol,
+    HasRegParam,
+    HasElasticNetParam,
+    HasFitIntercept,
+    HasStandardization,
+    HasWeightCol,
+):
+    solver = Param("solver", "solver algorithm: 'auto', 'normal' or 'eig'", TypeConverters.toString)
+    loss = Param("loss", "loss function: only 'squaredError'", TypeConverters.toString)
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # mirrors reference regression.py param mapping
+        return {
+            "maxIter": "max_iter",
+            "regParam": "alpha",
+            "elasticNetParam": "l1_ratio",
+            "tol": "tol",
+            "fitIntercept": "fit_intercept",
+            "standardization": "normalize",
+            "solver": "solver",
+            "loss": "loss",
+            "weightCol": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        def _solver(v):
+            return {"auto": "eig", "normal": "eig", "eig": "eig"}.get(v)
+
+        def _loss(v):
+            return "squared_loss" if v in ("squaredError", "squared_loss") else None
+
+        return {"solver": _solver, "loss": _loss}
+
+    def _get_solver_params_default(self) -> Dict[str, Any]:
+        return {
+            "alpha": 0.0001,
+            "l1_ratio": 0.0,
+            "fit_intercept": True,
+            "normalize": False,
+            "max_iter": 1000,
+            "tol": 1e-3,
+            "solver": "eig",
+            "loss": "squared_loss",
+            "verbose": False,
+        }
+
+
+class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
+    """LinearRegression estimator, drop-in for ``pyspark.ml.regression.LinearRegression``.
+
+    One distributed pass builds the normal-equation sufficient statistics
+    (XᵀWX/XᵀWy psum across the rows mesh); OLS/Ridge solve locally, L1/EN runs
+    gram-space coordinate descent — no further passes over the data. The Ridge
+    path scales alpha by Σw for Spark objective parity (reference
+    regression.py:536-542).
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            maxIter=100, regParam=0.0, elasticNetParam=0.0, tol=1e-6,
+            fitIntercept=True, standardization=True, solver="auto", loss="squaredError",
+        )
+        self._set_params(**kwargs)
+
+    def setMaxIter(self, value: int) -> "LinearRegression":
+        return self._set_params(maxIter=value)
+
+    def setRegParam(self, value: float) -> "LinearRegression":
+        return self._set_params(regParam=value)
+
+    def setElasticNetParam(self, value: float) -> "LinearRegression":
+        return self._set_params(elasticNetParam=value)
+
+    def setTol(self, value: float) -> "LinearRegression":
+        return self._set_params(tol=value)
+
+    def setFitIntercept(self, value: bool) -> "LinearRegression":
+        return self._set_params(fitIntercept=value)
+
+    def setStandardization(self, value: bool) -> "LinearRegression":
+        return self._set_params(standardization=value)
+
+    def setFeaturesCol(self, value) -> "LinearRegression":
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setLabelCol(self, value: str) -> "LinearRegression":
+        return self._set_params(labelCol=value)
+
+    def setPredictionCol(self, value: str) -> "LinearRegression":
+        return self._set_params(predictionCol=value)
+
+    def setWeightCol(self, value: str) -> "LinearRegression":
+        return self._set_params(weightCol=value)
+
+    def _get_tpu_fit_func(self, extracted: ExtractedData):
+        from ..ops.linear import linear_fit
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            alpha = float(params["alpha"])
+            l1_ratio = float(params["l1_ratio"])
+            use_cd = bool(alpha > 0 and l1_ratio > 0)
+            state = linear_fit(
+                inputs.X,
+                inputs.y,
+                inputs.w,
+                alpha=alpha,
+                l1_ratio=l1_ratio,
+                fit_intercept=bool(params["fit_intercept"]),
+                standardize=bool(params.get("normalize", False)),
+                use_cd=use_cd,
+                max_iter=int(params["max_iter"]),
+                tol=float(params["tol"]),
+            )
+            return {
+                "coef_": np.asarray(state["coef_"]),
+                "intercept_": float(state["intercept_"]),
+                "n_iter_": int(state["n_iter_"]),
+                "n_cols": inputs.n_cols,
+                "dtype": np.dtype(inputs.dtype).name,
+            }
+
+        return _fit
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "LinearRegressionModel":
+        return LinearRegressionModel(**attrs)
+
+
+class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
+    """Fitted linear regression model (reference regression.py:616-797)."""
+
+    def __init__(
+        self,
+        coef_: Optional[np.ndarray] = None,
+        intercept_: float = 0.0,
+        n_iter_: int = 0,
+        n_cols: int = 0,
+        dtype: str = "float32",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            coef_=coef_, intercept_=intercept_, n_iter_=n_iter_, n_cols=n_cols, dtype=dtype
+        )
+        self.coef_ = np.asarray(coef_)
+        self.intercept_ = float(intercept_)
+        self.n_iter_ = int(n_iter_)
+        self.n_cols = int(n_cols)
+        self.dtype = dtype
+
+    # -- Spark ML model surface -------------------------------------------
+    @property
+    def coefficients(self):
+        from ..linalg import DenseVector
+
+        return DenseVector(self.coef_)
+
+    @property
+    def intercept(self) -> float:
+        return self.intercept_
+
+    @property
+    def numFeatures(self) -> int:
+        return self.n_cols
+
+    @property
+    def hasSummary(self) -> bool:
+        return False
+
+    def setFeaturesCol(self, value) -> "LinearRegressionModel":
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setPredictionCol(self, value: str) -> "LinearRegressionModel":
+        return self._set_params(predictionCol=value)
+
+    def predict(self, value) -> float:
+        """Single-vector predict (Spark ML model surface)."""
+        from ..linalg import Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        return float(v @ self.coef_ + self.intercept_)
+
+    def _out_column_names(self) -> List[str]:
+        return [self.getOrDefault("predictionCol")]
+
+    def _get_transform_func(self):
+        import jax
+
+        from ..ops.linear import linear_predict
+        from ..parallel.mesh import default_devices
+
+        coef = self.coef_
+        intercept = self.intercept_
+        dtype = np.float32 if self._float32_inputs else np.float64
+
+        def construct():
+            dev = default_devices()[0]
+            return (
+                jax.device_put(coef.astype(dtype), dev),
+                jax.device_put(np.asarray(intercept, dtype=dtype), dev),
+            )
+
+        def predict(state, xb):
+            c, b = state
+            return linear_predict(xb.astype(dtype), c, b)
+
+        return construct, predict, None

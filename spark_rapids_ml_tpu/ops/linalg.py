@@ -43,16 +43,6 @@ def weighted_cov(
     return total_w, mean, cov
 
 
-def gram_and_xty(
-    X: jax.Array, y: jax.Array, w: jax.Array
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Weighted (XᵀWX, XᵀWy, Σw) — the normal-equation sufficient statistics."""
-    Xw = X * w[:, None]
-    gram = jnp.einsum("nd,ne->de", Xw, X)
-    xty = jnp.einsum("nd,n->d", Xw, y)
-    return gram, xty, jnp.sum(w)
-
-
 def sign_flip(components: jax.Array) -> jax.Array:
     """Canonicalize eigenvector signs: the max-|value| element of each component
     row is made positive — the exact semantics of the reference's thrust

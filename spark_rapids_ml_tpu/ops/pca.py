@@ -62,8 +62,3 @@ def pca_transform(
     if whiten:
         T = T * jax.lax.rsqrt(jnp.maximum(explained_variance, 1e-30))
     return T
-
-
-@jax.jit
-def pca_inverse_transform(T: jax.Array, components: jax.Array) -> jax.Array:
-    return T @ components

@@ -77,16 +77,21 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def ensure_dtype_support(dtype) -> None:
-    """Make float64 actually mean float64 on device.
+def dtype_scope(dtype):
+    """Context that makes float64 actually mean float64 on device, scoped to the
+    framework's own computations.
 
     JAX's default `jax_enable_x64=False` silently downcasts f64 to f32; a user
     who passed ``float32_inputs=False`` asked for double precision (the
-    reference supports f64 end-to-end; SURVEY.md §7 'float64 parity'), so flip
-    the flag on demand rather than silently degrading.
+    reference supports f64 end-to-end; SURVEY.md §7 'float64 parity'). The flag
+    is enabled via the scoped `jax.experimental.enable_x64` context so the
+    user's own JAX code keeps its default semantics.
     """
+    import contextlib
+
     if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
-        jax.config.update("jax_enable_x64", True)
+        return jax.enable_x64(True)  # jax config State: usable as a scoped context
+    return contextlib.nullcontext()
 
 
 def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:

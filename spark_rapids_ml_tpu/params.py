@@ -415,6 +415,21 @@ class _TpuParams(_TpuClass, Params):
     def float32_inputs(self) -> bool:
         return self._float32_inputs
 
+    def _setDefault(self: P, **kwargs: Any) -> P:
+        """Also push mapped Spark-param defaults into solver params so the two
+        tiers never disagree (a Spark default of regParam=0.0 must beat a
+        solver-kwarg default of alpha=1e-4)."""
+        super()._setDefault(**kwargs)
+        param_map = self._param_mapping()
+        for name, value in kwargs.items():
+            mapped = param_map.get(name)
+            if mapped:  # skip None (unsupported) and "" (dropped)
+                try:
+                    self._set_solver_param(mapped, value, silent=True)
+                except ValueError:
+                    pass  # a default value outside the solver's domain stays solver-side
+        return self
+
     # -- the single entry point every setter funnels through --------------
     def _set_params(self: P, **kwargs: Any) -> P:
         """Route kwargs to Spark Params and/or solver params (reference params.py:304-358)."""

@@ -1,0 +1,119 @@
+#
+# KMeans compat tests vs sklearn (reference tests/test_kmeans.py pattern).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.linalg import Vectors
+from spark_rapids_ml_tpu.models.clustering import KMeans, KMeansModel
+
+
+def _blobs(rng, n=400, d=6, k=4, dtype=np.float32):
+    from sklearn.datasets import make_blobs
+
+    x, y = make_blobs(n_samples=n, n_features=d, centers=k, cluster_std=0.4, random_state=7)
+    return x.astype(dtype), y
+
+
+@pytest.mark.parametrize("feature_type", ["array", "vector"])
+def test_kmeans_recovers_blobs(rng, feature_type):
+    x, y = _blobs(rng)
+    col = list(x) if feature_type == "array" else [Vectors.dense(v) for v in x]
+    df = pd.DataFrame({"features": col})
+    km = KMeans(k=4, maxIter=50, seed=5, num_workers=4).setFeaturesCol("features")
+    model = km.fit(df)
+    assert model.cluster_centers_.shape == (4, 6)
+
+    out = model.transform(df)
+    labels = np.asarray(out["prediction"], dtype=int)
+    # clustering must match blob structure up to label permutation
+    from sklearn.metrics import adjusted_rand_score
+
+    assert adjusted_rand_score(y, labels) > 0.99
+
+
+def test_kmeans_vs_sklearn_inertia(rng):
+    from sklearn.cluster import KMeans as SkKMeans
+
+    x, _ = _blobs(rng, n=300, d=5, k=3)
+    df = pd.DataFrame({"features": list(x)})
+    model = KMeans(k=3, maxIter=100, tol=1e-8, seed=3).setFeaturesCol("features").fit(df)
+    sk = SkKMeans(n_clusters=3, n_init=10, random_state=0).fit(x)
+    assert model.inertia_ <= sk.inertia_ * 1.05
+
+
+def test_kmeans_random_init_and_params(rng):
+    x, _ = _blobs(rng, n=100, d=4, k=2)
+    df = pd.DataFrame({"features": list(x)})
+    km = (
+        KMeans()
+        .setK(2)
+        .setMaxIter(30)
+        .setInitMode("random")
+        .setSeed(11)
+        .setFeaturesCol("features")
+        .setPredictionCol("cluster")
+    )
+    assert km.solver_params["n_clusters"] == 2
+    assert km.solver_params["init"] == "random"
+    model = km.fit(df)
+    out = model.transform(df)
+    assert set(np.asarray(out["cluster"], dtype=int)) == {0, 1}
+    # single-vector predict agrees with transform
+    assert model.predict(x[0]) == int(out["cluster"].iloc[0])
+
+
+def test_kmeans_tol_zero_remap():
+    km = KMeans(k=2).setTol(0.0)
+    assert km.solver_params["tol"] == 1e-16
+
+
+def test_kmeans_distance_measure_validation():
+    with pytest.raises(ValueError, match="euclidean"):
+        KMeans(k=2, distanceMeasure="cosine")
+    KMeans(k=2, distanceMeasure="euclidean")  # accepted
+
+
+def test_kmeans_weighted(rng):
+    # weight w==duplication equivalence for centers
+    x = np.array([[0.0, 0], [0, 0.1], [10, 10], [10, 10.1], [10, 9.9]], dtype=np.float64)
+    w = np.array([3.0, 3.0, 1.0, 1.0, 1.0])
+    df_w = pd.DataFrame({"features": list(x), "w": w})
+    model = (
+        KMeans(k=2, seed=2, maxIter=50, float32_inputs=False)
+        .setFeaturesCol("features")
+        .setWeightCol("w")
+        .fit(df_w)
+    )
+    centers = sorted([tuple(np.round(c, 3)) for c in model.cluster_centers_])
+    assert centers[0] == (0.0, 0.05)
+    np.testing.assert_allclose(centers[1], (10, 10), atol=0.1)
+
+
+def test_kmeans_persistence(tmp_path, rng):
+    x, _ = _blobs(rng, n=80, d=3, k=2)
+    df = pd.DataFrame({"features": list(x)})
+    model = KMeans(k=2, seed=1).setFeaturesCol("features").fit(df)
+    p = str(tmp_path / "km")
+    model.write().overwrite().save(p)
+    loaded = KMeansModel.load(p)
+    np.testing.assert_array_equal(loaded.cluster_centers_, model.cluster_centers_)
+    out1 = model.transform(df)["prediction"]
+    out2 = loaded.transform(df)["prediction"]
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_kmeans_k_exceeds_rows(rng):
+    df = pd.DataFrame({"features": list(rng.normal(size=(3, 2)))})
+    with pytest.raises(ValueError, match="exceeds"):
+        KMeans(k=5).setFeaturesCol("features").fit(df)
+
+
+def test_kmeans_batching_equivalence(rng):
+    # tiny max_samples_per_batch must not change results
+    x, _ = _blobs(rng, n=200, d=4, k=3)
+    df = pd.DataFrame({"features": list(x)})
+    m1 = KMeans(k=3, seed=9, maxIter=40).setFeaturesCol("features").fit(df)
+    m2 = KMeans(k=3, seed=9, maxIter=40, max_samples_per_batch=17).setFeaturesCol("features").fit(df)
+    np.testing.assert_allclose(m1.cluster_centers_, m2.cluster_centers_, atol=1e-4)

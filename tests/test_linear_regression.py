@@ -1,0 +1,180 @@
+#
+# LinearRegression compat tests vs sklearn across OLS / Ridge / Lasso / EN
+# (reference tests/test_linear_regression.py pattern).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.models.regression import LinearRegression, LinearRegressionModel
+
+
+def _data(rng, n=300, d=8, noise=0.1, dtype=np.float64):
+    x = rng.normal(size=(n, d)).astype(dtype)
+    true_coef = rng.normal(size=d)
+    y = (x @ true_coef + 1.5 + noise * rng.normal(size=n)).astype(dtype)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    return df, x, y, true_coef
+
+
+def test_ols_vs_sklearn(rng):
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    df, x, y, _ = _data(rng)
+    model = LinearRegression(regParam=0.0, float32_inputs=False, num_workers=4).setFeaturesCol("features").fit(df)
+    sk = SkLR().fit(x, y)
+    np.testing.assert_allclose(model.coef_, sk.coef_, rtol=1e-6)
+    np.testing.assert_allclose(model.intercept_, sk.intercept_, rtol=1e-6)
+    out = model.transform(df)
+    np.testing.assert_allclose(np.asarray(out["prediction"]), sk.predict(x), rtol=1e-6)
+
+
+def test_ridge_spark_alpha_scaling(rng):
+    # Spark objective 1/(2n)RSS + λ/2‖b‖² == sklearn Ridge(alpha=λ·n)
+    from sklearn.linear_model import Ridge
+
+    df, x, y, _ = _data(rng)
+    lam = 1e-3
+    model = (
+        LinearRegression(regParam=lam, elasticNetParam=0.0, standardization=False, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    sk = Ridge(alpha=lam * len(y)).fit(x, y)
+    np.testing.assert_allclose(model.coef_, sk.coef_, rtol=1e-5)
+    np.testing.assert_allclose(model.intercept_, sk.intercept_, rtol=1e-5)
+
+
+def test_lasso_vs_sklearn(rng):
+    from sklearn.linear_model import Lasso
+
+    df, x, y, _ = _data(rng, n=500, d=10)
+    lam = 0.05
+    model = (
+        LinearRegression(
+            regParam=lam, elasticNetParam=1.0, standardization=False,
+            maxIter=2000, tol=1e-10, float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    sk = Lasso(alpha=lam, max_iter=10000, tol=1e-12).fit(x, y)
+    np.testing.assert_allclose(model.coef_, sk.coef_, atol=1e-5)
+    np.testing.assert_allclose(model.intercept_, sk.intercept_, atol=1e-5)
+    # sparsity induced
+    assert np.sum(np.abs(model.coef_) < 1e-9) == np.sum(np.abs(sk.coef_) < 1e-9)
+
+
+def test_elastic_net_vs_sklearn(rng):
+    from sklearn.linear_model import ElasticNet
+
+    df, x, y, _ = _data(rng, n=400, d=6)
+    lam, l1r = 0.03, 0.5
+    model = (
+        LinearRegression(
+            regParam=lam, elasticNetParam=l1r, standardization=False,
+            maxIter=3000, tol=1e-10, float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    sk = ElasticNet(alpha=lam, l1_ratio=l1r, max_iter=10000, tol=1e-12).fit(x, y)
+    np.testing.assert_allclose(model.coef_, sk.coef_, atol=1e-5)
+    np.testing.assert_allclose(model.intercept_, sk.intercept_, atol=1e-5)
+
+
+def test_no_intercept(rng):
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    df, x, y, _ = _data(rng)
+    model = (
+        LinearRegression(fitIntercept=False, float32_inputs=False).setFeaturesCol("features").fit(df)
+    )
+    sk = SkLR(fit_intercept=False).fit(x, y)
+    np.testing.assert_allclose(model.coef_, sk.coef_, rtol=1e-6)
+    assert model.intercept_ == 0.0
+
+
+def test_weighted_equals_duplication(rng):
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    df, x, y, _ = _data(rng, n=60, d=4)
+    w = rng.integers(1, 4, size=60).astype(np.float64)
+    df["w"] = w
+    model = (
+        LinearRegression(float32_inputs=False).setFeaturesCol("features").setWeightCol("w").fit(df)
+    )
+    x_dup = np.repeat(x, w.astype(int), axis=0)
+    y_dup = np.repeat(y, w.astype(int))
+    sk = SkLR().fit(x_dup, y_dup)
+    np.testing.assert_allclose(model.coef_, sk.coef_, rtol=1e-6)
+    np.testing.assert_allclose(model.intercept_, sk.intercept_, rtol=1e-6)
+
+
+def test_standardization_ridge_differs_but_predicts(rng):
+    df, x, y, _ = _data(rng)
+    m_std = LinearRegression(regParam=0.1, standardization=True, float32_inputs=False).setFeaturesCol("features").fit(df)
+    m_raw = LinearRegression(regParam=0.1, standardization=False, float32_inputs=False).setFeaturesCol("features").fit(df)
+    assert not np.allclose(m_std.coef_, m_raw.coef_)
+    # both still predict reasonably
+    for m in (m_std, m_raw):
+        p = np.asarray(m.transform(df)["prediction"])
+        assert np.corrcoef(p, y)[0, 1] > 0.95
+
+
+def test_spark_params_surface(rng):
+    lr = (
+        LinearRegression()
+        .setMaxIter(42)
+        .setRegParam(0.2)
+        .setElasticNetParam(0.3)
+        .setTol(1e-9)
+        .setStandardization(False)
+        .setLabelCol("label")
+        .setPredictionCol("pred_out")
+        .setFeaturesCol("features")
+    )
+    assert lr.solver_params["max_iter"] == 42
+    assert lr.solver_params["alpha"] == 0.2
+    assert lr.solver_params["l1_ratio"] == 0.3
+    assert lr.getOrDefault("predictionCol") == "pred_out"
+    with pytest.raises(ValueError):
+        lr._set_params(loss="huber")  # unsupported loss value
+
+    df, x, y, _ = _data(rng, n=50, d=3)
+    model = lr.fit(df)
+    out = model.transform(df)
+    assert "pred_out" in out.columns
+    assert model.coefficients.size == 3
+    assert isinstance(model.intercept, float)
+    assert model.numFeatures == 3
+    assert abs(model.predict(x[0]) - np.asarray(out["pred_out"])[0]) < 1e-5
+
+
+def test_persistence(tmp_path, rng):
+    df, x, y, _ = _data(rng, n=50, d=3)
+    model = LinearRegression(float32_inputs=False).setFeaturesCol("features").fit(df)
+    p = str(tmp_path / "lr")
+    model.write().overwrite().save(p)
+    loaded = LinearRegressionModel.load(p)
+    np.testing.assert_array_equal(loaded.coef_, model.coef_)
+    assert loaded.intercept_ == model.intercept_
+    np.testing.assert_allclose(
+        np.asarray(loaded.transform(df)["prediction"]),
+        np.asarray(model.transform(df)["prediction"]),
+    )
+
+
+def test_fit_multiple_reg_paths(rng):
+    df, x, y, _ = _data(rng)
+    est = LinearRegression(standardization=False, float32_inputs=False).setFeaturesCol("features")
+    pmaps = [
+        {est.getParam("regParam"): 0.0},
+        {est.getParam("regParam"): 0.1},
+        {est.getParam("regParam"): 0.1, est.getParam("elasticNetParam"): 1.0},
+    ]
+    models = dict(est.fitMultiple(df, pmaps))
+    assert len(models) == 3
+    # more regularization shrinks coefficients
+    assert np.linalg.norm(models[1].coef_) < np.linalg.norm(models[0].coef_)
+    assert np.linalg.norm(models[2].coef_) < np.linalg.norm(models[0].coef_)

@@ -1,0 +1,42 @@
+#
+# Direct unit tests for solver-layer primitives not covered transitively.
+#
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.linalg import sign_flip, topk_eigh_desc, weighted_cov, weighted_moments
+
+
+def test_weighted_moments(rng):
+    x = rng.normal(size=(100, 4))
+    w = rng.uniform(0.5, 2.0, size=100)
+    total, mean, var = weighted_moments(jnp.asarray(x), jnp.asarray(w))
+    np.testing.assert_allclose(float(total), w.sum(), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(mean), np.average(x, axis=0, weights=w), rtol=1e-10)
+    expected_var = np.average((x - np.average(x, axis=0, weights=w)) ** 2, axis=0, weights=w)
+    np.testing.assert_allclose(np.asarray(var), expected_var, rtol=1e-8)
+
+
+def test_weighted_cov_matches_numpy(rng):
+    x = rng.normal(size=(50, 3))
+    w = np.ones(50)
+    _, mean, cov = weighted_cov(jnp.asarray(x), jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(cov), np.cov(x.T), rtol=1e-10)
+
+
+def test_sign_flip():
+    comps = jnp.asarray([[0.1, -0.9, 0.2], [0.5, 0.4, 0.3]])
+    flipped = np.asarray(sign_flip(comps))
+    np.testing.assert_allclose(flipped[0], [-0.1, 0.9, -0.2])
+    np.testing.assert_allclose(flipped[1], [0.5, 0.4, 0.3])
+
+
+def test_topk_eigh_desc(rng):
+    a = rng.normal(size=(5, 5))
+    sym = a @ a.T
+    evals, evecs = topk_eigh_desc(jnp.asarray(sym), 3)
+    evals = np.asarray(evals)
+    assert evals[0] >= evals[1] >= evals[2]
+    for i in range(3):
+        np.testing.assert_allclose(sym @ np.asarray(evecs[i]), evals[i] * np.asarray(evecs[i]), atol=1e-8)
