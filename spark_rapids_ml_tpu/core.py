@@ -264,6 +264,11 @@ class _TpuEstimator(_TpuCaller):
     def _create_model(self, attrs: Dict[str, Any]) -> "_TpuModel":
         raise NotImplementedError
 
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        """Whether CrossValidator can use the fused multi-model evaluate path
+        (reference `_CumlEstimator._supportsTransformEvaluate`)."""
+        return False
+
     # persistence ---------------------------------------------------------
     def write(self) -> "_TpuWriter":
         return _TpuWriter(self)

@@ -167,6 +167,17 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
     def _create_model(self, attrs: Dict[str, Any]) -> "LinearRegressionModel":
         return LinearRegressionModel(**attrs)
 
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        if not hasattr(evaluator, "getMetricName"):
+            return False
+        if evaluator.getMetricName() not in ("rmse", "mse", "r2", "mae", "var"):
+            return False
+        # weighted evaluation must take the fallback path (the fused pass
+        # produces unweighted sufficient stats)
+        if evaluator.hasParam("weightCol") and evaluator.isDefined("weightCol"):
+            return False
+        return True
+
 
 class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
     """Fitted linear regression model (reference regression.py:616-797)."""
@@ -223,6 +234,46 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
 
     def _out_column_names(self) -> List[str]:
         return [self.getOrDefault("predictionCol")]
+
+    # -- fused CV path (reference regression.py:762-785, 90-142) -----------
+    def _combine(self, models: List["LinearRegressionModel"]) -> "LinearRegressionModel":
+        """Pack N fitted models into one multi-model (coef_ stacked [m, d])."""
+        combined = LinearRegressionModel(
+            coef_=np.stack([m.coef_ for m in models]),
+            intercept_=0.0,
+            n_iter_=self.n_iter_,
+            n_cols=self.n_cols,
+            dtype=self.dtype,
+        )
+        combined._intercepts = np.asarray([m.intercept_ for m in models])
+        self._copyValues(combined)
+        self._copy_solver_params(combined)
+        return combined
+
+    def _transform_evaluate(self, dataset: Any, evaluator: Any) -> List[float]:
+        """Score ALL packed models in one pass: predictions [n, m] via a single
+        MXU matmul, then per-model regression sufficient stats."""
+        from ..metrics import RegressionMetrics
+
+        assert self.coef_.ndim == 2 and hasattr(self, "_intercepts"), "call _combine first"
+        from ..data import as_pandas
+
+        extracted = self._pre_process_data(dataset, for_fit=False)
+        # the evaluator's labelCol governs scoring (it may differ from the model's)
+        label_col = (
+            evaluator.getOrDefault("labelCol")
+            if hasattr(evaluator, "hasParam") and evaluator.hasParam("labelCol")
+            else self.getOrDefault("labelCol")
+        )
+        label = as_pandas(dataset)[label_col].to_numpy(dtype=np.float64)
+        feats = extracted.features
+        if hasattr(feats, "todense"):
+            feats = np.asarray(feats.todense())
+        preds = feats.astype(np.float64) @ self.coef_.T + self._intercepts[None, :]  # [n, m]
+        return [
+            RegressionMetrics.from_values(label, preds[:, j]).evaluate(evaluator)
+            for j in range(preds.shape[1])
+        ]
 
     def _get_transform_func(self):
         import jax

@@ -1,0 +1,210 @@
+#
+# Hyperparameter tuning: ParamGridBuilder, CrossValidator, CrossValidatorModel —
+# drop-in for `pyspark.ml.tuning` (reference tuning.py, 177 LoC).
+#
+# The accelerated path mirrors the reference's meta-algorithm exactly
+# (SURVEY.md §3.3): per fold, `fitMultiple` trains ALL param maps in ONE pass
+# over the (device-resident) data, `_combine` packs them into one multi-model,
+# and `_transform_evaluate` scores every model in ONE pass via the metrics
+# sufficient-stats machinery. Estimator/evaluator combos outside that contract
+# fall back to the plain fit-per-model loop (reference tuning.py:96-99 falls
+# back to Spark CV the same way).
+#
+from __future__ import annotations
+
+from multiprocessing.pool import ThreadPool
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .core import _TpuEstimator, _TpuModel
+from .params import Param, Params, TypeConverters
+from .utils import get_logger
+
+
+class ParamGridBuilder:
+    """Builder for a param grid used in grid search (pyspark.ml.tuning parity)."""
+
+    def __init__(self) -> None:
+        self._param_grid: Dict[Param, List[Any]] = {}
+
+    def addGrid(self, param: Param, values: List[Any]) -> "ParamGridBuilder":
+        if not isinstance(param, Param):
+            raise TypeError("param must be an instance of Param")
+        self._param_grid[param] = list(values)
+        return self
+
+    def baseOn(self, *args) -> "ParamGridBuilder":
+        if isinstance(args[0], dict):
+            args = tuple(args[0].items())
+        for param, value in args:
+            self.addGrid(param, [value])
+        return self
+
+    def build(self) -> List[Dict[Param, Any]]:
+        keys = list(self._param_grid.keys())
+        grids: List[Dict[Param, Any]] = [{}]
+        for key in keys:
+            grids = [{**g, key: v} for g in grids for v in self._param_grid[key]]
+        return grids
+
+
+class _ValidatorParams(Params):
+    numFolds = Param("numFolds", "number of folds for cross validation (>= 2)", TypeConverters.toInt)
+    seed = Param("seed", "random seed for fold assignment", TypeConverters.toInt)
+    parallelism = Param("parallelism", "number of threads evaluating folds in parallel", TypeConverters.toInt)
+    collectSubModels = Param("collectSubModels", "whether to keep all sub-models", TypeConverters.toBoolean)
+    foldCol = Param("foldCol", "optional column with user-specified fold ids", TypeConverters.toString)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._estimator: Optional[Any] = None
+        self._estimatorParamMaps: Optional[List[Dict[Param, Any]]] = None
+        self._evaluator: Optional[Any] = None
+        self._setDefault(numFolds=3, seed=0, parallelism=1, collectSubModels=False, foldCol="")
+
+    def getEstimator(self):
+        return self._estimator
+
+    def setEstimator(self, value):
+        self._estimator = value
+        return self
+
+    def getEstimatorParamMaps(self):
+        return self._estimatorParamMaps
+
+    def setEstimatorParamMaps(self, value):
+        self._estimatorParamMaps = value
+        return self
+
+    def getEvaluator(self):
+        return self._evaluator
+
+    def setEvaluator(self, value):
+        self._evaluator = value
+        return self
+
+    def getNumFolds(self) -> int:
+        return self.getOrDefault("numFolds")
+
+    def setNumFolds(self, value: int):
+        return self._set(numFolds=value)
+
+    def setSeed(self, value: int):
+        return self._set(seed=value)
+
+    def setParallelism(self, value: int):
+        return self._set(parallelism=value)
+
+
+class CrossValidator(_ValidatorParams):
+    """K-fold cross validation over a param grid.
+
+    >>> cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=ev)
+    >>> cv_model = cv.fit(df)
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        for name in ("estimator", "estimatorParamMaps", "evaluator"):
+            if name in kwargs:
+                getattr(self, f"set{name[0].upper()}{name[1:]}")(kwargs.pop(name))
+        self._set(**kwargs)
+
+    def _kfold_indices(self, n: int, pdf) -> List[Tuple[np.ndarray, np.ndarray]]:
+        num_folds = self.getNumFolds()
+        fold_col = self.getOrDefault("foldCol")
+        if fold_col:
+            fold_ids = pdf[fold_col].to_numpy(dtype=int)
+            if (fold_ids < 0).any() or (fold_ids >= num_folds).any():
+                raise ValueError(f"foldCol values must be in [0, {num_folds})")
+        else:
+            # balanced permutation split: every fold is guaranteed non-empty
+            # for n >= numFolds (a uniform random draw is not)
+            if n < num_folds:
+                raise ValueError(f"dataset has {n} rows but numFolds={num_folds}")
+            rng = np.random.default_rng(self.getOrDefault("seed"))
+            fold_ids = rng.permutation(n) % num_folds
+        out = []
+        for f in range(num_folds):
+            mask = fold_ids == f
+            train_idx, valid_idx = np.nonzero(~mask)[0], np.nonzero(mask)[0]
+            if len(train_idx) == 0 or len(valid_idx) == 0:
+                raise ValueError(f"fold {f} is empty; check foldCol values")
+            out.append((train_idx, valid_idx))
+        return out
+
+    def fit(self, dataset: Any) -> "CrossValidatorModel":
+        from .data import as_pandas
+
+        est = self.getEstimator()
+        epm = self.getEstimatorParamMaps()
+        eva = self.getEvaluator()
+        if est is None or epm is None or eva is None:
+            raise ValueError("estimator, estimatorParamMaps and evaluator must all be set")
+        logger = get_logger(type(self))
+
+        pdf = as_pandas(dataset)
+        n = len(pdf)
+        folds = self._kfold_indices(n, pdf)
+        num_models = len(epm)
+        metrics = np.zeros((len(folds), num_models))
+        accelerated = isinstance(est, _TpuEstimator) and est._supportsTransformEvaluate(eva)
+        logger.info(
+            "CrossValidator: %d folds x %d param maps (%s path)",
+            len(folds), num_models, "fused single-pass" if accelerated else "fallback per-model",
+        )
+
+        collect_sub = bool(self.getOrDefault("collectSubModels"))
+        sub_models: Optional[List[List[Any]]] = [None] * len(folds) if collect_sub else None
+
+        def run_fold(fold_i: int) -> np.ndarray:
+            train_idx, valid_idx = folds[fold_i]
+            train = pdf.iloc[train_idx].reset_index(drop=True)
+            valid = pdf.iloc[valid_idx].reset_index(drop=True)
+            if accelerated:
+                # ONE fit pass for all param maps, ONE eval pass for all models
+                models = [m for _, m in sorted(est.fitMultiple(train, epm))]
+                if collect_sub:
+                    sub_models[fold_i] = models
+                combined = models[0]._combine(models)
+                return np.asarray(combined._transform_evaluate(valid, eva))
+            scores = []
+            fold_models = []
+            for pm in epm:
+                model = est.copy(pm).fit(train)
+                fold_models.append(model)
+                scores.append(eva.evaluate(model.transform(valid)))
+            if collect_sub:
+                sub_models[fold_i] = fold_models
+            return np.asarray(scores)
+
+        parallelism = min(self.getOrDefault("parallelism"), len(folds))
+        if parallelism > 1:
+            with ThreadPool(parallelism) as pool:
+                for i, scores in enumerate(pool.map(run_fold, range(len(folds)))):
+                    metrics[i] = scores
+        else:
+            for i in range(len(folds)):
+                metrics[i] = run_fold(i)
+
+        avg = metrics.mean(axis=0)
+        std = metrics.std(axis=0)
+        best_idx = int(np.argmax(avg) if eva.isLargerBetter() else np.argmin(avg))
+        logger.info("CrossValidator: best param map %d (avg metric %.6f)", best_idx, avg[best_idx])
+        best_model = est.copy(epm[best_idx]).fit(pdf)
+        return CrossValidatorModel(
+            bestModel=best_model, avgMetrics=list(avg), stdMetrics=list(std), subModels=sub_models
+        )
+
+
+class CrossValidatorModel(Params):
+    def __init__(self, bestModel=None, avgMetrics=None, stdMetrics=None, subModels=None) -> None:
+        super().__init__()
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics or []
+        self.stdMetrics = stdMetrics or []
+        self.subModels = subModels
+
+    def transform(self, dataset: Any):
+        return self.bestModel.transform(dataset)

@@ -1,0 +1,205 @@
+#
+# Metrics / evaluators / CrossValidator tests (reference tests/test_tuning.py +
+# metrics assertions inside test_logistic_regression.py pattern).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.metrics import MulticlassMetrics, RegressionMetrics, _SummarizerBuffer
+from spark_rapids_ml_tpu.models.regression import LinearRegression
+from spark_rapids_ml_tpu.tuning import CrossValidator, CrossValidatorModel, ParamGridBuilder
+
+
+def test_regression_metrics_vs_sklearn(rng):
+    from sklearn.metrics import mean_absolute_error, mean_squared_error, r2_score
+
+    y = rng.normal(size=200)
+    p = y + 0.3 * rng.normal(size=200)
+    m = RegressionMetrics.from_values(y, p)
+    np.testing.assert_allclose(m.mean_squared_error(), mean_squared_error(y, p), rtol=1e-10)
+    np.testing.assert_allclose(m.mean_absolute_error(), mean_absolute_error(y, p), rtol=1e-10)
+    np.testing.assert_allclose(m.r2(), r2_score(y, p), rtol=1e-10)
+
+
+def test_summarizer_buffer_merge_equals_whole(rng):
+    y = rng.normal(size=300)
+    p = y + 0.1 * rng.normal(size=300)
+    whole = RegressionMetrics.from_values(y, p)
+    parts = [
+        RegressionMetrics.from_values(y[i : i + 100], p[i : i + 100]) for i in (0, 100, 200)
+    ]
+    merged = RegressionMetrics.merge_all(parts)
+    np.testing.assert_allclose(merged.mean_squared_error(), whole.mean_squared_error(), rtol=1e-12)
+    np.testing.assert_allclose(merged.r2(), whole.r2(), rtol=1e-12)
+    np.testing.assert_allclose(merged.mean_absolute_error(), whole.mean_absolute_error(), rtol=1e-12)
+
+
+def test_multiclass_metrics_vs_sklearn(rng):
+    from sklearn.metrics import accuracy_score, f1_score, precision_score, recall_score
+
+    y = rng.integers(0, 3, size=500).astype(float)
+    p = np.where(rng.uniform(size=500) < 0.8, y, rng.integers(0, 3, size=500)).astype(float)
+    confusion = {}
+    for a, b in zip(y, p):
+        confusion[(a, b)] = confusion.get((a, b), 0.0) + 1.0
+    m = MulticlassMetrics.from_confusion(confusion)
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    np.testing.assert_allclose(m.evaluate(ev), accuracy_score(y, p), rtol=1e-12)
+    ev.setMetricName("f1")
+    np.testing.assert_allclose(m.evaluate(ev), f1_score(y, p, average="weighted"), rtol=1e-10)
+    ev.setMetricName("weightedPrecision")
+    np.testing.assert_allclose(m.evaluate(ev), precision_score(y, p, average="weighted"), rtol=1e-10)
+    ev.setMetricName("weightedRecall")
+    np.testing.assert_allclose(m.evaluate(ev), recall_score(y, p, average="weighted"), rtol=1e-10)
+
+
+def test_binary_evaluator_auc_vs_sklearn(rng):
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    y = rng.integers(0, 2, size=400).astype(float)
+    score = y + rng.normal(scale=0.8, size=400)
+    df = pd.DataFrame({"label": y, "rawPrediction": score})
+    ev = BinaryClassificationEvaluator()
+    np.testing.assert_allclose(ev.evaluate(df), roc_auc_score(y, score), atol=1e-9)
+    ev.setMetricName("areaUnderPR")
+    np.testing.assert_allclose(ev.evaluate(df), average_precision_score(y, score), atol=5e-3)
+
+
+def test_param_grid_builder():
+    lr = LinearRegression()
+    grid = (
+        ParamGridBuilder()
+        .addGrid(lr.getParam("regParam"), [0.0, 0.1])
+        .addGrid(lr.getParam("elasticNetParam"), [0.0, 0.5, 1.0])
+        .build()
+    )
+    assert len(grid) == 6
+    assert all(len(g) == 2 for g in grid)
+
+
+def _cv_data(rng, n=400, d=5):
+    x = rng.normal(size=(n, d))
+    coef = np.array([1.0, -2.0, 0.0, 0.0, 3.0])
+    y = x @ coef + 0.5 + 0.2 * rng.normal(size=n)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+def test_cross_validator_fused_path(rng):
+    df = _cv_data(rng)
+    lr = LinearRegression(standardization=False, float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.5, 10.0]).build()
+    ev = RegressionEvaluator(metricName="rmse")
+    assert lr._supportsTransformEvaluate(ev)
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=ev, numFolds=3, seed=42)
+    cv_model = cv.fit(df)
+    assert isinstance(cv_model, CrossValidatorModel)
+    assert len(cv_model.avgMetrics) == 3
+    # tiny regularization best for well-conditioned data
+    assert int(np.argmin(cv_model.avgMetrics)) == 0
+    out = cv_model.transform(df)
+    assert "prediction" in out.columns
+
+
+def test_cross_validator_matches_manual_scores(rng):
+    # fused path must equal the naive per-model loop
+    df = _cv_data(rng, n=200)
+    lr = LinearRegression(standardization=False, float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 1.0]).build()
+    ev = RegressionEvaluator(metricName="r2")
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=ev, numFolds=2, seed=7)
+    fused = cv.fit(df).avgMetrics
+
+    # manual loop with identical folds
+    folds = cv._kfold_indices(len(df), df)
+    manual = np.zeros(2)
+    for train_idx, valid_idx in folds:
+        train, valid = df.iloc[train_idx], df.iloc[valid_idx]
+        for j, pm in enumerate(grid):
+            model = lr.copy(pm).fit(train)
+            manual[j] += ev.evaluate(model.transform(valid))
+    manual /= len(folds)
+    np.testing.assert_allclose(fused, manual, rtol=1e-8)
+
+
+def test_cross_validator_parallel(rng):
+    df = _cv_data(rng, n=150)
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.1]).build()
+    ev = RegressionEvaluator()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid, evaluator=ev, numFolds=3, parallelism=3
+    )
+    assert len(cv.fit(df).avgMetrics) == 2
+
+
+def test_cross_validator_fold_col(rng):
+    df = _cv_data(rng, n=90)
+    df["my_fold"] = np.arange(90) % 3
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid, evaluator=RegressionEvaluator(), numFolds=3,
+        foldCol="my_fold",
+    )
+    assert len(cv.fit(df).avgMetrics) == 1
+
+
+def test_binary_auc_ties_and_order_invariance(rng):
+    # constant scores must give AUC 0.5 regardless of row order
+    y = np.array([1.0, 1, 1, 0, 0, 0])
+    df = pd.DataFrame({"label": y, "rawPrediction": np.zeros(6)})
+    ev = BinaryClassificationEvaluator(numBins=0)
+    np.testing.assert_allclose(ev.evaluate(df), 0.5, atol=1e-12)
+    df2 = df.iloc[::-1].reset_index(drop=True)
+    np.testing.assert_allclose(ev.evaluate(df2), 0.5, atol=1e-12)
+    # tied groups vs sklearn
+    from sklearn.metrics import roc_auc_score
+    yy = rng.integers(0, 2, size=200).astype(float)
+    ss = np.round(yy + rng.normal(scale=0.8, size=200), 1)  # heavy ties
+    d3 = pd.DataFrame({"label": yy, "rawPrediction": ss})
+    np.testing.assert_allclose(ev.evaluate(d3), roc_auc_score(yy, ss), atol=1e-10)
+
+
+def test_cv_small_dataset_folds_nonempty(rng):
+    df = _cv_data(rng, n=7)
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0]).build()
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=RegressionEvaluator(), numFolds=3, seed=0)
+    m = cv.fit(df)  # must not crash on any seed: folds are balanced
+    assert np.isfinite(m.avgMetrics[0])
+
+
+def test_cv_collect_sub_models(rng):
+    df = _cv_data(rng, n=60)
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.1]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid, evaluator=RegressionEvaluator(),
+        numFolds=2, collectSubModels=True,
+    )
+    m = cv.fit(df)
+    assert m.subModels is not None and len(m.subModels) == 2
+    assert all(len(fold_models) == 2 for fold_models in m.subModels)
+
+
+def test_fused_path_respects_evaluator_label_col(rng):
+    df = _cv_data(rng, n=100).rename(columns={"label": "target"})
+    lr = LinearRegression(float32_inputs=False, labelCol="target").setFeaturesCol("features")
+    ev = RegressionEvaluator(metricName="rmse").setLabelCol("target")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0]).build()
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=ev, numFolds=2)
+    assert np.isfinite(cv.fit(df).avgMetrics[0])
+
+
+def test_weighted_evaluator_takes_fallback(rng):
+    lr = LinearRegression()
+    ev = RegressionEvaluator(metricName="rmse")
+    assert lr._supportsTransformEvaluate(ev)
+    ev2 = RegressionEvaluator(metricName="rmse", weightCol="w")
+    assert not lr._supportsTransformEvaluate(ev2)
