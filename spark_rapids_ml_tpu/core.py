@@ -376,6 +376,9 @@ class _TpuModelWithColumns(_TpuModel):
         return [self.getOrDefault("outputCol") if self.hasParam("outputCol") and self.isDefined("outputCol") else pred.prediction]
 
     def _transform_arrays(self, features: Any) -> Any:
+        """Batched predict over a host feature block. The per-algo `predict` may
+        return one array or a tuple of arrays (multi-output models); each output
+        is concatenated across batches."""
         from .parallel.mesh import dtype_scope
 
         with dtype_scope(np.float32 if self._float32_inputs else np.float64):
@@ -383,15 +386,21 @@ class _TpuModelWithColumns(_TpuModel):
             state = construct()
             n = features.shape[0]
             batch = int(config["max_records_per_batch"])
-            outs = []
+            outs: List[Any] = []
             for start in range(0, n, batch):
                 stop = min(start + batch, n)
                 xb = features[start:stop]
                 if hasattr(xb, "todense"):
                     xb = np.asarray(xb.todense())
-                outs.append(np.asarray(predict(state, xb)))
+                result = predict(state, xb)
+                if isinstance(result, tuple):
+                    outs.append(tuple(np.asarray(r) for r in result))
+                else:
+                    outs.append(np.asarray(result))
             if not outs:
                 return np.zeros((0,), dtype=np.float64)
+            if isinstance(outs[0], tuple):
+                return tuple(np.concatenate(parts, axis=0) for parts in zip(*outs))
             return np.concatenate(outs, axis=0)
 
     def transform(self, dataset: Any):

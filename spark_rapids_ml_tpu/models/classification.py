@@ -1,0 +1,445 @@
+#
+# Classification algorithms: LogisticRegression (RandomForestClassifier joins
+# this module when the tree family lands — reference classification.py hosts
+# both).
+#
+# API-parity target: reference classification.py:665-1581, drop-in for
+# `pyspark.ml.classification.LogisticRegression`: binomial + multinomial,
+# standardization, intercept centering, single-class degenerate handling,
+# rawPrediction/probability/prediction output columns, threshold(s).
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithColumns, pred
+from ..data import ExtractedData, as_pandas, vectors_to_pandas_column
+from ..params import (
+    HasElasticNetParam,
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasFitIntercept,
+    HasLabelCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasRegParam,
+    HasStandardization,
+    HasTol,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+)
+
+
+class _LogisticRegressionParams(
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasMaxIter,
+    HasTol,
+    HasRegParam,
+    HasElasticNetParam,
+    HasFitIntercept,
+    HasStandardization,
+    HasWeightCol,
+):
+    family = Param("family", "label distribution: 'auto', 'binomial' or 'multinomial'", TypeConverters.toString)
+    threshold = Param("threshold", "binary prediction threshold in [0, 1]", TypeConverters.toFloat)
+    thresholds = Param(
+        "thresholds",
+        "multiclass thresholds: predict argmax(p/threshold)",
+        TypeConverters.toListFloat,
+    )
+
+    def getFamily(self) -> str:
+        return self.getOrDefault("family")
+
+    def getThreshold(self) -> float:
+        return self.getOrDefault("threshold")
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # mirrors reference classification.py param mapping for LogisticRegression
+        return {
+            "maxIter": "max_iter",
+            "regParam": "alpha",
+            "elasticNetParam": "l1_ratio",
+            "tol": "tol",
+            "fitIntercept": "fit_intercept",
+            "standardization": "standardization",
+            "family": "",  # resolved from the label cardinality at fit time
+            "threshold": "",
+            "thresholds": "",
+            "weightCol": "",
+        }
+
+    def _get_solver_params_default(self) -> Dict[str, Any]:
+        return {
+            "alpha": 0.0,
+            "l1_ratio": 0.0,
+            "max_iter": 100,
+            "tol": 1e-6,
+            "fit_intercept": True,
+            "standardization": True,
+            "lbfgs_memory": 10,  # reference parity: lbfgs_memory=10 (classification.py:1056-1057)
+            "verbose": False,
+        }
+
+
+class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
+    """LogisticRegression estimator, drop-in for
+    ``pyspark.ml.classification.LogisticRegression``.
+
+    Distributed L-BFGS where every objective/gradient evaluation is one fused
+    MXU matmul + psum over the rows mesh; standardization statistics are
+    computed in-graph and folded into the coefficients (no standardized copy of
+    the data) — the TPU-native form of the reference's CuPy pre-standardization
+    + `LogisticRegressionMG` path (classification.py:984-1089).
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            maxIter=100, regParam=0.0, elasticNetParam=0.0, tol=1e-6, fitIntercept=True,
+            standardization=True, family="auto", threshold=0.5,
+        )
+        self._set_params(**kwargs)
+
+    def _set_params(self, **kwargs):
+        if "family" in kwargs and kwargs["family"] not in ("auto", "binomial", "multinomial"):
+            raise ValueError(
+                f"family must be 'auto', 'binomial' or 'multinomial', got {kwargs['family']!r}"
+            )
+        return super()._set_params(**kwargs)
+
+    def setMaxIter(self, value: int) -> "LogisticRegression":
+        return self._set_params(maxIter=value)
+
+    def setRegParam(self, value: float) -> "LogisticRegression":
+        return self._set_params(regParam=value)
+
+    def setElasticNetParam(self, value: float) -> "LogisticRegression":
+        return self._set_params(elasticNetParam=value)
+
+    def setTol(self, value: float) -> "LogisticRegression":
+        return self._set_params(tol=value)
+
+    def setFitIntercept(self, value: bool) -> "LogisticRegression":
+        return self._set_params(fitIntercept=value)
+
+    def setStandardization(self, value: bool) -> "LogisticRegression":
+        return self._set_params(standardization=value)
+
+    def setFamily(self, value: str) -> "LogisticRegression":
+        return self._set_params(family=value)
+
+    def setThreshold(self, value: float) -> "LogisticRegression":
+        return self._set_params(threshold=value)
+
+    def setThresholds(self, value: List[float]) -> "LogisticRegression":
+        return self._set_params(thresholds=value)
+
+    def setFeaturesCol(self, value) -> "LogisticRegression":
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setLabelCol(self, value: str) -> "LogisticRegression":
+        return self._set_params(labelCol=value)
+
+    def setPredictionCol(self, value: str) -> "LogisticRegression":
+        return self._set_params(predictionCol=value)
+
+    def setProbabilityCol(self, value: str) -> "LogisticRegression":
+        return self._set_params(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str) -> "LogisticRegression":
+        return self._set_params(rawPredictionCol=value)
+
+    def setWeightCol(self, value: str) -> "LogisticRegression":
+        return self._set_params(weightCol=value)
+
+    def _get_tpu_fit_func(self, extracted: ExtractedData):
+        from ..ops.logistic import logistic_fit
+
+        labels_host = extracted.label
+        family = self.getOrDefault("family")
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            alpha = float(params["alpha"])
+            l1_ratio = float(params["l1_ratio"])
+            if alpha > 0 and l1_ratio > 0:
+                raise ValueError(
+                    "L1/ElasticNet logistic regression is not supported yet; "
+                    "set elasticNetParam=0.0"
+                )
+            classes = np.unique(labels_host).astype(np.float64)
+            k = len(classes)
+            if k == 1:
+                # degenerate single-class fit: P(class)=1 (Spark parity,
+                # reference classification.py:1122-1135)
+                return {
+                    "coef_": np.zeros((1, inputs.n_cols)),
+                    "intercept_": np.array([np.inf if classes[0] == 1.0 else -np.inf]),
+                    "classes_": classes,
+                    "n_iter_": 0,
+                    "objective_": 0.0,
+                    "n_cols": inputs.n_cols,
+                    "dtype": np.dtype(inputs.dtype).name,
+                }
+            multinomial = family == "multinomial" or (family == "auto" and k > 2)
+            if family == "binomial" and k > 2:
+                raise ValueError(f"family='binomial' but found {k} classes")
+            y_idx_host = np.searchsorted(classes, labels_host).astype(np.int32)
+
+            from ..parallel import make_global_rows
+
+            y_idx, _, _ = make_global_rows(inputs.mesh, y_idx_host)
+            state = logistic_fit(
+                inputs.X,
+                y_idx,
+                inputs.w,
+                k=k,
+                multinomial=multinomial,
+                lam_l2=alpha * (1.0 - l1_ratio),
+                fit_intercept=bool(params["fit_intercept"]),
+                standardize=bool(params["standardization"]),
+                max_iter=int(params["max_iter"]),
+                tol=float(params["tol"]),
+                lbfgs_memory=int(params["lbfgs_memory"]),
+            )
+            return {
+                "coef_": np.asarray(state["coef_"], dtype=np.float64),
+                "intercept_": np.asarray(state["intercept_"], dtype=np.float64),
+                "classes_": classes,
+                "n_iter_": int(state["n_iter_"]),
+                "objective_": float(state["objective_"]),
+                "n_cols": inputs.n_cols,
+                "dtype": np.dtype(inputs.dtype).name,
+            }
+
+        return _fit
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "LogisticRegressionModel":
+        return LogisticRegressionModel(**attrs)
+
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        if not hasattr(evaluator, "getMetricName"):
+            return False
+        from ..metrics import MulticlassMetrics
+
+        if evaluator.getMetricName() not in MulticlassMetrics.SUPPORTED_MULTI_CLASS_METRIC_NAMES:
+            return False
+        if evaluator.hasParam("weightCol") and evaluator.isDefined("weightCol"):
+            return False
+        return True
+
+
+class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
+    """Fitted logistic regression model (reference classification.py:1159-1581)."""
+
+    def __init__(
+        self,
+        coef_: Optional[np.ndarray] = None,
+        intercept_: Optional[np.ndarray] = None,
+        classes_: Optional[np.ndarray] = None,
+        n_iter_: int = 0,
+        objective_: float = 0.0,
+        n_cols: int = 0,
+        dtype: str = "float32",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            coef_=coef_, intercept_=intercept_, classes_=classes_, n_iter_=n_iter_,
+            objective_=objective_, n_cols=n_cols, dtype=dtype,
+        )
+        self.coef_ = np.atleast_2d(np.asarray(coef_))
+        self.intercept_ = np.atleast_1d(np.asarray(intercept_))
+        self.classes_ = np.asarray(classes_)
+        self.n_iter_ = int(n_iter_)
+        self.objective_ = float(objective_)
+        self.n_cols = int(n_cols)
+        self.dtype = dtype
+
+    # -- Spark ML model surface -------------------------------------------
+    @property
+    def numClasses(self) -> int:
+        return len(self.classes_)
+
+    @property
+    def numFeatures(self) -> int:
+        return self.n_cols
+
+    @property
+    def _is_multinomial(self) -> bool:
+        return self.coef_.shape[0] > 1
+
+    @property
+    def coefficients(self):
+        from ..linalg import DenseVector
+
+        if self._is_multinomial:
+            raise Exception(
+                "Multinomial models contain a matrix of coefficients, use coefficientMatrix instead."
+            )
+        return DenseVector(self.coef_[0])
+
+    @property
+    def intercept(self) -> float:
+        if self._is_multinomial:
+            raise Exception(
+                "Multinomial models contain a vector of intercepts, use interceptVector instead."
+            )
+        return float(self.intercept_[0])
+
+    @property
+    def coefficientMatrix(self) -> np.ndarray:
+        return self.coef_
+
+    @property
+    def interceptVector(self):
+        from ..linalg import DenseVector
+
+        return DenseVector(self.intercept_)
+
+    def setFeaturesCol(self, value) -> "LogisticRegressionModel":
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setThreshold(self, value: float) -> "LogisticRegressionModel":
+        return self._set_params(threshold=value)
+
+    def setProbabilityCol(self, value: str) -> "LogisticRegressionModel":
+        return self._set_params(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str) -> "LogisticRegressionModel":
+        return self._set_params(rawPredictionCol=value)
+
+    def setPredictionCol(self, value: str) -> "LogisticRegressionModel":
+        return self._set_params(predictionCol=value)
+
+    # -- prediction machinery ---------------------------------------------
+    def _get_transform_func(self):
+        import jax
+
+        from ..ops.logistic import logistic_predict
+        from ..parallel.mesh import default_devices
+
+        coef_np, intercept_np = self.coef_, self.intercept_
+        multinomial = self._is_multinomial
+        dtype = np.float32 if self._float32_inputs else np.float64
+
+        def construct():
+            dev = default_devices()[0]
+            return (
+                jax.device_put(coef_np.astype(dtype), dev),
+                jax.device_put(intercept_np.astype(dtype), dev),
+            )
+
+        def predict(state, xb):
+            coef, b = state
+            return logistic_predict(xb.astype(dtype), coef, b, multinomial=multinomial)
+
+        return construct, predict, None
+
+    def _raw_prob(self, features) -> tuple:
+        """Batched (raw, prob) arrays for a host feature block."""
+        if np.isinf(self.intercept_).any():
+            # degenerate single-class model
+            n = features.shape[0]
+            return np.tile(self.intercept_, (n, 1)), np.ones((n, 1))
+        raw, prob = self._transform_arrays(features)
+        return raw.astype(np.float64), prob.astype(np.float64)
+
+    def _predict_from_prob(self, prob: np.ndarray) -> np.ndarray:
+        if self.numClasses == 1:
+            return np.full(prob.shape[0], float(self.classes_[0]))
+        if self.isDefined("thresholds"):
+            t = np.asarray(self.getOrDefault("thresholds"))
+            idx = np.argmax(prob / t[None, :], axis=1)
+        elif not self._is_multinomial and self.numClasses == 2:
+            idx = (prob[:, 1] > self.getThreshold()).astype(int)
+        else:
+            idx = np.argmax(prob, axis=1)
+        return self.classes_[idx].astype(np.float64)
+
+    def transform(self, dataset: Any):
+        pdf = as_pandas(dataset)
+        extracted = self._pre_process_data(dataset, for_fit=False)
+        raw, prob = self._raw_prob(extracted.features)
+        out = pdf.copy(deep=False)
+        as_vec = extracted.feature_kind == "vector"
+        raw_col = vectors_to_pandas_column(raw) if as_vec else list(raw)
+        prob_col = vectors_to_pandas_column(prob) if as_vec else list(prob)
+        out[self.getOrDefault("rawPredictionCol")] = raw_col
+        out[self.getOrDefault("probabilityCol")] = prob_col
+        out[self.getOrDefault("predictionCol")] = self._predict_from_prob(prob)
+        return out
+
+    def predict(self, value) -> float:
+        """Single-vector predict (Spark ML model surface)."""
+        from ..linalg import Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        _, prob = self._raw_prob(v[None, :])
+        return float(self._predict_from_prob(prob)[0])
+
+    def predictProbability(self, value):
+        from ..linalg import DenseVector, Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        _, prob = self._raw_prob(v[None, :])
+        return DenseVector(prob[0])
+
+    # -- fused CV path ------------------------------------------------------
+    def _combine(self, models: List["LogisticRegressionModel"]) -> "LogisticRegressionModel":
+        combined = LogisticRegressionModel(
+            coef_=self.coef_, intercept_=self.intercept_, classes_=self.classes_,
+            n_iter_=self.n_iter_, objective_=self.objective_, n_cols=self.n_cols, dtype=self.dtype,
+        )
+        combined._sub_models = list(models)
+        self._copyValues(combined)
+        self._copy_solver_params(combined)
+        return combined
+
+    def _transform_evaluate(self, dataset: Any, evaluator: Any) -> List[float]:
+        """Score ALL packed models in one pass over the data."""
+        from ..metrics import MulticlassMetrics
+
+        assert hasattr(self, "_sub_models"), "call _combine first"
+        label_col = (
+            evaluator.getOrDefault("labelCol")
+            if hasattr(evaluator, "hasParam") and evaluator.hasParam("labelCol")
+            else self.getOrDefault("labelCol")
+        )
+        pdf = as_pandas(dataset)
+        label = pdf[label_col].to_numpy(dtype=np.float64)
+        extracted = self._pre_process_data(dataset, for_fit=False)
+        want_logloss = evaluator.getMetricName() == "logLoss"
+        eps = evaluator.getOrDefault("eps") if evaluator.hasParam("eps") else 1e-15
+        scores = []
+        for m in self._sub_models:
+            _, prob = m._raw_prob(extracted.features)
+            prediction = m._predict_from_prob(prob)
+            pairs = np.stack([label, prediction], axis=1)
+            uniq, inverse = np.unique(pairs, axis=0, return_inverse=True)
+            counts = np.bincount(inverse, minlength=len(uniq)).astype(np.float64)
+            confusion = {
+                (float(uniq[i, 0]), float(uniq[i, 1])): float(counts[i]) for i in range(len(uniq))
+            }
+            log_loss = None
+            if want_logloss:
+                # exact class membership: labels unseen by this fold's model get
+                # probability eps (the model assigns them ~0 mass)
+                cls_idx = np.searchsorted(m.classes_, label)
+                cls_idx_safe = np.clip(cls_idx, 0, len(m.classes_) - 1)
+                known = m.classes_[cls_idx_safe] == label
+                p_raw = prob[np.arange(len(label)), cls_idx_safe]
+                p_true = np.clip(np.where(known, p_raw, 0.0), eps, 1 - eps)
+                log_loss = float(np.sum(-np.log(p_true)))
+            scores.append(MulticlassMetrics.from_confusion(confusion, log_loss).evaluate(evaluator))
+        return scores
