@@ -35,6 +35,89 @@ from ..params import (
 )
 
 
+from .tree import _RandomForestEstimator, _RandomForestModel
+
+
+class RandomForestClassifier(HasProbabilityCol, HasRawPredictionCol, _RandomForestEstimator):
+    """RandomForestClassifier, drop-in for
+    ``pyspark.ml.classification.RandomForestClassifier``.
+
+    Ensemble-split fit (reference tree.py:270-281 strategy): each mesh device
+    grows its share of the forest on its row shard with level-wise histogram
+    tree building (ops/trees.py); tree arrays are gathered at the end (the
+    Treelite-concat analog). Impurity: gini (default) or entropy.
+    """
+
+    _is_classification = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._setDefault(impurity="gini")
+        if self._solver_params.get("split_criterion") is None:
+            self._solver_params["split_criterion"] = "gini"
+
+    def _set_params(self, **kwargs):
+        if "impurity" in kwargs and kwargs["impurity"] not in ("gini", "entropy"):
+            raise ValueError("impurity must be 'gini' or 'entropy' for classification")
+        return super()._set_params(**kwargs)
+
+    def setProbabilityCol(self, value: str) -> "RandomForestClassifier":
+        return self._set_params(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str) -> "RandomForestClassifier":
+        return self._set_params(rawPredictionCol=value)
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "RandomForestClassificationModel":
+        return RandomForestClassificationModel(**attrs)
+
+
+class RandomForestClassificationModel(HasProbabilityCol, HasRawPredictionCol, _RandomForestModel):
+    """Fitted RF classification model (reference classification.py:302-662)."""
+
+    _is_classification = True
+
+    @property
+    def numClasses(self) -> int:
+        return len(self.classes_)
+
+    def _leaf_values(self) -> np.ndarray:
+        # normalized per-node class distribution (Spark averages leaf distributions)
+        totals = self.node_stats.sum(axis=2, keepdims=True)
+        return self.node_stats / np.maximum(totals, 1e-30)
+
+    def setProbabilityCol(self, value: str) -> "RandomForestClassificationModel":
+        return self._set_params(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str) -> "RandomForestClassificationModel":
+        return self._set_params(rawPredictionCol=value)
+
+    def _out_column_names(self) -> List[str]:
+        return [
+            self.getOrDefault("rawPredictionCol"),
+            self.getOrDefault("probabilityCol"),
+            self.getOrDefault("predictionCol"),
+        ]
+
+    def _split_output(self, result, names, extracted) -> Dict[str, Any]:
+        mean_dist = np.asarray(result, dtype=np.float64)
+        prob = mean_dist / np.maximum(mean_dist.sum(axis=1, keepdims=True), 1e-30)
+        raw = mean_dist * self.num_trees  # Spark raw = summed tree votes
+        prediction = self.classes_[np.argmax(prob, axis=1)].astype(np.float64)
+        as_vec = extracted.feature_kind == "vector"
+        return {
+            names[0]: vectors_to_pandas_column(raw) if as_vec else list(raw),
+            names[1]: vectors_to_pandas_column(prob) if as_vec else list(prob),
+            names[2]: prediction,
+        }
+
+    def predict(self, value) -> float:
+        from ..linalg import Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        dist = np.asarray(self._raw_forest_output(v[None, :]), dtype=np.float64)[0]
+        return float(self.classes_[int(np.argmax(dist))])
+
+
 class _LogisticRegressionParams(
     HasFeaturesCol,
     HasFeaturesCols,

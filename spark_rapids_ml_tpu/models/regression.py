@@ -32,6 +32,56 @@ from ..params import (
 )
 
 
+from .tree import _RandomForestEstimator, _RandomForestModel
+
+
+class RandomForestRegressor(_RandomForestEstimator):
+    """RandomForestRegressor, drop-in for
+    ``pyspark.ml.regression.RandomForestRegressor`` (reference
+    regression.py:799-1080). Variance split criterion; ensemble split across
+    the mesh like the classifier."""
+
+    _is_classification = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._setDefault(impurity="variance")
+        if self._solver_params.get("split_criterion") is None:
+            self._solver_params["split_criterion"] = "variance"
+
+    def _set_params(self, **kwargs):
+        if "impurity" in kwargs and kwargs["impurity"] != "variance":
+            raise ValueError("impurity must be 'variance' for regression")
+        return super()._set_params(**kwargs)
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "RandomForestRegressionModel":
+        return RandomForestRegressionModel(**attrs)
+
+
+class RandomForestRegressionModel(_RandomForestModel):
+    """Fitted RF regression model."""
+
+    _is_classification = False
+
+    def _leaf_values(self) -> np.ndarray:
+        # node mean: Σwy / Σw, kept as [M, 1]
+        w = self.node_stats[..., 0]
+        wy = self.node_stats[..., 1]
+        return (wy / np.maximum(w, 1e-30))[..., None]
+
+    def _out_column_names(self) -> List[str]:
+        return [self.getOrDefault("predictionCol")]
+
+    def _split_output(self, result, names, extracted):
+        return {names[0]: np.asarray(result)[:, 0]}
+
+    def predict(self, value) -> float:
+        from ..linalg import Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        return float(np.asarray(self._raw_forest_output(v[None, :]))[0, 0])
+
+
 class _LinearRegressionParams(
     HasFeaturesCol,
     HasFeaturesCols,

@@ -1,0 +1,332 @@
+#
+# Shared random-forest machinery (reference tree.py, 636 LoC): params common to
+# classifier/regressor, the ensemble-split fit orchestration, and the
+# array-forest model base. Subclasses live in classification.py/regression.py,
+# mirroring the reference layout.
+#
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithColumns
+from ..data import ExtractedData
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasSeed,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+)
+
+
+def resolve_max_features(strategy: str, d: int, is_classification: bool) -> int:
+    """featureSubsetStrategy -> number of features per split (Spark semantics)."""
+    s = str(strategy).lower()
+    if s == "auto":
+        return max(1, int(math.sqrt(d))) if is_classification else max(1, d // 3)
+    if s == "all":
+        return d
+    if s == "sqrt":
+        return max(1, int(math.sqrt(d)))
+    if s == "log2":
+        return max(1, int(math.log2(d)))
+    if s == "onethird":
+        return max(1, d // 3)
+    import re
+
+    # Spark's grammar: "^[1-9]\d*$" is a feature COUNT; "(0.0, 1.0]" decimals
+    # are a fraction — so "1.0" means ALL features, "1" means one feature
+    if re.fullmatch(r"[1-9]\d*", s):
+        return min(d, int(s))
+    try:
+        v = float(s)
+        if 0 < v <= 1:
+            return max(1, int(v * d))
+    except ValueError:
+        pass
+    raise ValueError(f"Unsupported featureSubsetStrategy: {strategy!r}")
+
+
+class _RandomForestParams(
+    HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasPredictionCol, HasSeed, HasWeightCol
+):
+    numTrees = Param("numTrees", "number of trees in the forest", TypeConverters.toInt)
+    maxDepth = Param("maxDepth", "maximum tree depth", TypeConverters.toInt)
+    maxBins = Param("maxBins", "maximum number of feature histogram bins", TypeConverters.toInt)
+    minInstancesPerNode = Param(
+        "minInstancesPerNode", "minimum number of instances each child must have", TypeConverters.toInt
+    )
+    minInfoGain = Param("minInfoGain", "minimum information gain for a split", TypeConverters.toFloat)
+    featureSubsetStrategy = Param(
+        "featureSubsetStrategy",
+        "number of features per split: auto|all|sqrt|log2|onethird|n|fraction",
+        TypeConverters.toString,
+    )
+    subsamplingRate = Param("subsamplingRate", "fraction of rows sampled per tree", TypeConverters.toFloat)
+    bootstrap = Param("bootstrap", "whether bootstrap samples are used", TypeConverters.toBoolean)
+    impurity = Param("impurity", "split criterion", TypeConverters.toString)
+    # accepted-and-ignored Spark knobs (reference maps these to "" the same way)
+    checkpointInterval = Param("checkpointInterval", "ignored", TypeConverters.toInt)
+    cacheNodeIds = Param("cacheNodeIds", "ignored", TypeConverters.toBoolean)
+    maxMemoryInMB = Param("maxMemoryInMB", "ignored", TypeConverters.toInt)
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # mirrors reference tree.py param mapping
+        return {
+            "numTrees": "n_estimators",
+            "maxDepth": "max_depth",
+            "maxBins": "n_bins",
+            "minInstancesPerNode": "min_samples_leaf",
+            "minInfoGain": "min_impurity_decrease",
+            "featureSubsetStrategy": "max_features",
+            "subsamplingRate": "max_samples",
+            "bootstrap": "bootstrap",
+            "impurity": "split_criterion",
+            "seed": "random_state",
+            "checkpointInterval": "",
+            "cacheNodeIds": "",
+            "maxMemoryInMB": "",
+            "weightCol": "",
+        }
+
+    def _get_solver_params_default(self) -> Dict[str, Any]:
+        return {
+            "n_estimators": 20,
+            "max_depth": 5,
+            "n_bins": 32,
+            "min_samples_leaf": 1,
+            "min_impurity_decrease": 0.0,
+            "max_features": "auto",
+            "max_samples": 1.0,
+            "bootstrap": True,
+            "split_criterion": None,  # set by subclass default
+            "random_state": 0,
+            "node_chunk": 256,
+            "verbose": False,
+        }
+
+    def getNumTrees(self) -> int:
+        return self.getOrDefault("numTrees")
+
+    def getMaxDepth(self) -> int:
+        return self.getOrDefault("maxDepth")
+
+
+class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
+    """Shared fit orchestration (reference tree.py:240-431)."""
+
+    _is_classification: bool = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            numTrees=20, maxDepth=5, maxBins=32, minInstancesPerNode=1, minInfoGain=0.0,
+            featureSubsetStrategy="auto", subsamplingRate=1.0, bootstrap=True, seed=0,
+        )
+        self._set_params(**kwargs)
+
+    # common setters (each subclass also exposes them through this base)
+    def setNumTrees(self, value: int):
+        return self._set_params(numTrees=value)
+
+    def setMaxDepth(self, value: int):
+        return self._set_params(maxDepth=value)
+
+    def setMaxBins(self, value: int):
+        return self._set_params(maxBins=value)
+
+    def setFeatureSubsetStrategy(self, value: str):
+        return self._set_params(featureSubsetStrategy=value)
+
+    def setImpurity(self, value: str):
+        return self._set_params(impurity=value)
+
+    def setSeed(self, value: int):
+        return self._set_params(seed=value)
+
+    def setFeaturesCol(self, value):
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setLabelCol(self, value: str):
+        return self._set_params(labelCol=value)
+
+    def setPredictionCol(self, value: str):
+        return self._set_params(predictionCol=value)
+
+    def _row_stats(self, labels: np.ndarray, classes: np.ndarray) -> np.ndarray:
+        """Per-row stat contributions: class one-hot (clf) or (1, y, y²) (reg)."""
+        if self._is_classification:
+            idx = np.searchsorted(classes, labels)
+            stats = np.zeros((len(labels), len(classes)), np.float32)
+            stats[np.arange(len(labels)), idx] = 1.0
+            return stats
+        y = labels.astype(np.float64)
+        return np.stack([np.ones_like(y), y, y * y], axis=1).astype(np.float32)
+
+    def _get_tpu_fit_func(self, extracted: ExtractedData):
+        from ..ops.trees import bin_features, forest_fit, quantile_bins, split_bins_to_thresholds
+        from ..parallel import make_global_rows
+
+        x_host = extracted.features
+        labels_host = extracted.label
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            d = inputs.n_cols
+            max_bins = int(params["n_bins"])
+            max_depth = int(params["max_depth"])
+            classes = (
+                np.unique(labels_host).astype(np.float64)
+                if self._is_classification
+                else np.zeros(0)
+            )
+            impurity = params["split_criterion"]
+            edges_host = quantile_bins(x_host, max_bins, seed=int(params["random_state"] or 0))
+            edges = edges_host.astype(np.float32)
+            stats_host = self._row_stats(labels_host, classes)
+
+            # bin the ALREADY device-resident features (inputs.X carries the
+            # user weights + padding zeros in inputs.w); user weights scale each
+            # row's histogram contribution and the bootstrap draw inside
+            # forest_fit multiplies on top
+            Xb_binned = bin_features(inputs.X, edges)
+            w = inputs.w
+            stats_global, _, _ = make_global_rows(inputs.mesh, stats_host)
+
+            state = forest_fit(
+                Xb_binned,
+                stats_global * w[:, None],
+                w,
+                int(params["random_state"] or 0),
+                mesh=inputs.mesh,
+                n_trees=int(params["n_estimators"]),
+                max_depth=max_depth,
+                max_bins=max_bins,
+                max_features=resolve_max_features(params["max_features"], d, self._is_classification),
+                impurity=impurity,
+                node_chunk=int(params["node_chunk"]),
+                bootstrap=bool(params["bootstrap"]),
+                subsample_rate=float(params["max_samples"]),
+                min_instances=float(params["min_samples_leaf"]),
+                min_info_gain=float(params["min_impurity_decrease"]),
+                n_stats=stats_host.shape[1],
+            )
+            n_trees = int(params["n_estimators"])
+            feature = np.asarray(state["feature"])[:n_trees]
+            split_bin = np.asarray(state["split_bin"])[:n_trees]
+            node_stats = np.asarray(state["node_stats"], dtype=np.float64)[:n_trees]
+            threshold = split_bins_to_thresholds(feature, split_bin, edges_host)
+            node_stats = _fill_empty_nodes(feature, node_stats)
+            return {
+                "feature": feature.astype(np.int32),
+                "threshold": threshold,
+                "node_stats": node_stats,
+                "classes_": classes,
+                "num_trees": n_trees,
+                "max_depth": max_depth,
+                "n_cols": d,
+                "dtype": np.dtype(inputs.dtype).name,
+            }
+
+        return _fit
+
+
+def _fill_empty_nodes(feature: np.ndarray, node_stats: np.ndarray) -> np.ndarray:
+    """Propagate parent stats into empty nodes so predict-time rows landing in a
+    training-empty branch fall back to the parent distribution."""
+    T, M, S = node_stats.shape
+    out = node_stats.copy()
+    for i in range(1, M):
+        parent = (i - 1) // 2
+        empty = out[:, i, :].sum(axis=1) == 0
+        out[empty, i, :] = out[empty, parent, :]
+    return out
+
+
+class _RandomForestModel(_RandomForestParams, _TpuModelWithColumns):
+    """Array-forest model base (reference tree.py:433-636)."""
+
+    _is_classification: bool = False
+
+    def __init__(
+        self,
+        feature: Optional[np.ndarray] = None,
+        threshold: Optional[np.ndarray] = None,
+        node_stats: Optional[np.ndarray] = None,
+        classes_: Optional[np.ndarray] = None,
+        num_trees: int = 0,
+        max_depth: int = 0,
+        n_cols: int = 0,
+        dtype: str = "float32",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            feature=feature, threshold=threshold, node_stats=node_stats, classes_=classes_,
+            num_trees=num_trees, max_depth=max_depth, n_cols=n_cols, dtype=dtype,
+        )
+        self.feature = np.asarray(feature)
+        self.threshold = np.asarray(threshold)
+        self.node_stats = np.asarray(node_stats)
+        self.classes_ = np.asarray(classes_)
+        self.num_trees = int(num_trees)
+        self.max_depth = int(max_depth)
+        self.n_cols = int(n_cols)
+        self.dtype = dtype
+
+    @property
+    def getNumTrees(self) -> int:  # Spark model exposes this as a property
+        return self.num_trees
+
+    @property
+    def numFeatures(self) -> int:
+        return self.n_cols
+
+    @property
+    def totalNumNodes(self) -> int:
+        return int(np.sum(self.feature >= 0) * 2 + self.num_trees)
+
+    def setFeaturesCol(self, value):
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setPredictionCol(self, value: str):
+        return self._set_params(predictionCol=value)
+
+    def _leaf_values(self) -> np.ndarray:
+        """Per-node output values fed to the traversal (subclass defines)."""
+        raise NotImplementedError
+
+    def _raw_forest_output(self, features) -> np.ndarray:
+        """Batched mean-of-leaf-values [n, S] through the shared batching."""
+        return self._transform_arrays(features)
+
+    def _get_transform_func(self):
+        import jax
+
+        from ..ops.trees import forest_raw_predict
+        from ..parallel.mesh import default_devices
+
+        feature = self.feature
+        threshold = self.threshold
+        leaves = self._leaf_values()
+        max_depth = self.max_depth
+        dtype = np.float32 if self._float32_inputs else np.float64
+
+        def construct():
+            dev = default_devices()[0]
+            return (
+                jax.device_put(feature, dev),
+                jax.device_put(threshold.astype(dtype), dev),
+                jax.device_put(leaves.astype(dtype), dev),
+            )
+
+        def predict(state, xb):
+            f, t, lv = state
+            return forest_raw_predict(xb.astype(dtype), f, t, lv, max_depth=max_depth)
+
+        return construct, predict, None

@@ -1,0 +1,330 @@
+#
+# Distributed random-forest solver — the in-tree replacement for
+# `cuml.RandomForestClassifier/Regressor` + Treelite concat (consumed by
+# reference tree.py:324-378).
+#
+# TPU-native design (no CUDA-style per-node kernels):
+#  * features are QUANTILE-BINNED once (maxBins edges from a host sample — the
+#    same sketch-then-bin scheme Spark ML uses), so tree growth only touches
+#    int32 bin ids;
+#  * trees grow LEVEL-WISE in a full binary-array layout: one
+#    `jax.ops.segment_sum` scatter per level builds the (node, feature, bin,
+#    stat) histogram for every active row at once, prefix sums over bins give
+#    every candidate split's left/right stats, and the best (feature, bin) per
+#    node is an argmax — all static shapes, fully jittable;
+#  * deep levels are processed in node CHUNKS to bound the histogram tensor
+#    (the `max_batch_size` idea of cuML's RF builder);
+#  * the ensemble is split across the mesh exactly like the reference
+#    (_estimators_per_worker, tree.py:270-281): each device grows its share of
+#    trees on ITS row shard via shard_map (no collectives during growth), and
+#    the stacked tree arrays are gathered at the end — the Treelite-concat
+#    analog with arrays instead of serialized C++ objects.
+#
+# A forest is a dict of arrays (n_trees leading axis):
+#   feature   [T, M] int32   (-1 = leaf)           M = 2^(max_depth+1) - 1
+#   threshold [T, M] f32     (split: x <= thr -> left child 2i+1)
+#   leaf      [T, M, S] f32  (class counts / (w, wy) stats per node)
+#
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Binning
+# ---------------------------------------------------------------------------
+
+
+def quantile_bins(x_host: np.ndarray, max_bins: int, sample_cap: int = 100_000, seed: int = 0) -> np.ndarray:
+    """Per-feature quantile bin edges from a host sample: [d, max_bins-1].
+
+    Mirrors Spark ML's approxQuantile-based continuous-feature binning."""
+    n = x_host.shape[0]
+    if n > sample_cap:
+        idx = np.random.default_rng(seed).choice(n, sample_cap, replace=False)
+        sample = np.asarray(x_host[idx], dtype=np.float64)
+    else:
+        sample = np.asarray(x_host, dtype=np.float64)
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    edges = np.quantile(sample, qs, axis=0).T  # [d, max_bins-1]
+    return np.ascontiguousarray(edges)
+
+
+@jax.jit
+def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
+    """X [n, d] -> int32 bin ids [n, d] via per-feature searchsorted."""
+
+    def one_feature(col, e):
+        return jnp.searchsorted(e, col, side="left").astype(jnp.int32)
+
+    return jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(X, edges)
+
+
+# ---------------------------------------------------------------------------
+# Impurity / split evaluation
+# ---------------------------------------------------------------------------
+
+
+def _split_gains(hist: jax.Array, impurity: str, min_instances: float):
+    """hist: [C, d, B, S] per-node histograms. Returns (gain [C, d, B],
+    total [C, S]) where gain[c, f, b] is the impurity decrease of splitting
+    node c on feature f at bin <= b."""
+    left = jnp.cumsum(hist, axis=2)  # [C, d, B, S]
+    total = left[:, 0, -1, :]  # [C, S] (any feature's full sum)
+    right = total[:, None, None, :] - left
+
+    if impurity in ("gini", "entropy"):
+        def node_impurity(stats):  # stats [..., S] class counts
+            cnt = jnp.sum(stats, axis=-1)
+            p = stats / jnp.maximum(cnt, 1e-30)[..., None]
+            if impurity == "gini":
+                return 1.0 - jnp.sum(p * p, axis=-1), cnt
+            return -jnp.sum(jnp.where(p > 0, p * jnp.log2(p), 0.0), axis=-1), cnt
+
+        imp_l, cnt_l = node_impurity(left)
+        imp_r, cnt_r = node_impurity(right)
+        imp_p, cnt_p = node_impurity(total)
+        cnt_p_b = cnt_p[:, None, None]
+        weighted_child = (cnt_l * imp_l + cnt_r * imp_r) / jnp.maximum(cnt_p_b, 1e-30)
+        gain = imp_p[:, None, None] - weighted_child
+    else:  # variance (regression): S = (w, wy, wyy)
+        w_l, wy_l, wyy_l = left[..., 0], left[..., 1], left[..., 2]
+        w_r, wy_r, wyy_r = right[..., 0], right[..., 1], right[..., 2]
+        w_p = total[:, 0][:, None, None]
+
+        def var_sum(w_, wy_, wyy_):  # Σw·(y-μ)² = Σwy² − (Σwy)²/Σw
+            return wyy_ - wy_ * wy_ / jnp.maximum(w_, 1e-30)
+
+        ss_p = var_sum(total[:, 0], total[:, 1], total[:, 2])[:, None, None]
+        ss_child = var_sum(w_l, wy_l, wyy_l) + var_sum(w_r, wy_r, wyy_r)
+        gain = (ss_p - ss_child) / jnp.maximum(w_p, 1e-30)
+        cnt_l, cnt_r = w_l, w_r
+        cnt_p_b = w_p
+
+    valid = (cnt_l >= min_instances) & (cnt_r >= min_instances)
+    # the last bin means "everything left" — never a real split
+    valid = valid & (jnp.arange(hist.shape[2])[None, None, :] < hist.shape[2] - 1)
+    return jnp.where(valid, gain, -jnp.inf), total
+
+
+def _feature_subset_mask(key, n_nodes: int, d: int, m: int):
+    """Exact-m random feature subset per node: bool [n_nodes, d]."""
+    if m >= d:
+        return jnp.ones((n_nodes, d), bool)
+    u = jax.random.uniform(key, (n_nodes, d))
+    rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+    return rank < m
+
+
+# ---------------------------------------------------------------------------
+# Single-tree growth (level-wise, full binary layout)
+# ---------------------------------------------------------------------------
+
+
+def _grow_tree(
+    key,
+    Xb: jax.Array,  # [n, d] int32 bins
+    stats_row: jax.Array,  # [n, S] per-row stat contributions (already w-weighted)
+    params: Dict,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Grow one tree; returns (feature [M], split_bin [M], node_stats [M, S])."""
+    n, d = Xb.shape
+    S = stats_row.shape[1]
+    B = params["max_bins"]
+    max_depth = params["max_depth"]
+    node_cap = params["node_chunk"]
+    M = 2 ** (max_depth + 1) - 1
+
+    feature = jnp.full((M,), -1, jnp.int32)
+    split_bin = jnp.zeros((M,), jnp.int32)
+    node_stats = jnp.zeros((M, S), stats_row.dtype)
+    node_id = jnp.zeros((n,), jnp.int32)  # current node per row (level-order id)
+    active = jnp.ones((n,), bool)  # row not yet in a leaf
+
+    for depth in range(max_depth):
+        level_size = 2**depth
+        offset = level_size - 1
+        n_chunks = max(1, -(-level_size // node_cap))
+        chunk = min(level_size, node_cap)
+        key, kf = jax.random.split(key)
+        fmask_level = _feature_subset_mask(kf, level_size, d, params["max_features"])
+
+        for ci in range(n_chunks):
+            c0 = offset + ci * chunk
+            local = node_id - c0  # node index within chunk
+            in_chunk = active & (local >= 0) & (local < chunk)
+            # flat segment id: (node_local * d + f) * B + bin
+            seg = (local[:, None] * d + jnp.arange(d)[None, :]) * B + Xb  # [n, d]
+            seg = jnp.where(in_chunk[:, None], seg, chunk * d * B)  # dump masked rows
+            hist_flat = jax.ops.segment_sum(
+                jnp.broadcast_to(stats_row[:, None, :], (n, d, S)).reshape(-1, S),
+                seg.reshape(-1),
+                num_segments=chunk * d * B + 1,
+            )[:-1]
+            hist = hist_flat.reshape(chunk, d, B, S)
+
+            gain, total = _split_gains(hist, params["impurity"], params["min_instances"])
+            fmask = jax.lax.dynamic_slice_in_dim(fmask_level, ci * chunk, chunk, 0)
+            gain = jnp.where(fmask[:, :, None], gain, -jnp.inf)
+            flat_best = jnp.argmax(gain.reshape(chunk, -1), axis=1)
+            best_gain = jnp.take_along_axis(gain.reshape(chunk, -1), flat_best[:, None], 1)[:, 0]
+            best_f = (flat_best // B).astype(jnp.int32)
+            best_b = (flat_best % B).astype(jnp.int32)
+
+            is_split = best_gain > params["min_info_gain"]
+            feature = jax.lax.dynamic_update_slice_in_dim(
+                feature, jnp.where(is_split, best_f, -1), c0, 0
+            )
+            split_bin = jax.lax.dynamic_update_slice_in_dim(
+                split_bin, jnp.where(is_split, best_b, 0), c0, 0
+            )
+            node_stats = jax.lax.dynamic_update_slice(node_stats, total, (c0, 0))
+
+        # advance rows: split nodes send rows to children; leaf rows deactivate
+        node_f = feature[node_id]
+        went_split = active & (node_f >= 0)
+        row_bin = jnp.take_along_axis(Xb, jnp.maximum(node_f, 0)[:, None], axis=1)[:, 0]
+        go_left = row_bin <= split_bin[node_id]
+        child = 2 * node_id + jnp.where(go_left, 1, 2)
+        node_id = jnp.where(went_split, child, node_id)
+        active = went_split
+
+    # last level: record stats for rows that reached it (all remaining leaves)
+    level_size = 2**max_depth
+    offset = level_size - 1
+    local = node_id - offset
+    in_level = active & (local >= 0)
+    seg = jnp.where(in_level, local, level_size)
+    last_stats = jax.ops.segment_sum(stats_row, seg, num_segments=level_size + 1)[:-1]
+    node_stats = jax.lax.dynamic_update_slice(node_stats, last_stats, (offset, 0))
+    return feature, split_bin, node_stats
+
+
+# ---------------------------------------------------------------------------
+# Forest over the mesh
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "seed", "n_trees", "max_depth", "max_bins", "max_features", "impurity",
+        "node_chunk", "bootstrap", "subsample_rate", "min_instances", "min_info_gain", "n_stats",
+    ),
+)
+def forest_fit(
+    Xb: jax.Array,  # [n_pad, d] int32 (row-sharded)
+    stats_row: jax.Array,  # [n_pad, S] per-row stats, zero on padding
+    w: jax.Array,  # [n_pad] weights (bootstrap sampling distribution)
+    seed: int,
+    *,
+    mesh,
+    n_trees: int,
+    max_depth: int,
+    max_bins: int,
+    max_features: int,
+    impurity: str,
+    node_chunk: int = 256,
+    bootstrap: bool = True,
+    subsample_rate: float = 1.0,
+    min_instances: float = 1.0,
+    min_info_gain: float = 0.0,
+    n_stats: int = 2,
+) -> Dict[str, jax.Array]:
+    """Ensemble-split forest fit: device i grows trees [i*t0, (i+1)*t0) on its
+    row shard. Returns stacked (feature [T, M], split_bin [T, M],
+    node_stats [T, M, S])."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROWS_AXIS
+
+    n_dev = mesh.devices.size
+    trees_per_dev = -(-n_trees // n_dev)  # reference _estimators_per_worker
+    params = {
+        "max_depth": max_depth, "max_bins": max_bins, "max_features": max_features,
+        "impurity": impurity, "node_chunk": node_chunk,
+        "min_instances": min_instances, "min_info_gain": min_info_gain,
+    }
+
+    def local(Xb_l, stats_l, w_l):
+        rank = jax.lax.axis_index(ROWS_AXIS)
+        n_l = Xb_l.shape[0]
+
+        def one_tree(tree_i):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), rank * trees_per_dev + tree_i)
+            n_draws = int(max(1, round(subsample_rate * n_l)))
+            k1, key = jax.random.split(key)
+            if bootstrap:
+                p = w_l / jnp.maximum(jnp.sum(w_l), 1e-30)
+                idx = jax.random.choice(k1, n_l, (n_draws,), replace=True, p=p)
+                wb = jnp.zeros((n_l,), stats_l.dtype).at[idx].add(1.0)
+            elif subsample_rate < 1.0:
+                # subsample without replacement (Spark bootstrap=False semantics);
+                # padding rows drawn here contribute nothing (stats are w-scaled)
+                idx = jax.random.choice(k1, n_l, (n_draws,), replace=False)
+                wb = jnp.zeros((n_l,), stats_l.dtype).at[idx].set(1.0)
+            else:
+                wb = jnp.ones((n_l,), stats_l.dtype)
+            return _grow_tree(key, Xb_l, stats_l * wb[:, None], params)
+
+        feats, bins_, nstats = jax.lax.map(one_tree, jnp.arange(trees_per_dev))
+        return feats, bins_, nstats
+
+    feats, bins_, nstats = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS)),
+        out_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS, None, None)),
+    )(Xb, stats_row, w)
+    # out axis 0 is [n_dev * trees_per_dev] (device-major) — the tree concat
+    return {"feature": feats, "split_bin": bins_, "node_stats": nstats}
+
+
+# ---------------------------------------------------------------------------
+# Prediction
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_raw_predict(
+    X: jax.Array,  # [n, d] float
+    feature: jax.Array,  # [T, M]
+    threshold: jax.Array,  # [T, M] real-valued thresholds
+    leaf_value: jax.Array,  # [T, M, S]
+    *,
+    max_depth: int,
+) -> jax.Array:
+    """Average of per-tree leaf values: [n, S]. Traversal is a fixed-depth
+    gather loop (vectorized oblivious descent, SURVEY.md §7 architecture map)."""
+
+    def one_tree(feat, thr, leaves):
+        def step(_, node):
+            f = feat[node]
+            is_split = f >= 0
+            xv = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+            child = 2 * node + jnp.where(xv <= thr[node], 1, 2)
+            return jnp.where(is_split, child, node)
+
+        node = jax.lax.fori_loop(0, max_depth, step, jnp.zeros(X.shape[0], jnp.int32))
+        return leaves[node]  # [n, S]
+
+    per_tree = jax.vmap(one_tree)(feature, threshold, leaf_value)  # [T, n, S]
+    return jnp.mean(per_tree, axis=0)
+
+
+def split_bins_to_thresholds(
+    feature: np.ndarray, split_bin: np.ndarray, edges: np.ndarray
+) -> np.ndarray:
+    """Convert bin-id splits to real thresholds using the bin edges.
+
+    Split 'bin <= b' corresponds to 'x <= edges[f, b]' (searchsorted-left)."""
+    f = np.maximum(feature, 0)
+    b = np.minimum(split_bin, edges.shape[1] - 1)
+    thr = edges[f, b]
+    return np.where(feature >= 0, thr, np.inf).astype(np.float64)

@@ -1,0 +1,203 @@
+#
+# RandomForest classifier/regressor tests vs sklearn
+# (reference tests/test_random_forest.py pattern, 945 LoC there).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.models.classification import (
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+)
+from spark_rapids_ml_tpu.models.regression import (
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
+
+
+def _clf_data(rng, n=500, d=8, k=3):
+    from sklearn.datasets import make_classification
+
+    x, y = make_classification(
+        n_samples=n, n_features=d, n_informative=d - 2, n_redundant=0,
+        n_classes=k, n_clusters_per_class=1, class_sep=2.0, random_state=9,
+    )
+    return pd.DataFrame({"features": list(x.astype(np.float64)), "label": y.astype(np.float64)}), x, y
+
+
+def _reg_data(rng, n=500, d=6):
+    x = rng.uniform(-2, 2, size=(n, d))
+    y = np.sin(x[:, 0]) * 3 + x[:, 1] ** 2 + 0.5 * x[:, 2] + 0.1 * rng.normal(size=n)
+    return pd.DataFrame({"features": list(x), "label": y}), x, y
+
+
+def test_rf_classifier_accuracy(rng):
+    df, x, y = _clf_data(rng)
+    rf = (
+        RandomForestClassifier(numTrees=20, maxDepth=6, maxBins=64, seed=7, num_workers=4)
+        .setFeaturesCol("features")
+    )
+    assert rf.solver_params["n_estimators"] == 20
+    model = rf.fit(df)
+    assert model.numClasses == 3
+    assert model.getNumTrees == 20
+    out = model.transform(df)
+    acc = (np.asarray(out["prediction"]) == y).mean()
+    assert acc > 0.93
+    # probability columns sane
+    probs = np.stack([np.asarray(p) for p in out["probability"]])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+    raws = np.stack([np.asarray(p) for p in out["rawPrediction"]])
+    np.testing.assert_allclose(raws.sum(axis=1), model.num_trees, rtol=1e-5)
+
+
+def test_rf_classifier_vs_sklearn_holdout(rng):
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+
+    df, x, y = _clf_data(rng, n=800, d=10)
+    train, test = df.iloc[:600], df.iloc[600:].reset_index(drop=True)
+    model = (
+        RandomForestClassifier(numTrees=30, maxDepth=8, maxBins=64, seed=3)
+        .setFeaturesCol("features")
+        .fit(train)
+    )
+    ours = (np.asarray(model.transform(test)["prediction"]) == y[600:]).mean()
+    sk = SkRF(n_estimators=30, max_depth=8, random_state=3).fit(x[:600], y[:600])
+    theirs = (sk.predict(x[600:]) == y[600:]).mean()
+    assert ours >= theirs - 0.07  # within striking distance of sklearn
+
+
+def test_rf_regressor_quality(rng):
+    from sklearn.ensemble import RandomForestRegressor as SkRF
+
+    df, x, y = _reg_data(rng, n=800)
+    train, test = df.iloc[:600], df.iloc[600:].reset_index(drop=True)
+    # featureSubsetStrategy='all' to match sklearn's regression default
+    # (Spark's 'auto' means onethird for regression); num_workers=2 so each
+    # tree sees 300 rows like a reasonable shard
+    model = (
+        RandomForestRegressor(
+            numTrees=30, maxDepth=8, maxBins=64, seed=1,
+            featureSubsetStrategy="all", num_workers=2,
+        )
+        .setFeaturesCol("features")
+        .fit(train)
+    )
+    pred = np.asarray(model.transform(test)["prediction"])
+    sk = SkRF(n_estimators=30, max_depth=8, random_state=1).fit(x[:600], y[:600])
+    sk_mse = np.mean((sk.predict(x[600:]) - y[600:]) ** 2)
+    our_mse = np.mean((pred - y[600:]) ** 2)
+    var = np.var(y[600:])
+    assert our_mse < var * 0.1  # explains >90% of variance
+    assert our_mse < sk_mse * 2.5
+
+
+def test_rf_feature_subset_strategies():
+    from spark_rapids_ml_tpu.models.tree import resolve_max_features
+
+    assert resolve_max_features("auto", 100, True) == 10
+    assert resolve_max_features("auto", 99, False) == 33
+    assert resolve_max_features("all", 7, True) == 7
+    assert resolve_max_features("sqrt", 64, False) == 8
+    assert resolve_max_features("log2", 64, True) == 6
+    assert resolve_max_features("onethird", 9, True) == 3
+    assert resolve_max_features("5", 100, True) == 5
+    assert resolve_max_features("0.5", 10, True) == 5
+    with pytest.raises(ValueError):
+        resolve_max_features("bogus", 10, True)
+
+
+def test_rf_impurity_validation():
+    with pytest.raises(ValueError, match="gini"):
+        RandomForestClassifier(impurity="variance")
+    with pytest.raises(ValueError, match="variance"):
+        RandomForestRegressor(impurity="gini")
+    RandomForestClassifier(impurity="entropy")  # ok
+
+
+def test_rf_persistence(tmp_path, rng):
+    df, x, y = _clf_data(rng, n=200)
+    model = RandomForestClassifier(numTrees=5, maxDepth=4, seed=2).setFeaturesCol("features").fit(df)
+    p = str(tmp_path / "rf")
+    model.write().overwrite().save(p)
+    loaded = RandomForestClassificationModel.load(p)
+    np.testing.assert_array_equal(loaded.feature, model.feature)
+    np.testing.assert_array_equal(loaded.threshold, model.threshold)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.transform(df)["prediction"]),
+        np.asarray(model.transform(df)["prediction"]),
+    )
+
+
+def test_rf_single_vector_predict(rng):
+    df, x, y = _clf_data(rng, n=150)
+    model = RandomForestClassifier(numTrees=10, maxDepth=5, seed=5).setFeaturesCol("features").fit(df)
+    out = model.transform(df)
+    assert model.predict(x[0]) == float(np.asarray(out["prediction"])[0])
+
+    dfr, xr, yr = _reg_data(rng, n=150)
+    mr = RandomForestRegressor(numTrees=10, maxDepth=5, seed=5).setFeaturesCol("features").fit(dfr)
+    outr = mr.transform(dfr)
+    np.testing.assert_allclose(mr.predict(xr[0]), np.asarray(outr["prediction"])[0], rtol=1e-6)
+
+
+def test_rf_deterministic_with_seed(rng):
+    df, _, _ = _clf_data(rng, n=150)
+    m1 = RandomForestClassifier(numTrees=8, maxDepth=4, seed=11).setFeaturesCol("features").fit(df)
+    m2 = RandomForestClassifier(numTrees=8, maxDepth=4, seed=11).setFeaturesCol("features").fit(df)
+    np.testing.assert_array_equal(m1.feature, m2.feature)
+    np.testing.assert_array_equal(m1.threshold, m2.threshold)
+
+
+def test_rf_min_instances_and_gain(rng):
+    df, _, _ = _clf_data(rng, n=150)
+    # huge minInstancesPerNode forces shallow trees
+    m = (
+        RandomForestClassifier(numTrees=4, maxDepth=6, minInstancesPerNode=100, seed=1)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    n_splits = int(np.sum(m.feature >= 0))
+    m2 = RandomForestClassifier(numTrees=4, maxDepth=6, seed=1).setFeaturesCol("features").fit(df)
+    assert n_splits < int(np.sum(m2.feature >= 0))
+
+
+def test_rf_feature_subset_fraction_one():
+    from spark_rapids_ml_tpu.models.tree import resolve_max_features
+
+    # Spark grammar: "1.0" is a FRACTION (all features), "1" is a count
+    assert resolve_max_features("1.0", 100, True) == 100
+    assert resolve_max_features("1", 100, True) == 1
+
+
+def test_rf_weight_col_changes_model(rng):
+    df, x, y = _clf_data(rng, n=200, d=6, k=2)
+    w = np.where(y == 0, 10.0, 0.1)  # heavily favor class 0
+    dfw = df.copy()
+    dfw["w"] = w
+    m_plain = RandomForestClassifier(numTrees=6, maxDepth=4, seed=4).setFeaturesCol("features").fit(df)
+    m_w = (
+        RandomForestClassifier(numTrees=6, maxDepth=4, seed=4, weightCol="w")
+        .setFeaturesCol("features")
+        .fit(dfw)
+    )
+    # weighting must change the learned trees
+    assert not np.array_equal(m_plain.node_stats, m_w.node_stats)
+    # and bias predictions toward the upweighted class
+    p_plain = np.asarray(m_plain.transform(df)["prediction"])
+    p_w = np.asarray(m_w.transform(df)["prediction"])
+    assert (p_w == 0).sum() >= (p_plain == 0).sum()
+
+
+def test_rf_no_bootstrap_subsampling_diversifies(rng):
+    df, _, _ = _clf_data(rng, n=300, d=6, k=2)
+    m = (
+        RandomForestClassifier(
+            numTrees=6, maxDepth=4, seed=2, bootstrap=False, subsamplingRate=0.5, num_workers=1
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    # trees trained on different half-samples must differ
+    assert not np.array_equal(m.node_stats[0], m.node_stats[1])
