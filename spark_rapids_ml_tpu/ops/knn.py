@@ -1,0 +1,181 @@
+#
+# Distributed k-nearest-neighbors solvers — in-tree replacements for
+# `cuml.neighbors.nearest_neighbors_mg.NearestNeighborsMG` (exact, reference
+# knn.py:649) and the local-index ANN path (`cuml.neighbors.NearestNeighbors`
+# IVFFlat, reference knn.py:1393-1404).
+#
+# Exact kNN, TPU-native shape: instead of the reference's UCX all-to-all
+# (query blocks shuffled between ranks), ITEMS stay row-sharded and QUERIES are
+# replicated: every device computes a [q_tile, n_local] distance tile on the
+# MXU, takes a per-shard top-k, and the [n_dev, nq, k] candidates are gathered
+# and merged with one final top-k — an all-gather of k·nq scalars instead of an
+# item shuffle, which is the right trade on ICI (SURVEY.md §2.4 all-to-all row).
+#
+# ANN IVFFlat: per-shard KMeans coarse quantizer + PADDED cluster buckets
+# (fixed list length -> static shapes); queries probe the nprobe closest
+# centroids and search only those buckets via gather — the TPU analog of the
+# IVF list scan.
+#
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import ROWS_AXIS
+
+
+def _tile_topk(items, queries, valid, k, batch_queries=4096):
+    """Per-device exact top-k: items [n_loc, d], queries [nq, d] ->
+    (dist [nq, k], idx [nq, k] local). Scans query tiles; padding items get
+    +inf distance."""
+    n_loc, d = items.shape
+    nq = queries.shape[0]
+    n_tiles = max(1, -(-nq // batch_queries))
+    pad = n_tiles * batch_queries - nq
+    qp = jnp.pad(queries, ((0, pad), (0, 0)))
+    item_sq = jnp.sum(items * items, axis=1)  # [n_loc]
+    big = jnp.asarray(jnp.inf, items.dtype)
+
+    def one_tile(q):
+        # ||q - x||² = ||q||² - 2 q·x + ||x||²; q·xᵀ rides the MXU
+        d2 = item_sq[None, :] - 2.0 * (q @ items.T)
+        d2 = jnp.where(valid[None, :], d2, big)
+        neg_d, idx = jax.lax.top_k(-d2, k)
+        return -neg_d + jnp.sum(q * q, axis=1)[:, None], idx
+
+    qt = qp.reshape(n_tiles, batch_queries, d)
+    dists, idxs = jax.lax.map(one_tile, qt)
+    return dists.reshape(-1, k)[:nq], idxs.reshape(-1, k)[:nq]
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "batch_queries"))
+def exact_knn(
+    items: jax.Array,  # [n_pad, d] row-sharded
+    valid: jax.Array,  # [n_pad] bool (False on padding)
+    queries: jax.Array,  # [nq, d] replicated
+    *,
+    mesh,
+    k: int,
+    batch_queries: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """Global exact kNN: returns (distances [nq, k], GLOBAL item indices [nq, k])
+    sorted ascending by distance. Distances are euclidean (not squared), Spark/
+    cuML convention."""
+    n_dev = mesh.devices.size
+    n_loc = items.shape[0] // n_dev
+
+    def local(items_l, valid_l):
+        rank = jax.lax.axis_index(ROWS_AXIS)
+        d2, idx = _tile_topk(items_l, queries, valid_l, k, batch_queries)
+        gidx = idx + rank * n_loc
+        # gather all shards' candidates: [n_dev, nq, k]
+        d2_all = jax.lax.all_gather(d2, ROWS_AXIS)
+        gidx_all = jax.lax.all_gather(gidx, ROWS_AXIS)
+        return d2_all, gidx_all
+
+    d2_all, gidx_all = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS)),
+        out_specs=(P(), P()),
+    )(items, valid)
+    nq = queries.shape[0]
+    d2_cat = jnp.moveaxis(d2_all, 0, 1).reshape(nq, -1)  # [nq, n_dev*k]
+    gidx_cat = jnp.moveaxis(gidx_all, 0, 1).reshape(nq, -1)
+    neg_d, pos = jax.lax.top_k(-d2_cat, k)
+    final_idx = jnp.take_along_axis(gidx_cat, pos, axis=1)
+    d2_final = jnp.maximum(-neg_d, 0.0)
+    return jnp.sqrt(d2_final), final_idx
+
+
+# ---------------------------------------------------------------------------
+# IVFFlat approximate kNN (single-shard index; the estimator runs one per
+# partition like the reference's local-index design)
+# ---------------------------------------------------------------------------
+
+
+def build_ivfflat(x, n_lists: int, seed: int = 0, kmeans_iters: int = 10):
+    """Build an IVFFlat index on host+device: returns dict with centroids
+    [n_lists, d], buckets [n_lists, L, d], bucket_ids [n_lists, L] (−1 pad)."""
+    import numpy as np
+
+    from .kmeans import kmeans_fit, kmeans_plus_plus_init
+    from ..parallel.mesh import get_mesh
+
+    x = np.asarray(x, dtype=np.float32)
+    n, d = x.shape
+    n_lists = min(n_lists, n)
+    centers0 = kmeans_plus_plus_init(x, n_lists, seed).astype(np.float32)
+    mesh1 = get_mesh(1)
+    state = kmeans_fit(
+        jax.device_put(x), jnp.ones((n,), jnp.float32), jax.device_put(centers0),
+        mesh=mesh1, max_iter=kmeans_iters, tol=1e-6,
+    )
+    centroids = np.asarray(state["cluster_centers_"])
+    d2 = ((x[:, None, :] - centroids[None, :, :]) ** 2).sum(-1) if n * n_lists * d < 5e7 else None
+    if d2 is None:
+        assign = np.asarray(
+            jax.jit(lambda X, C: jnp.argmin(
+                jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1
+            ))(jax.device_put(x), jax.device_put(centroids))
+        )
+    else:
+        assign = d2.argmin(1)
+    L = max(1, int(np.bincount(assign, minlength=n_lists).max()))
+    buckets = np.zeros((n_lists, L, d), np.float32)
+    bucket_ids = np.full((n_lists, L), -1, np.int64)
+    fill = np.zeros(n_lists, np.int64)
+    for i, c in enumerate(assign):
+        buckets[c, fill[c]] = x[i]
+        bucket_ids[c, fill[c]] = i
+        fill[c] += 1
+    return {"centroids": centroids, "buckets": buckets, "bucket_ids": bucket_ids}
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "batch_queries"))
+def ivfflat_search(
+    queries: jax.Array,  # [nq, d]
+    centroids: jax.Array,  # [C, d]
+    buckets: jax.Array,  # [C, L, d]
+    bucket_ids: jax.Array,  # [C, L]
+    *,
+    k: int,
+    n_probes: int,
+    batch_queries: int = 1024,
+) -> Tuple[jax.Array, jax.Array]:
+    """Probe the n_probes nearest lists per query; returns (sqrt distances,
+    item ids) [nq, k] (id −1 where fewer than k candidates)."""
+    nq, d = queries.shape
+    C, L, _ = buckets.shape
+    n_probes = min(n_probes, C)
+    n_tiles = max(1, -(-nq // batch_queries))
+    pad = n_tiles * batch_queries - nq
+    qp = jnp.pad(queries, ((0, pad), (0, 0)))
+
+    def one_tile(q):  # [B, d]
+        B = q.shape[0]
+        cd = jnp.sum(centroids * centroids, 1)[None, :] - 2.0 * q @ centroids.T
+        _, probe = jax.lax.top_k(-cd, n_probes)  # [B, n_probes]
+        cand = buckets[probe]  # [B, n_probes, L, d]
+        cand_ids = bucket_ids[probe]  # [B, n_probes, L]
+        cand = cand.reshape(B, n_probes * L, d)
+        cand_ids = cand_ids.reshape(B, n_probes * L)
+        d2 = jnp.sum((cand - q[:, None, :]) ** 2, axis=2)
+        d2 = jnp.where(cand_ids >= 0, d2, jnp.inf)
+        neg_d, pos = jax.lax.top_k(-d2, min(k, n_probes * L))
+        ids = jnp.take_along_axis(cand_ids, pos, axis=1)
+        dist = jnp.maximum(-neg_d, 0.0)
+        if dist.shape[1] < k:  # fewer candidates than k: pad
+            padk = k - dist.shape[1]
+            dist = jnp.pad(dist, ((0, 0), (0, padk)), constant_values=jnp.inf)
+            ids = jnp.pad(ids, ((0, 0), (0, padk)), constant_values=-1)
+        return jnp.sqrt(dist), ids
+
+    qt = qp.reshape(n_tiles, batch_queries, d)
+    dists, idxs = jax.lax.map(one_tile, qt)
+    return dists.reshape(-1, k)[:nq], idxs.reshape(-1, k)[:nq]
