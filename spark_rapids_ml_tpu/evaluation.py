@@ -142,7 +142,25 @@ class MulticlassClassificationEvaluator(
             prob_col = self.getOrDefault("probabilityCol")
             probs = np.stack([np.asarray(p) for p in pdf[prob_col]])
             eps = self.getOrDefault("eps")
-            p_true = np.clip(probs[np.arange(len(label)), label.astype(int)], eps, 1 - eps)
+            # resolve each label to its probability-vector column: direct index
+            # when labels are already 0..k-1 (the Spark convention), otherwise
+            # by position among the sorted class values — matching how models
+            # order probability columns by classes_. The fallback needs every
+            # class present in this dataset; a partial batch with exotic labels
+            # is ambiguous, so raise rather than silently mis-index.
+            lab_int = label.astype(int)
+            if np.array_equal(lab_int, label) and lab_int.min() >= 0 and lab_int.max() < probs.shape[1]:
+                col = lab_int
+            else:
+                classes = np.unique(label)
+                if len(classes) != probs.shape[1]:
+                    raise ValueError(
+                        "logLoss cannot map labels to probability columns: labels are not "
+                        f"0..{probs.shape[1] - 1} indices and the {len(classes)} distinct label "
+                        f"values do not cover the {probs.shape[1]} probability columns"
+                    )
+                col = np.searchsorted(classes, label)
+            p_true = np.clip(probs[np.arange(len(label)), col], eps, 1 - eps)
             log_loss = float(np.sum(-np.log(p_true) * weight))
         return MulticlassMetrics.from_confusion(confusion, log_loss).evaluate(self)
 

@@ -178,6 +178,11 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
         query_by_id = query_out.set_index(id_col)
         for _, r in knn_df.iterrows():
             for item_id, d in zip(r["indices"], r["distances"]):
+                # ANN search pads under-filled probe results with +inf
+                # distance — those aren't real neighbors, skip them (a real
+                # hit always has finite distance, whatever its user id)
+                if not np.isfinite(d):
+                    continue
                 rows.append((r["query_id"], item_id, d))
         pairs = pd.DataFrame(rows, columns=["_query_id", "_item_id", distCol])
         item_side = item_by_id.loc[pairs["_item_id"]].reset_index()

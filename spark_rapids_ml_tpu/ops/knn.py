@@ -40,13 +40,21 @@ def _tile_topk(items, queries, valid, k, batch_queries=4096):
     qp = jnp.pad(queries, ((0, pad), (0, 0)))
     item_sq = jnp.sum(items * items, axis=1)  # [n_loc]
     big = jnp.asarray(jnp.inf, items.dtype)
+    # k may exceed the per-shard row count (only the GLOBAL row count bounds
+    # it); take what the shard has and pad candidates with +inf distance so the
+    # global merge never selects them
+    kk = min(k, n_loc)
 
     def one_tile(q):
         # ||q - x||² = ||q||² - 2 q·x + ||x||²; q·xᵀ rides the MXU
         d2 = item_sq[None, :] - 2.0 * (q @ items.T)
         d2 = jnp.where(valid[None, :], d2, big)
-        neg_d, idx = jax.lax.top_k(-d2, k)
-        return -neg_d + jnp.sum(q * q, axis=1)[:, None], idx
+        neg_d, idx = jax.lax.top_k(-d2, kk)
+        d_out = -neg_d + jnp.sum(q * q, axis=1)[:, None]
+        if kk < k:
+            d_out = jnp.pad(d_out, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
+            idx = jnp.pad(idx, ((0, 0), (0, k - kk)))
+        return d_out, idx
 
     qt = qp.reshape(n_tiles, batch_queries, d)
     dists, idxs = jax.lax.map(one_tile, qt)
@@ -73,20 +81,20 @@ def exact_knn(
         rank = jax.lax.axis_index(ROWS_AXIS)
         d2, idx = _tile_topk(items_l, queries, valid_l, k, batch_queries)
         gidx = idx + rank * n_loc
-        # gather all shards' candidates: [n_dev, nq, k]
-        d2_all = jax.lax.all_gather(d2, ROWS_AXIS)
-        gidx_all = jax.lax.all_gather(gidx, ROWS_AXIS)
-        return d2_all, gidx_all
+        return d2, gidx
 
+    # per-shard candidates come back stacked over the mesh axis ([n_dev*nq, k]);
+    # the merge below is a tiny [nq, n_dev*k] top-k that XLA gathers itself —
+    # an all-gather of k·nq scalars, not an item shuffle
     d2_all, gidx_all = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS)),
-        out_specs=(P(), P()),
+        out_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS, None)),
     )(items, valid)
     nq = queries.shape[0]
-    d2_cat = jnp.moveaxis(d2_all, 0, 1).reshape(nq, -1)  # [nq, n_dev*k]
-    gidx_cat = jnp.moveaxis(gidx_all, 0, 1).reshape(nq, -1)
+    d2_cat = jnp.moveaxis(d2_all.reshape(n_dev, nq, k), 0, 1).reshape(nq, -1)
+    gidx_cat = jnp.moveaxis(gidx_all.reshape(n_dev, nq, k), 0, 1).reshape(nq, -1)
     neg_d, pos = jax.lax.top_k(-d2_cat, k)
     final_idx = jnp.take_along_axis(gidx_cat, pos, axis=1)
     d2_final = jnp.maximum(-neg_d, 0.0)

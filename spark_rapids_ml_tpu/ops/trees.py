@@ -261,7 +261,11 @@ def forest_fit(
             n_draws = int(max(1, round(subsample_rate * n_l)))
             k1, key = jax.random.split(key)
             if bootstrap:
-                p = w_l / jnp.maximum(jnp.sum(w_l), 1e-30)
+                # draw UNIFORMLY over valid (non-padding) rows; the user weights
+                # already scale stats_l, so weighting the draw too would apply
+                # them twice (w² effective weighting)
+                valid = (w_l > 0).astype(stats_l.dtype)
+                p = valid / jnp.maximum(jnp.sum(valid), 1e-30)
                 idx = jax.random.choice(k1, n_l, (n_draws,), replace=True, p=p)
                 wb = jnp.zeros((n_l,), stats_l.dtype).at[idx].add(1.0)
             elif subsample_rate < 1.0:

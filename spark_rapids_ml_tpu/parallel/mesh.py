@@ -10,6 +10,7 @@
 #
 from __future__ import annotations
 
+import contextlib
 from typing import Optional, Tuple, Union
 
 import numpy as np
@@ -77,21 +78,28 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+@contextlib.contextmanager
 def dtype_scope(dtype):
-    """Context that makes float64 actually mean float64 on device, scoped to the
-    framework's own computations.
+    """Numerics context for the framework's own computations: real f64 when
+    asked for, and FULL-float32 matmuls always.
 
-    JAX's default `jax_enable_x64=False` silently downcasts f64 to f32; a user
-    who passed ``float32_inputs=False`` asked for double precision (the
-    reference supports f64 end-to-end; SURVEY.md §7 'float64 parity'). The flag
-    is enabled via the scoped `jax.experimental.enable_x64` context so the
-    user's own JAX code keeps its default semantics.
+    - JAX's default `jax_enable_x64=False` silently downcasts f64 to f32; a user
+      who passed ``float32_inputs=False`` asked for double precision (the
+      reference supports f64 end-to-end; SURVEY.md §7 'float64 parity'). The
+      flag is enabled via the scoped context so the user's own JAX code keeps
+      its default semantics.
+    - TPU matmuls default to one-pass bf16 on the MXU (~3 decimal digits) —
+      fine for neural nets, wrong for classical ML: kNN distance expansions,
+      covariance/gram accumulations and L-BFGS gradients all lose parity
+      (observed ~2% distance error on a v5e chip). `default_matmul_precision
+      ("float32")` selects the multi-pass full-f32 MXU mode, restoring
+      CPU-equivalent f32 accuracy; CPU/GPU backends are unaffected.
     """
-    import contextlib
-
-    if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
-        return jax.enable_x64(True)  # jax config State: usable as a scoped context
-    return contextlib.nullcontext()
+    with contextlib.ExitStack() as stack:
+        if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
+            stack.enter_context(jax.enable_x64(True))  # jax config State: scoped context
+        stack.enter_context(jax.default_matmul_precision("float32"))
+        yield
 
 
 def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:

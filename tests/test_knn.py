@@ -1,0 +1,100 @@
+#
+# Exact + approximate kNN tests (reference tests/test_nearest_neighbors.py and
+# test_approximate_nearest_neighbors.py pattern).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.models.knn import (
+    ApproximateNearestNeighbors,
+    NearestNeighbors,
+)
+
+
+def _item_query(rng, n_items=64, n_queries=10, d=4):
+    items = rng.normal(size=(n_items, d))
+    queries = rng.normal(size=(n_queries, d))
+    item_df = pd.DataFrame({"features": list(items), "id": np.arange(n_items, dtype=np.int64)})
+    query_df = pd.DataFrame({"features": list(queries), "id": np.arange(n_queries, dtype=np.int64) + 1000})
+    return item_df, query_df, items, queries
+
+
+def _sk_knn(items, queries, k):
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    nn = SkNN(n_neighbors=k).fit(items)
+    dist, idx = nn.kneighbors(queries)
+    return dist, idx
+
+
+def test_exact_knn_matches_sklearn(rng):
+    item_df, query_df, items, queries = _item_query(rng)
+    model = NearestNeighbors(k=4).setInputCol("features").setIdCol("id").fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    sk_dist, sk_idx = _sk_knn(items, queries, 4)
+    ours_idx = np.stack(knn_df["indices"].to_list())
+    ours_dist = np.stack(knn_df["distances"].to_list())
+    np.testing.assert_allclose(ours_dist, sk_dist, rtol=1e-5, atol=1e-8)
+    np.testing.assert_array_equal(ours_idx, sk_idx)
+
+
+def test_exact_knn_k_exceeds_per_shard_rows(rng):
+    # 16 items spread over the 8-device mesh = 2 rows per shard; k=5 is valid
+    # (k <= total rows) and must not crash the per-shard top-k
+    item_df, query_df, items, queries = _item_query(rng, n_items=16, n_queries=4)
+    model = NearestNeighbors(k=5).setInputCol("features").setIdCol("id").fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    sk_dist, sk_idx = _sk_knn(items, queries, 5)
+    np.testing.assert_allclose(np.stack(knn_df["distances"].to_list()), sk_dist, rtol=1e-5, atol=1e-8)
+    np.testing.assert_array_equal(np.stack(knn_df["indices"].to_list()), sk_idx)
+
+
+def test_exact_knn_k_exceeds_total_rows_raises(rng):
+    item_df, query_df, *_ = _item_query(rng, n_items=8)
+    model = NearestNeighbors(k=9).setInputCol("features").setIdCol("id").fit(item_df)
+    with pytest.raises(ValueError, match="exceeds"):
+        model.kneighbors(query_df)
+
+
+def test_exact_join_row_count(rng):
+    item_df, query_df, *_ = _item_query(rng, n_items=32, n_queries=6)
+    model = NearestNeighbors(k=3).setInputCol("features").setIdCol("id").fit(item_df)
+    out = model.exactNearestNeighborsJoin(query_df)
+    assert len(out) == 6 * 3
+    assert "distCol" in out.columns
+    assert "item_id" in out.columns and "query_id" in out.columns
+
+
+def test_ann_ivfflat_recall(rng):
+    item_df, query_df, items, queries = _item_query(rng, n_items=512, n_queries=32, d=8)
+    ann = (
+        ApproximateNearestNeighbors(k=8, algoParams={"nlist": 16, "nprobe": 16})
+        .setInputCol("features")
+        .setIdCol("id")
+    )
+    model = ann.fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    _, sk_idx = _sk_knn(items, queries, 8)
+    ours = np.stack(knn_df["indices"].to_list())
+    # probing ALL lists -> exact search: recall must be 1
+    recall = np.mean([len(set(a) & set(b)) / 8.0 for a, b in zip(ours, sk_idx)])
+    assert recall == 1.0
+
+
+def test_ann_join_skips_padded_ids(rng):
+    # tiny buckets + 1 probe: some queries see < k candidates, producing -1
+    # padded ids that the join must silently drop (not KeyError)
+    item_df, query_df, *_ = _item_query(rng, n_items=20, n_queries=5, d=3)
+    ann = (
+        ApproximateNearestNeighbors(k=10, algoParams={"nlist": 10, "nprobe": 1})
+        .setInputCol("features")
+        .setIdCol("id")
+    )
+    model = ann.fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    indices = np.stack(knn_df["indices"].to_list())
+    assert (indices == -1).any(), "test setup should produce under-filled results"
+    out = model.approxSimilarityJoin(query_df)
+    assert (out["item_id"] != -1).all()
+    assert np.isfinite(out["distCol"]).all()

@@ -190,6 +190,28 @@ def test_rf_weight_col_changes_model(rng):
     assert (p_w == 0).sum() >= (p_plain == 0).sum()
 
 
+def test_rf_bootstrap_weight_applied_once(rng):
+    # ADVICE r1 (high): with bootstrap=True the draw was proportional to w AND
+    # the histogram stats were w-scaled -> w² weighting. Weighted mean of
+    # {y=0,w=1; y=1,w=3} must be ~0.75 either way.
+    n = 400
+    y = (np.arange(n) % 2).astype(np.float64)
+    w = np.where(y == 0, 1.0, 3.0)
+    x = rng.normal(size=(n, 3))  # uninformative features -> root-level mean
+    df = pd.DataFrame({"features": list(x), "label": y, "w": w})
+    for bootstrap in (True, False):
+        m = (
+            RandomForestRegressor(
+                numTrees=8, maxDepth=1, seed=3, weightCol="w", bootstrap=bootstrap,
+                minInfoGain=1e9,  # forbid splits: every tree is a root stump
+            )
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+        pred = float(np.asarray(m.transform(df)["prediction"])[0])
+        assert abs(pred - 0.75) < 0.05, f"bootstrap={bootstrap}: {pred}"
+
+
 def test_rf_no_bootstrap_subsampling_diversifies(rng):
     df, _, _ = _clf_data(rng, n=300, d=6, k=2)
     m = (

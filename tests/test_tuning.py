@@ -203,3 +203,30 @@ def test_weighted_evaluator_takes_fallback(rng):
     assert lr._supportsTransformEvaluate(ev)
     ev2 = RegressionEvaluator(metricName="rmse", weightCol="w")
     assert not lr._supportsTransformEvaluate(ev2)
+
+
+def test_logloss_non_contiguous_labels(rng):
+    from sklearn.metrics import log_loss as sk_log_loss
+
+    # labels {1., 3., 5.} with a 3-column probability vector ordered by sorted
+    # class value — logLoss must index via the class ordering, not label value
+    classes = np.array([1.0, 3.0, 5.0])
+    y = classes[rng.integers(0, 3, size=120)]
+    probs = rng.dirichlet(np.ones(3), size=120)
+    pred = classes[np.argmax(probs, axis=1)]
+    df = pd.DataFrame({"label": y, "prediction": pred, "probability": list(probs)})
+    ev = MulticlassClassificationEvaluator(metricName="logLoss")
+    np.testing.assert_allclose(
+        ev.evaluate(df), sk_log_loss(y, probs, labels=classes), rtol=1e-10
+    )
+
+
+def test_logloss_contiguous_labels_vs_sklearn(rng):
+    from sklearn.metrics import log_loss as sk_log_loss
+
+    y = rng.integers(0, 3, size=150).astype(float)
+    probs = rng.dirichlet(np.ones(3), size=150)
+    pred = np.argmax(probs, axis=1).astype(float)
+    df = pd.DataFrame({"label": y, "prediction": pred, "probability": list(probs)})
+    ev = MulticlassClassificationEvaluator(metricName="logLoss")
+    np.testing.assert_allclose(ev.evaluate(df), sk_log_loss(y, probs, labels=[0, 1, 2]), rtol=1e-10)
