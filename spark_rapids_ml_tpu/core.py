@@ -67,12 +67,34 @@ class FitInputs:
     X: Any  # row-sharded jax.Array [n_pad, d], or None when sparse
     y: Any  # row-sharded jax.Array [n_pad] or None
     w: Any  # row-sharded jax.Array [n_pad]; 0.0 on padding rows
-    n_valid: int
+    n_valid: int  # GLOBAL valid row count (sum over processes under SPMD)
     n_cols: int
     desc: Any  # PartitionDescriptor
     dtype: Any
     X_sparse: Any = None  # host scipy CSR when the sparse path is active
+    ctx: Any = None  # the TpuContext the fit runs under (rendezvous access)
+    local_rows_target: Any = None  # per-process padded local rows (SPMD mode)
     extra: Dict[str, Any] = field(default_factory=dict)
+
+    def put_rows(self, host_rows: np.ndarray, weights: Optional[np.ndarray] = None) -> Any:
+        """Lay an additional per-row host array out on the mesh with the SAME
+        row layout/padding as X (labels, per-row stats, ...). Under SPMD every
+        process passes its local slice; padding matches X's so row i of the
+        result still corresponds to row i of X."""
+        from .parallel import make_global_rows
+
+        arr, _, _ = make_global_rows(
+            self.mesh, host_rows, weights=weights, local_rows_target=self.local_rows_target
+        )
+        return arr
+
+    def allgather_host(self, payload: str) -> List[str]:
+        """Control-plane allgather of small strings across ranks (host-side
+        statistics merging: class sets, bin edges, init centers). Identity in
+        single-controller mode."""
+        if self.ctx is not None and self.ctx.is_spmd:
+            return self.ctx.rendezvous.allgather(payload)
+        return [payload]
 
 
 # A fit function maps (inputs, solver_params) -> model-attribute dict.
@@ -134,21 +156,42 @@ class _TpuCommon(_TpuParams):
 class _TpuCaller(_TpuCommon):
     """Shared fit-orchestration machinery (reference `_CumlCaller`, core.py:430-806)."""
 
-    def _build_fit_inputs(self, extracted: ExtractedData) -> FitInputs:
-        """Lay the host blocks out on the mesh (pad-and-mask; SURVEY.md §7)."""
-        import jax.numpy as jnp
+    # Whether this estimator's fit function is correct under multi-process SPMD
+    # (all host-side statistics either rendezvous-merged or absent). Estimators
+    # flip this as they are proven by the multiprocess test harness.
+    _supports_multiprocess: bool = False
 
-        from .parallel import PartitionDescriptor, get_mesh, make_global_rows
-        from .parallel.mesh import default_devices
+    def _build_fit_inputs(self, extracted: ExtractedData, ctx: Any) -> FitInputs:
+        """Lay the host blocks out on the mesh (pad-and-mask; SURVEY.md §7).
 
-        n_dev = min(self.num_workers, len(default_devices()))
-        mesh = get_mesh(n_dev)
+        Under multi-process SPMD (`ctx.is_spmd`) `extracted` is this PROCESS's
+        local row block: the global layout is agreed through the rendezvous
+        (PartitionDescriptor allgather — the reference's utils.py:192-210) and
+        every process pads its block to the common per-process size before
+        global-array assembly.
+        """
+        import jax
+
+        from .parallel import PartitionDescriptor, make_global_rows
+
+        mesh = ctx.mesh
+        n_dev = mesh.devices.size
         dtype = np.float32 if self._float32_inputs else np.float64
+        spmd = ctx.is_spmd
 
-        desc = PartitionDescriptor.build(
-            [extracted.n_rows // n_dev + (1 if i < extracted.n_rows % n_dev else 0) for i in range(n_dev)],
-            extracted.n_cols,
-        )
+        local_rows_target = None
+        if spmd:
+            desc = PartitionDescriptor.build(
+                [extracted.n_rows], extracted.n_cols, rank=ctx.rank, rendezvous=ctx.rendezvous
+            )
+            n_local_dev = jax.local_device_count()
+            max_rows = max(r for _, r in desc.parts_rank_size)
+            local_rows_target = -(-max_rows // n_local_dev) * n_local_dev
+        else:
+            desc = PartitionDescriptor.build(
+                [extracted.n_rows // n_dev + (1 if i < extracted.n_rows % n_dev else 0) for i in range(n_dev)],
+                extracted.n_cols,
+            )
 
         weights = extracted.weight
         if extracted.is_sparse:
@@ -157,22 +200,27 @@ class _TpuCaller(_TpuCommon):
             import numpy as _np
 
             w_np = weights if weights is not None else _np.ones(extracted.n_rows, dtype=dtype)
-            w, _, n_valid = (w_np, None, extracted.n_rows)
+            w = w_np
             y = extracted.label
             return FitInputs(
-                mesh=mesh, X=None, y=y, w=w, n_valid=n_valid, n_cols=extracted.n_cols,
-                desc=desc, dtype=dtype, X_sparse=X_sparse,
+                mesh=mesh, X=None, y=y, w=w, n_valid=desc.m, n_cols=extracted.n_cols,
+                desc=desc, dtype=dtype, X_sparse=X_sparse, ctx=ctx,
+                local_rows_target=local_rows_target,
             )
 
-        X, w, n_valid = make_global_rows(mesh, extracted.features.astype(dtype, copy=False), weights=weights)
+        X, w, _ = make_global_rows(
+            mesh, extracted.features.astype(dtype, copy=False), weights=weights,
+            local_rows_target=local_rows_target,
+        )
         y = None
         if extracted.label is not None:
-            from .parallel import make_global_rows as _mgr
-
-            y, _, _ = _mgr(mesh, extracted.label.astype(dtype, copy=False))
+            y, _, _ = make_global_rows(
+                mesh, extracted.label.astype(dtype, copy=False),
+                local_rows_target=local_rows_target,
+            )
         return FitInputs(
-            mesh=mesh, X=X, y=y, w=w, n_valid=n_valid, n_cols=extracted.n_cols,
-            desc=desc, dtype=dtype,
+            mesh=mesh, X=X, y=y, w=w, n_valid=desc.m, n_cols=extracted.n_cols,
+            desc=desc, dtype=dtype, ctx=ctx, local_rows_target=local_rows_target,
         )
 
     @abstractmethod
@@ -194,17 +242,38 @@ class _TpuCaller(_TpuCommon):
         extracted = self._pre_process_data(dataset, for_fit=True)
         fit_func = self._get_tpu_fit_func(extracted)
 
+        import contextlib
+
         from .parallel import TpuContext
         from .parallel.mesh import dtype_scope
 
-        with TpuContext(0, 1, num_devices=None) as _ctx, dtype_scope(
+        # Route through the caller's process group when one is active (the
+        # reference's train-UDF-inside-CumlContext shape, core.py:768-781);
+        # otherwise stand up the single-controller context ourselves.
+        active = TpuContext.current()
+        if active is not None:
+            if active.is_spmd and not self._supports_multiprocess:
+                raise NotImplementedError(
+                    f"{type(self).__name__} does not support multi-process SPMD fit yet; "
+                    "run it single-controller (one process driving all devices)"
+                )
+            ctx_mgr: Any = contextlib.nullcontext(active)
+        else:
+            from .parallel.mesh import default_devices
+
+            ctx_mgr = TpuContext(
+                0, 1, num_devices=min(self.num_workers, len(default_devices()))
+            )
+
+        with ctx_mgr as ctx, dtype_scope(
             np.float32 if self._float32_inputs else np.float64
         ):
-            inputs = self._build_fit_inputs(extracted)
+            inputs = self._build_fit_inputs(extracted, ctx)
             logger.info(
-                "fit: %d rows x %d cols on %d-device mesh (%s)",
+                "fit: %d rows x %d cols on %d-device mesh (%s)%s",
                 inputs.n_valid, inputs.n_cols, inputs.mesh.devices.size,
                 "sparse" if inputs.X_sparse is not None else "dense",
+                f" [SPMD rank {ctx.rank}/{ctx.nranks}]" if ctx.is_spmd else "",
             )
             if param_maps is None:
                 solver_param_sets = [dict(self._solver_params)]

@@ -247,7 +247,13 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
     def setWeightCol(self, value: str) -> "LogisticRegression":
         return self._set_params(weightCol=value)
 
+    # host-side class discovery is rendezvous-merged below; everything else is
+    # one pure SPMD program — correct under multi-process
+    _supports_multiprocess = True
+
     def _get_tpu_fit_func(self, extracted: ExtractedData):
+        import json
+
         from ..ops.logistic import logistic_fit
 
         labels_host = extracted.label
@@ -261,7 +267,12 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                     "L1/ElasticNet logistic regression is not supported yet; "
                     "set elasticNetParam=0.0"
                 )
-            classes = np.unique(labels_host).astype(np.float64)
+            # class set must be GLOBAL: merge each rank's local label values
+            # (the reference gets this for free because cuML's qn fit allgathers
+            # label cardinality internally)
+            local_classes = np.unique(labels_host).astype(np.float64)
+            gathered = inputs.allgather_host(json.dumps(local_classes.tolist()))
+            classes = np.unique(np.concatenate([np.asarray(json.loads(g)) for g in gathered]))
             k = len(classes)
             if k == 1:
                 # degenerate single-class fit: P(class)=1 (Spark parity,
@@ -279,10 +290,7 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             if family == "binomial" and k > 2:
                 raise ValueError(f"family='binomial' but found {k} classes")
             y_idx_host = np.searchsorted(classes, labels_host).astype(np.int32)
-
-            from ..parallel import make_global_rows
-
-            y_idx, _, _ = make_global_rows(inputs.mesh, y_idx_host)
+            y_idx = inputs.put_rows(y_idx_host)
             state = logistic_fit(
                 inputs.X,
                 y_idx,

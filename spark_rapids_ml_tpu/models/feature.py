@@ -59,6 +59,9 @@ class PCA(_PCAParams, _TpuEstimator):
     (reference feature.py:222-241).
     """
 
+    # fit is one pure SPMD program over (X, w): correct under multi-process
+    _supports_multiprocess = True
+
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._setDefault(k=1)

@@ -185,6 +185,9 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
     def setWeightCol(self, value: str) -> "LinearRegression":
         return self._set_params(weightCol=value)
 
+    # fit is one pure SPMD program over (X, y, w): correct under multi-process
+    _supports_multiprocess = True
+
     def _get_tpu_fit_func(self, extracted: ExtractedData):
         from ..ops.linear import linear_fit
 

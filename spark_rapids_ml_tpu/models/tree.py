@@ -172,7 +172,6 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
         from ..ops.trees import bin_features, forest_fit, quantile_bins, split_bins_to_thresholds
-        from ..parallel import make_global_rows
 
         x_host = extracted.features
         labels_host = extracted.label
@@ -197,7 +196,7 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
             # forest_fit multiplies on top
             Xb_binned = bin_features(inputs.X, edges)
             w = inputs.w
-            stats_global, _, _ = make_global_rows(inputs.mesh, stats_host)
+            stats_global = inputs.put_rows(stats_host)
 
             state = forest_fit(
                 Xb_binned,

@@ -21,4 +21,4 @@ from .mesh import (  # noqa: F401
     set_devices,
 )
 from .partition import PartitionDescriptor  # noqa: F401
-from .context import TpuContext, LocalRendezvous, Rendezvous  # noqa: F401
+from .context import FileRendezvous, LocalRendezvous, Rendezvous, TpuContext  # noqa: F401

@@ -15,11 +15,13 @@
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
+import time
 from typing import List, Optional
 
-__all__ = ["Rendezvous", "LocalRendezvous", "TpuContext"]
+__all__ = ["Rendezvous", "LocalRendezvous", "FileRendezvous", "TpuContext"]
 
 
 class Rendezvous:
@@ -72,10 +74,76 @@ class LocalRendezvous(Rendezvous):
         return out  # type: ignore[return-value]
 
 
+class FileRendezvous(Rendezvous):
+    """Cross-PROCESS rendezvous over a shared directory.
+
+    The control plane for multi-process SPMD launches outside Spark (and for
+    the subprocess test harness): each rank writes its payload to
+    ``<dir>/round_<i>/rank_<r>`` and polls until all N files exist — the same
+    allgather-of-strings contract the reference gets from
+    `BarrierTaskContext.allGather` (reference cuml_context.py:80-103). Works on
+    any shared filesystem; write-then-rename makes each file's appearance
+    atomic.
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        nranks: int,
+        root: str,
+        timeout_s: float = 300.0,
+        run_id: Optional[str] = None,
+    ):
+        """`run_id` should be a fresh nonce minted by the LAUNCHER and passed to
+        every rank — it namespaces this run's rounds so stale files from a
+        previous run in the same root can never be read as current. Without it,
+        the caller must guarantee `root` is a fresh directory per run."""
+        self.rank = rank
+        self.nranks = nranks
+        self.root = os.path.join(root, run_id) if run_id else root
+        self.timeout_s = timeout_s
+        self._round = 0
+        os.makedirs(self.root, exist_ok=True)
+
+    def allgather(self, payload: str) -> List[str]:
+        round_dir = os.path.join(self.root, f"round_{self._round}")
+        self._round += 1
+        os.makedirs(round_dir, exist_ok=True)
+        tmp = os.path.join(round_dir, f".rank_{self.rank}.tmp")
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, os.path.join(round_dir, f"rank_{self.rank}"))
+        deadline = time.monotonic() + self.timeout_s
+        out: List[Optional[str]] = [None] * self.nranks
+        pending = set(range(self.nranks))
+        while pending:
+            for r in list(pending):
+                path = os.path.join(round_dir, f"rank_{r}")
+                if os.path.exists(path):
+                    with open(path) as f:
+                        out[r] = f.read()
+                    pending.discard(r)
+            if pending:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rendezvous round {self._round - 1}: ranks {sorted(pending)} "
+                        f"missing after {self.timeout_s}s"
+                    )
+                time.sleep(0.01)
+        return out  # type: ignore[return-value]
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+# The context active for the current fit call, set by TpuContext.__enter__.
+# Estimators pick this up so `with TpuContext(...): est.fit(local_df)` routes
+# the fit through the caller's process group — the analog of the reference's
+# train-UDF body running inside its CumlContext (reference core.py:768-781).
+_ACTIVE_CONTEXT: Optional["TpuContext"] = None
 
 
 class TpuContext:
@@ -107,31 +175,64 @@ class TpuContext:
         self.num_devices = num_devices
         self.mesh = None
         self._initialized_distributed = False
+        self._prev_active: Optional["TpuContext"] = None
+
+    @classmethod
+    def current(cls) -> Optional["TpuContext"]:
+        """The context entered by the caller, if any (estimators consult this)."""
+        return _ACTIVE_CONTEXT
+
+    @property
+    def is_spmd(self) -> bool:
+        """True when each rank holds only its LOCAL row block (multi-process
+        SPMD), so estimators must rendezvous for global layout/host stats."""
+        return self.nranks > 1
 
     def __enter__(self) -> "TpuContext":
+        global _ACTIVE_CONTEXT
         import jax
 
-        if self.require_distributed and self.nranks > 1 and jax.process_count() == 1:
-            assert self.rendezvous is not None, "multi-process TpuContext needs a rendezvous"
-            if self.rank == 0:
-                coordinator = json.dumps({"addr": f"{socket.gethostname()}:{_free_port()}"})
-            else:
-                coordinator = json.dumps({})
-            gathered = self.rendezvous.allgather(coordinator)
-            addr = json.loads(gathered[0])["addr"]
-            jax.distributed.initialize(
-                coordinator_address=addr, num_processes=self.nranks, process_id=self.rank
-            )
-            self._initialized_distributed = True
+        if self.nranks > 1:
+            # nranks > 1 always means multi-process SPMD: the process group
+            # must be live and a control-plane rendezvous present, or ranks
+            # would silently fit their local block as if it were global
+            if self.rendezvous is None:
+                raise RuntimeError(
+                    "TpuContext with nranks > 1 needs a rendezvous (control-plane "
+                    "allgather for partition layout and host-side statistics)"
+                )
+            # probe distributed state WITHOUT jax.process_count(): that call
+            # initializes the XLA backend, after which distributed init is
+            # rejected
+            if not jax.distributed.is_initialized():
+                if self.rank == 0:
+                    coordinator = json.dumps({"addr": f"{socket.gethostname()}:{_free_port()}"})
+                else:
+                    coordinator = json.dumps({})
+                gathered = self.rendezvous.allgather(coordinator)
+                addr = json.loads(gathered[0])["addr"]
+                jax.distributed.initialize(
+                    coordinator_address=addr, num_processes=self.nranks, process_id=self.rank
+                )
+                self._initialized_distributed = True
+            if jax.process_count() != self.nranks:
+                raise RuntimeError(
+                    f"jax.distributed is initialized with {jax.process_count()} "
+                    f"processes but TpuContext was built for nranks={self.nranks}"
+                )
 
         from .mesh import get_mesh
 
         self.mesh = get_mesh(self.num_devices)
+        self._prev_active = _ACTIVE_CONTEXT
+        _ACTIVE_CONTEXT = self
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        global _ACTIVE_CONTEXT
         import jax
 
+        _ACTIVE_CONTEXT = self._prev_active
         if self._initialized_distributed:
             # destroy on success, abort-equivalent on exception
             # (reference cuml_context.py:150-167)

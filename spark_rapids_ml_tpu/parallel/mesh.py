@@ -117,31 +117,50 @@ def make_global_rows(
     x: np.ndarray,
     *,
     weights: Optional[np.ndarray] = None,
+    local_rows_target: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Place a host row-block on the mesh as a row-sharded global array.
 
-    Pads rows to a multiple of the mesh size; returns ``(X, w, n_valid)`` where
-    `w` is a row-weight vector with zeros on padding rows (and the user's sample
-    weights elsewhere). Solvers MUST use `w` for any per-row reduction so padding
-    never contaminates results.
+    Pads rows and returns ``(X, w, n_valid)`` where `w` is a row-weight vector
+    with zeros on padding rows (and the user's sample weights elsewhere).
+    Solvers MUST use `w` for any per-row reduction so padding never
+    contaminates results.
 
-    Single-controller path: `jax.device_put` with a NamedSharding splits the host
-    array across local devices. Under multi-process SPMD each process passes its
-    local block and we assemble the global array from per-process shards.
+    Single-controller path: `jax.device_put` with a NamedSharding splits the
+    host array (padded to a multiple of the mesh size) across local devices.
+    Under multi-process SPMD, `x` is this PROCESS's local block; every process
+    pads its block to `local_rows_target` rows (the rendezvous-agreed common
+    local size — processes hold ragged row counts, SPMD XLA wants equal
+    shards) and the global array is assembled from the per-process shards.
     """
     n_dev = mesh.devices.size
     x = np.ascontiguousarray(x)
     if weights is None:
         weights = np.ones(x.shape[0], dtype=x.dtype if x.dtype.kind == "f" else np.float32)
-    xp, n_valid = pad_rows(x, n_dev)
-    wp, _ = pad_rows(np.asarray(weights, dtype=xp.dtype if xp.dtype.kind == "f" else np.float32), n_dev)
+    weights = np.asarray(weights)
 
     if jax.process_count() == 1:
+        xp, n_valid = pad_rows(x, n_dev)
+        wp, _ = pad_rows(np.asarray(weights, dtype=xp.dtype if xp.dtype.kind == "f" else np.float32), n_dev)
         X = jax.device_put(xp, row_sharding(mesh, xp.ndim))
         w = jax.device_put(wp, row_sharding(mesh, 1))
-    else:  # multi-process: xp is this process's local block
+    else:  # multi-process: x is this process's local block
         from jax.experimental import multihost_utils
 
+        n_local_dev = jax.local_device_count()
+        if local_rows_target is None:
+            local_rows_target = -(-x.shape[0] // n_local_dev) * n_local_dev
+        if local_rows_target < x.shape[0] or local_rows_target % n_local_dev:
+            raise ValueError(
+                f"local_rows_target={local_rows_target} must cover the {x.shape[0]} local "
+                f"rows and divide by the {n_local_dev} local devices"
+            )
+        n_valid = x.shape[0]
+        xp = np.pad(x, [(0, local_rows_target - n_valid)] + [(0, 0)] * (x.ndim - 1))
+        wp = np.pad(
+            np.asarray(weights, dtype=xp.dtype if xp.dtype.kind == "f" else np.float32),
+            (0, local_rows_target - n_valid),
+        )
         X = multihost_utils.host_local_array_to_global_array(xp, mesh, P(ROWS_AXIS))
         w = multihost_utils.host_local_array_to_global_array(wp, mesh, P(ROWS_AXIS))
     return X, w, n_valid

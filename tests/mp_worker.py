@@ -1,0 +1,91 @@
+#
+# Worker script for the multi-process SPMD test (launched as a subprocess by
+# tests/test_multiprocess.py; the `mp_` prefix keeps pytest from collecting it).
+#
+# Each process holds a RAGGED local row block and fits PCA + LinearRegression +
+# LogisticRegression cooperatively through TpuContext(require_distributed=True)
+# — the analog of the reference's one-Spark-task-per-GPU barrier fit
+# (reference core.py:698-791). Results must match a single-process fit on the
+# concatenated data (asserted by the parent test).
+#
+import os
+import sys
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nranks = int(sys.argv[2])
+    rdv_dir = sys.argv[3]
+    out_dir = sys.argv[4]
+    run_id = sys.argv[5] if len(sys.argv) > 5 else None
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.models.regression import LinearRegression
+    from spark_rapids_ml_tpu.parallel import FileRendezvous, TpuContext
+
+    X, y_log, y_lin = make_dataset()
+    bounds = split_bounds(len(X), nranks)
+    lo, hi = bounds[rank], bounds[rank + 1]
+    df = pd.DataFrame(
+        {"features": list(X[lo:hi]), "label": y_log[lo:hi], "target": y_lin[lo:hi]}
+    )
+
+    rdv = FileRendezvous(rank, nranks, rdv_dir, timeout_s=120.0, run_id=run_id)
+    with TpuContext(rank, nranks, rdv, require_distributed=True):
+        pca = PCA(k=3, inputCol="features", float32_inputs=False).fit(df)
+        lin = (
+            LinearRegression(regParam=0.0, float32_inputs=False, labelCol="target")
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+        lr = (
+            LogisticRegression(maxIter=100, regParam=0.1, tol=1e-10, float32_inputs=False)
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+    np.savez(
+        os.path.join(out_dir, f"rank{rank}.npz"),
+        pca_components=pca.components_,
+        pca_mean=pca.mean_,
+        pca_var_ratio=pca.explained_variance_ratio_,
+        lin_coef=lin.coef_,
+        lin_intercept=np.asarray(lin.intercept_),
+        lr_coef=lr.coef_,
+        lr_intercept=lr.intercept_,
+        lr_classes=lr.classes_,
+    )
+
+
+def make_dataset():
+    """Deterministic dataset; rows SORTED by label so later ranks see only one
+    class — exercising the rendezvous class-set merge."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    n, d = 120, 6
+    X = rng.normal(size=(n, d))
+    coef = rng.normal(size=d)
+    y_lin = X @ coef + 0.5
+    y_log = (X @ coef + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    order = np.argsort(y_log, kind="stable")
+    return X[order], y_log[order], y_lin[order]
+
+
+def split_bounds(n, nranks):
+    """Deliberately ragged split: rank 0 gets ~60% of the rows."""
+    bounds = [0]
+    big = int(n * 0.6)
+    rest = n - big
+    per = rest // max(1, nranks - 1) if nranks > 1 else 0
+    bounds.append(big if nranks > 1 else n)
+    for r in range(1, nranks):
+        bounds.append(bounds[-1] + (per if r < nranks - 1 else n - bounds[-1]))
+    return bounds
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+#
+# Multi-process SPMD fit tests: N real OS processes, each holding a ragged
+# local row block, fit cooperatively through TpuContext(require_distributed=
+# True) over a FileRendezvous — the runtime analog of the reference's barrier
+# stage of one-task-per-GPU NCCL ranks (reference core.py:698-791 +
+# cuml_context.py:36-148). Results must match a single-process fit on the
+# concatenated dataset.
+#
+import os
+import subprocess
+import sys
+import uuid
+
+import numpy as np
+import pandas as pd
+import pytest
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+
+
+def _launch_workers(nranks, tmp_path, local_devices=2):
+    env = dict(os.environ)
+    # subprocesses must NOT grab the real TPU chip nor inherit the parent's
+    # 8-device CPU forcing: plain CPU backend with `local_devices` devices each
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={local_devices}"
+    env["JAX_ENABLE_X64"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rdv_dir = str(tmp_path / "rdv")
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir, exist_ok=True)
+    run_id = uuid.uuid4().hex  # launcher-minted nonce guards against stale rounds
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "mp_worker.py"),
+             str(r), str(nranks), rdv_dir, out_dir, run_id],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(nranks)
+    ]
+    outputs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+    return out_dir
+
+
+def _single_process_reference():
+    from tests.mp_worker import make_dataset
+
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.models.regression import LinearRegression
+
+    X, y_log, y_lin = make_dataset()
+    df = pd.DataFrame({"features": list(X), "label": y_log, "target": y_lin})
+    pca = PCA(k=3, inputCol="features", float32_inputs=False).fit(df)
+    lin = (
+        LinearRegression(regParam=0.0, float32_inputs=False, labelCol="target")
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    lr = (
+        LogisticRegression(maxIter=100, regParam=0.1, tol=1e-10, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    return pca, lin, lr
+
+
+@pytest.mark.parametrize("nranks", [2, 3])
+def test_multiprocess_fit_matches_single_process(nranks, tmp_path):
+    out_dir = _launch_workers(nranks, tmp_path)
+    pca, lin, lr = _single_process_reference()
+
+    for r in range(nranks):
+        got = np.load(os.path.join(out_dir, f"rank{r}.npz"))
+        np.testing.assert_allclose(got["pca_components"], pca.components_, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(got["pca_mean"], pca.mean_, rtol=1e-6, atol=1e-10)
+        np.testing.assert_allclose(
+            got["pca_var_ratio"], pca.explained_variance_ratio_, rtol=1e-6
+        )
+        np.testing.assert_allclose(got["lin_coef"], lin.coef_, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(got["lin_intercept"], lin.intercept_, rtol=1e-6, atol=1e-8)
+        # the SORTED labels mean later ranks hold a single class locally — the
+        # rendezvous class-merge must still find both classes globally
+        np.testing.assert_array_equal(got["lr_classes"], lr.classes_)
+        np.testing.assert_allclose(got["lr_coef"], lr.coef_, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(got["lr_intercept"], lr.intercept_, rtol=1e-4, atol=1e-6)
+
+
+def test_multiprocess_unsupported_estimator_raises(tmp_path):
+    # estimators without rendezvous-merged host stats must refuse SPMD fits
+    from spark_rapids_ml_tpu.core import _TpuCaller
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.models.tree import _RandomForestEstimator
+
+    assert not KMeans._supports_multiprocess
+    assert not _RandomForestEstimator._supports_multiprocess
+    assert not _TpuCaller._supports_multiprocess  # default is opt-in
+
+
+def test_multirank_context_requires_rendezvous():
+    from spark_rapids_ml_tpu.parallel import TpuContext
+
+    with pytest.raises(RuntimeError, match="rendezvous"):
+        with TpuContext(0, 2):
+            pass
