@@ -13,11 +13,12 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core import FitInputs, _TpuEstimator, _TpuModelWithColumns, pred
+from ..core import FitInputs, _TpuEstimator, _TpuModel, _TpuModelWithColumns, pred
 from ..data import ExtractedData
 from ..params import (
     HasFeaturesCol,
     HasFeaturesCols,
+    HasIDCol,
     HasPredictionCol,
     HasSeed,
     HasTol,
@@ -228,3 +229,203 @@ class KMeansModel(_KMeansParams, _TpuModelWithColumns):
             return kmeans_predict(xb.astype(dtype), state)
 
         return construct, predict, None
+
+
+class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol):
+    """Param surface of the reference's DBSCAN (reference clustering.py:522-639):
+    solver knobs are first-class Params (there is no pyspark DBSCAN to map from)."""
+
+    eps = Param(
+        "eps",
+        "maximum distance between 2 points such they reside in the same neighborhood",
+        TypeConverters.toFloat,
+    )
+    min_samples = Param(
+        "min_samples",
+        "number of samples in a neighborhood for a point to be a core point (incl. itself)",
+        TypeConverters.toInt,
+    )
+    metric = Param("metric", "distance metric: 'euclidean' or 'cosine'", TypeConverters.toString)
+    algorithm = Param("algorithm", "neighbor computation algorithm: 'brute' or 'rbc'", TypeConverters.toString)
+    max_mbytes_per_batch = Param(
+        "max_mbytes_per_batch",
+        "memory budget (MB) for each pairwise-distance tile — trades runtime for memory "
+        "on the N^2 distance computation",
+        TypeConverters.identity,
+    )
+    calc_core_sample_indices = Param(
+        "calc_core_sample_indices", "whether to compute core sample indices", TypeConverters.toBoolean
+    )
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # identity mapping: the Param names ARE the solver kwargs (no pyspark
+        # class exists to translate from; reference clustering.py:503-505 has
+        # an empty mapping for the same reason but syncs via shared names)
+        return {
+            "eps": "eps",
+            "min_samples": "min_samples",
+            "metric": "metric",
+            "algorithm": "algorithm",
+            "max_mbytes_per_batch": "max_mbytes_per_batch",
+            "calc_core_sample_indices": "calc_core_sample_indices",
+        }
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        # Param-tier defaults live HERE so a directly-constructed model resolves
+        # them too. calc_core_sample_indices follows the reference's Param tier
+        # (True, clustering.py:526-533) — its cuml tier says False but the Param
+        # default wins there as well.
+        self._setDefault(
+            eps=0.5, min_samples=5, metric="euclidean", algorithm="brute",
+            max_mbytes_per_batch=None, calc_core_sample_indices=True,
+        )
+
+    def _get_solver_params_default(self) -> Dict[str, Any]:
+        # reference clustering.py:508-515 defaults (Param tier overrides above)
+        return {
+            "eps": 0.5,
+            "min_samples": 5,
+            "metric": "euclidean",
+            "algorithm": "brute",
+            "verbose": False,
+            "max_mbytes_per_batch": None,
+            "calc_core_sample_indices": True,
+        }
+
+    def getEps(self) -> float:
+        return self.getOrDefault("eps")
+
+    def setEps(self, value: float):
+        return self._set_params(eps=value)
+
+    def getMinSamples(self) -> int:
+        return self.getOrDefault("min_samples")
+
+    def setMinSamples(self, value: int):
+        return self._set_params(min_samples=value)
+
+    def getMetric(self) -> str:
+        return self.getOrDefault("metric")
+
+    def setMetric(self, value: str):
+        return self._set_params(metric=value)
+
+    def setMaxMbytesPerBatch(self, value):
+        return self._set_params(max_mbytes_per_batch=value)
+
+    def getMaxMbytesPerBatch(self):
+        return self.getOrDefault("max_mbytes_per_batch")
+
+    def setFeaturesCol(self, value):
+        return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
+
+    def setPredictionCol(self, value: str):
+        return self._set_params(predictionCol=value)
+
+    def setIdCol(self, value: str):
+        return self._set_params(idCol=value)
+
+
+class DBSCAN(_DBSCANParams, _TpuEstimator):
+    """DBSCAN estimator (reference clustering.py:641-849).
+
+    Like the reference, ``fit`` is a no-op returning a parameter-copied model —
+    the clustering itself runs in ``model.transform`` because DBSCAN has no
+    train/inference split (reference clustering.py:820-833).
+
+    >>> model = DBSCAN(eps=0.5, min_samples=5).setFeaturesCol("features").fit(df)
+    >>> out = model.transform(df)   # df + prediction column, noise = -1
+
+    Distributed strategy: the dataset is replicated to every device and the N²
+    pairwise-distance work is row-sliced across the mesh (the reference's
+    broadcast + rank-sliced DBSCANMG, clustering.py:1013-1091) in three tiled
+    MXU passes — core mask, core-graph components by min-label propagation with
+    pointer jumping, border adoption. `max_mbytes_per_batch` bounds each
+    distance tile.
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._set_params(**kwargs)
+
+    def _set_params(self, **kwargs):
+        if kwargs.get("metric") == "precomputed":
+            raise ValueError(
+                "the 'precomputed' metric is not supported; use sklearn/cuML directly"
+            )
+        if "metric" in kwargs and kwargs["metric"] not in ("euclidean", "cosine"):
+            raise ValueError(f"metric must be 'euclidean' or 'cosine', got {kwargs['metric']!r}")
+        if "algorithm" in kwargs and kwargs["algorithm"] not in ("brute", "rbc"):
+            raise ValueError(f"algorithm must be 'brute' or 'rbc', got {kwargs['algorithm']!r}")
+        return super()._set_params(**kwargs)
+
+    def _get_tpu_fit_func(self, extracted: ExtractedData):  # pragma: no cover
+        raise NotImplementedError("DBSCAN does not fit and generate model (reference parity)")
+
+    def _fit_internal(self, dataset: Any, paramMaps):
+        # parameter-copied model(s), no data touched (reference
+        # clustering.py:820-833); one model per param map for fitMultiple
+        sources = [self.copy(pm) for pm in paramMaps] if paramMaps else [self]
+        models = []
+        for src in sources:
+            model = DBSCANModel(n_cols=0, dtype="")
+            src._copyValues(model)
+            src._copy_solver_params(model)
+            models.append(model)
+        return models
+
+    def _create_model(self, attrs):  # pragma: no cover - _fit_internal overridden
+        return DBSCANModel(**attrs)
+
+
+class DBSCANModel(_DBSCANParams, _TpuModel):
+    """DBSCAN 'model': runs the clustering inside transform and appends the
+    label column (reference clustering.py:852-1100).
+
+    `idCol` is accepted for API compatibility with the reference, which needs
+    an id join because Spark rows are unordered; the pandas path preserves row
+    order, so labels are attached positionally and the id column is left
+    untouched."""
+
+    def __init__(self, n_cols: int = 0, dtype: str = "", **kwargs: Any) -> None:
+        super().__init__(n_cols=n_cols, dtype=dtype)
+        self.n_cols = int(n_cols)
+        self.dtype = dtype
+        self.core_sample_indices_: Optional[np.ndarray] = None
+
+    def transform(self, dataset: Any):
+        from ..data import as_pandas
+        from ..ops.dbscan import dbscan_fit
+        from ..parallel import TpuContext, get_mesh
+        from ..parallel.mesh import default_devices, dtype_scope
+
+        active = TpuContext.current()
+        if active is not None and active.is_spmd:
+            # the compute lives in transform for DBSCAN, so the SPMD guard the
+            # other estimators apply at fit time applies here
+            raise NotImplementedError(
+                "DBSCANModel.transform does not support multi-process SPMD yet; "
+                "run it single-controller (one process driving all devices)"
+            )
+        pdf = as_pandas(dataset)
+        extracted = self._pre_process_data(dataset, for_fit=False)
+        feats = extracted.features
+        if hasattr(feats, "todense"):
+            feats = np.asarray(feats.todense())
+        n_dev = min(self.num_workers, len(default_devices()))
+        with dtype_scope(np.float32):
+            labels, core_idx = dbscan_fit(
+                np.asarray(feats, dtype=np.float32),
+                mesh=get_mesh(n_dev),
+                eps=float(self.getOrDefault("eps")),
+                min_samples=int(self.getOrDefault("min_samples")),
+                metric=self.getOrDefault("metric"),
+                max_mbytes_per_batch=self.getOrDefault("max_mbytes_per_batch"),
+                calc_core_sample_indices=bool(self.getOrDefault("calc_core_sample_indices")),
+            )
+        self.core_sample_indices_ = core_idx
+        out = pdf.copy(deep=False)
+        out[self.getOrDefault("predictionCol")] = labels.astype(np.int64)
+        return out

@@ -1,0 +1,151 @@
+#
+# DBSCAN tests vs sklearn (reference tests/test_dbscan.py pattern).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.models.clustering import DBSCAN, DBSCANModel
+
+
+def _df(x):
+    return pd.DataFrame({"features": list(x.astype(np.float64))})
+
+
+def _sk_labels(x, eps, min_samples, metric="euclidean"):
+    from sklearn.cluster import DBSCAN as SkDBSCAN
+
+    return SkDBSCAN(eps=eps, min_samples=min_samples, metric=metric).fit(x)
+
+
+def test_dbscan_blobs_exact_sklearn(rng):
+    from sklearn.datasets import make_blobs
+
+    x, _ = make_blobs(n_samples=500, centers=4, cluster_std=0.5, random_state=3)
+    model = DBSCAN(eps=0.8, min_samples=5).setFeaturesCol("features").fit(_df(x))
+    out = model.transform(_df(x))
+    sk = _sk_labels(x, 0.8, 5)
+    np.testing.assert_array_equal(out["prediction"].to_numpy(), sk.labels_)
+    np.testing.assert_array_equal(
+        np.sort(model.core_sample_indices_), np.sort(sk.core_sample_indices_)
+    )
+
+
+def test_dbscan_moons_and_noise(rng):
+    from sklearn.datasets import make_moons
+
+    x, _ = make_moons(n_samples=400, noise=0.05, random_state=1)
+    model = DBSCAN(eps=0.15, min_samples=5).setFeaturesCol("features").fit(_df(x))
+    out = model.transform(_df(x))
+    sk = _sk_labels(x, 0.15, 5)
+    np.testing.assert_array_equal(out["prediction"].to_numpy(), sk.labels_)
+
+    # uniform noise: mostly -1 labels, still exact
+    xn = rng.uniform(-5, 5, size=(300, 2))
+    m2 = DBSCAN(eps=0.3, min_samples=4).setFeaturesCol("features").fit(_df(xn))
+    sk2 = _sk_labels(xn, 0.3, 4)
+    np.testing.assert_array_equal(m2.transform(_df(xn))["prediction"].to_numpy(), sk2.labels_)
+    assert (sk2.labels_ == -1).any()  # the scenario actually has noise points
+
+
+def test_dbscan_border_points():
+    # handmade chain: two dense cores + one border point reachable from a core,
+    # one point out of reach (noise)
+    x = np.array(
+        [[0.0, 0], [0.1, 0], [0.2, 0], [0.3, 0],   # cluster 0 (core at 0.1/0.2)
+         [0.95, 0],                                  # border of cluster 0? no: out of eps
+         [5.0, 0], [5.1, 0], [5.2, 0], [5.3, 0],   # cluster 1
+         [5.75, 0],                                  # border: within eps of 5.3
+         [9.0, 0]]                                   # noise
+    )
+    model = DBSCAN(eps=0.5, min_samples=3).setFeaturesCol("features").fit(_df(x))
+    out = model.transform(_df(x))["prediction"].to_numpy()
+    sk = _sk_labels(x, 0.5, 3)
+    np.testing.assert_array_equal(out, sk.labels_)
+    assert out[-1] == -1
+
+
+def test_dbscan_cosine_metric(rng):
+    # rays from origin: cosine clusters by direction regardless of magnitude
+    angles = np.concatenate([rng.normal(0.0, 0.05, 40), rng.normal(1.5, 0.05, 40)])
+    r = rng.uniform(0.5, 3.0, 80)
+    x = np.stack([r * np.cos(angles), r * np.sin(angles)], axis=1)
+    model = DBSCAN(eps=0.02, min_samples=4, metric="cosine").setFeaturesCol("features").fit(_df(x))
+    out = model.transform(_df(x))["prediction"].to_numpy()
+    sk = _sk_labels(x, 0.02, 4, metric="cosine")
+    np.testing.assert_array_equal(out, sk.labels_)
+    assert out.max() == 1  # two directional clusters
+
+
+def test_dbscan_max_mbytes_tiling_invariance(rng):
+    from sklearn.datasets import make_blobs
+
+    x, _ = make_blobs(n_samples=300, centers=3, cluster_std=0.6, random_state=7)
+    base = DBSCAN(eps=0.9, min_samples=5).setFeaturesCol("features").fit(_df(x)).transform(_df(x))
+    tiny = (
+        DBSCAN(eps=0.9, min_samples=5, max_mbytes_per_batch=1)
+        .setFeaturesCol("features")
+        .fit(_df(x))
+        .transform(_df(x))
+    )
+    np.testing.assert_array_equal(base["prediction"].to_numpy(), tiny["prediction"].to_numpy())
+
+
+def test_dbscan_all_noise_and_single_cluster(rng):
+    x = rng.uniform(-100, 100, size=(50, 3))  # far apart: all noise
+    out = DBSCAN(eps=0.1, min_samples=3).setFeaturesCol("features").fit(_df(x)).transform(_df(x))
+    assert (out["prediction"].to_numpy() == -1).all()
+
+    x2 = rng.normal(size=(60, 3)) * 0.01  # one tight ball
+    out2 = DBSCAN(eps=0.5, min_samples=3).setFeaturesCol("features").fit(_df(x2)).transform(_df(x2))
+    assert (out2["prediction"].to_numpy() == 0).all()
+
+
+def test_dbscan_param_validation():
+    with pytest.raises(ValueError, match="precomputed"):
+        DBSCAN(metric="precomputed")
+    with pytest.raises(ValueError, match="metric"):
+        DBSCAN(metric="manhattan")
+    with pytest.raises(ValueError, match="algorithm"):
+        DBSCAN(algorithm="kdtree")
+    d = DBSCAN(eps=0.25, min_samples=7)
+    assert d.getEps() == 0.25
+    assert d.getMinSamples() == 7
+    assert d.solver_params["eps"] == 0.25
+
+
+def test_dbscan_fit_is_noop_and_persistence(tmp_path, rng):
+    x = rng.normal(size=(40, 2))
+    est = DBSCAN(eps=0.7, min_samples=4).setFeaturesCol("features")
+    model = est.fit(_df(x))  # must not touch the data distribution-wise
+    p = str(tmp_path / "dbscan")
+    model.write().overwrite().save(p)
+    loaded = DBSCANModel.load(p)
+    assert loaded.getEps() == 0.7
+    assert loaded.getMinSamples() == 4
+    np.testing.assert_array_equal(
+        loaded.transform(_df(x))["prediction"].to_numpy(),
+        model.transform(_df(x))["prediction"].to_numpy(),
+    )
+
+
+def test_dbscan_prediction_col_name(rng):
+    x = rng.normal(size=(30, 2))
+    model = (
+        DBSCAN(eps=0.5, min_samples=3)
+        .setFeaturesCol("features")
+        .setPredictionCol("cluster")
+        .fit(_df(x))
+    )
+    out = model.transform(_df(x))
+    assert "cluster" in out.columns
+
+
+def test_dbscan_fit_multiple_param_maps(rng):
+    x = rng.normal(size=(40, 2))
+    est = DBSCAN(eps=0.5, min_samples=3).setFeaturesCol("features")
+    grid = [{est.getParam("eps"): 0.3}, {est.getParam("eps"): 0.8}]
+    models = est.fit(_df(x), grid)
+    assert len(models) == 2
+    assert models[0].getEps() == 0.3 and models[1].getEps() == 0.8
+    assert models[0].solver_params["eps"] == 0.3
