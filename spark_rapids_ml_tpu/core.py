@@ -531,20 +531,25 @@ class _TpuWriter:
         with open(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(metadata, f, indent=2)
         if isinstance(inst, _TpuModel):
-            arrays = {}
-            scalars = {}
-            for k, v in inst._model_attributes.items():
-                if isinstance(v, np.ndarray):
-                    arrays[k] = v
-                elif isinstance(v, (list, tuple)) and len(v) and isinstance(v[0], np.ndarray):
-                    for i, a in enumerate(v):
-                        arrays[f"{k}__list{i}"] = a
-                    scalars[f"{k}__listlen"] = len(v)
-                else:
-                    scalars[k] = v
-            np.savez(os.path.join(path, "arrays.npz"), **arrays)
-            with open(os.path.join(path, "attributes.json"), "w") as f:
-                json.dump(scalars, f, default=_np_default)
+            self._write_model_attributes(inst, path)
+
+    def _write_model_attributes(self, inst: "_TpuModel", path: str) -> None:
+        """Array-serialization hook: npz bundle + JSON scalars by default;
+        subclasses may use a different sidecar format (UMAP's .npy layout)."""
+        arrays = {}
+        scalars = {}
+        for k, v in inst._model_attributes.items():
+            if isinstance(v, np.ndarray):
+                arrays[k] = v
+            elif isinstance(v, (list, tuple)) and len(v) and isinstance(v[0], np.ndarray):
+                for i, a in enumerate(v):
+                    arrays[f"{k}__list{i}"] = a
+                scalars[f"{k}__listlen"] = len(v)
+            else:
+                scalars[k] = v
+        np.savez(os.path.join(path, "arrays.npz"), **arrays)
+        with open(os.path.join(path, "attributes.json"), "w") as f:
+            json.dump(scalars, f, default=_np_default)
 
 
 class _TpuReader:
@@ -556,26 +561,36 @@ class _TpuReader:
             metadata = json.load(f)
         cls = self.cls
         if metadata["is_model"]:
-            scalars: Dict[str, Any] = {}
-            attrs_path = os.path.join(path, "attributes.json")
-            if os.path.exists(attrs_path):
-                with open(attrs_path) as f:
-                    scalars = json.load(f)
-            arrays_path = os.path.join(path, "arrays.npz")
-            attrs: Dict[str, Any] = {}
-            if os.path.exists(arrays_path):
-                with np.load(arrays_path, allow_pickle=False) as npz:
-                    attrs.update({k: npz[k] for k in npz.files})
-            # reassemble list-of-array attributes
-            list_lens = {k[: -len("__listlen")]: v for k, v in scalars.items() if k.endswith("__listlen")}
-            for base, ln in list_lens.items():
-                attrs[base] = [attrs.pop(f"{base}__list{i}") for i in range(ln)]
-                scalars.pop(f"{base}__listlen")
-            attrs.update(scalars)
+            attrs = self._read_model_attributes(path)
             inst = cls(**attrs)  # reference `_from_row` pattern (core.py:1150-1157)
             inst._model_attributes = attrs
         else:
             inst = cls()
+        self._restore_params(inst, metadata)
+        return inst
+
+    def _read_model_attributes(self, path: str) -> Dict[str, Any]:
+        """Inverse of `_TpuWriter._write_model_attributes` (hook for sidecar
+        format variants)."""
+        scalars: Dict[str, Any] = {}
+        attrs_path = os.path.join(path, "attributes.json")
+        if os.path.exists(attrs_path):
+            with open(attrs_path) as f:
+                scalars = json.load(f)
+        arrays_path = os.path.join(path, "arrays.npz")
+        attrs: Dict[str, Any] = {}
+        if os.path.exists(arrays_path):
+            with np.load(arrays_path, allow_pickle=False) as npz:
+                attrs.update({k: npz[k] for k in npz.files})
+        # reassemble list-of-array attributes
+        list_lens = {k[: -len("__listlen")]: v for k, v in scalars.items() if k.endswith("__listlen")}
+        for base, ln in list_lens.items():
+            attrs[base] = [attrs.pop(f"{base}__list{i}") for i in range(ln)]
+            scalars.pop(f"{base}__listlen")
+        attrs.update(scalars)
+        return attrs
+
+    def _restore_params(self, inst: Any, metadata: Dict[str, Any]) -> None:
         for name, v in metadata["defaultParamMap"].items():
             if inst.hasParam(name):
                 inst._defaultParamMap[inst.getParam(name)] = v

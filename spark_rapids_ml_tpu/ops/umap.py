@@ -1,0 +1,418 @@
+#
+# UMAP solver — the in-tree replacement for `cuml.manifold.UMAP` (consumed by
+# reference umap.py:928-950; the reference only orchestrates, cuML owns the
+# math, so this file implements the algorithm itself, matching umap-learn /
+# cuML semantics).
+#
+# TPU-native design:
+#  * the kNN graph comes from the exact sharded kNN solver (ops/knn.py) — the
+#    only O(n²) stage, tiled on the MXU across the mesh;
+#  * smooth-kNN calibration (per-point rho/sigma via bisection to hit
+#    log2(k) effective neighbors) is one vectorized jitted program — no
+#    per-point Python;
+#  * the fuzzy simplicial set stays in fixed [n, k] edge layout (static
+#    shapes); the transpose weights needed for symmetrization are looked up
+#    with a vectorized membership test instead of sparse-matrix ops;
+#  * the SGD layout optimization runs as a `lax.fori_loop` over epochs; each
+#    epoch applies ALL due edges at once (umap-learn's epochs_per_sample
+#    schedule), attraction via scatter-add on both endpoints, repulsion via
+#    per-edge negative samples — a parallel variant of umap-learn's
+#    sequential SGD with the same schedule and force model.
+#
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SMOOTH_K_TOLERANCE = 1e-5
+MIN_K_DIST_SCALE = 1e-3
+
+
+def find_ab_params(spread: float, min_dist: float) -> Tuple[float, float]:
+    """Fit the differentiable curve 1/(1+a*x^(2b)) to the desired fuzzy-member
+    curve (umap-learn's find_ab_params)."""
+    from scipy.optimize import curve_fit
+
+    def curve(x, a, b):
+        return 1.0 / (1.0 + a * x ** (2 * b))
+
+    xv = np.linspace(0, spread * 3, 300)
+    yv = np.ones_like(xv)
+    mask = xv >= min_dist
+    yv[mask] = np.exp(-(xv[mask] - min_dist) / spread)
+    params, _ = curve_fit(curve, xv, yv)
+    return float(params[0]), float(params[1])
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def smooth_knn(
+    knn_dist: jax.Array,  # [n, k] ascending distances, col 0 = self (0.0)
+    local_connectivity: float = 1.0,
+    bandwidth: float = 1.0,
+    n_iter: int = 64,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-point (rho, sigma): rho = distance to the local_connectivity-th
+    nearest neighbor (interpolated); sigma solves
+    sum_j exp(-max(0, d_ij - rho)/sigma) = log2(k) by bisection."""
+    n, k = knn_dist.shape
+    target = jnp.log2(k) * bandwidth
+
+    # rho: interpolated local_connectivity-th smallest NONZERO distance
+    nonzero = knn_dist > 0.0
+    num_nonzero = jnp.sum(nonzero, axis=1)
+    big = jnp.max(knn_dist) + 1.0
+    nz_sorted = jnp.sort(jnp.where(nonzero, knn_dist, big), axis=1)  # [n, k]
+    lc = jnp.asarray(local_connectivity, knn_dist.dtype)
+    idx = jnp.floor(lc).astype(jnp.int32) - 1
+    frac = lc - jnp.floor(lc)
+
+    def rho_of(row, nnz):
+        lo = jnp.where(idx >= 0, row[jnp.maximum(idx, 0)], 0.0)
+        hi = row[jnp.minimum(idx + 1, k - 1)]
+        interp = jnp.where(idx >= 0, lo + frac * (hi - lo), frac * row[0])
+        # umap-learn: if fewer nonzero distances than local_connectivity, rho
+        # is the max distance
+        return jnp.where(nnz >= lc, interp, jnp.where(nnz > 0, row[jnp.maximum(nnz - 1, 0)], 0.0))
+
+    rho = jax.vmap(rho_of)(nz_sorted, num_nonzero)
+
+    def psum_of(sigma):
+        d = jnp.maximum(knn_dist - rho[:, None], 0.0)
+        # col 0 is the self-distance: umap-learn sums over the k-1 others + 1
+        return jnp.sum(jnp.exp(-d / sigma[:, None]), axis=1)
+
+    lo = jnp.zeros(n, knn_dist.dtype)
+    hi = jnp.full(n, jnp.inf, knn_dist.dtype)
+    mid = jnp.ones(n, knn_dist.dtype)
+
+    def body(_, state):
+        lo, hi, mid = state
+        val = psum_of(mid)
+        too_big = val > target
+        hi = jnp.where(too_big, mid, hi)
+        lo = jnp.where(too_big, lo, mid)
+        mid = jnp.where(
+            too_big, (lo + hi) / 2.0, jnp.where(jnp.isinf(hi), mid * 2.0, (lo + hi) / 2.0)
+        )
+        return lo, hi, mid
+
+    _, _, sigma = jax.lax.fori_loop(0, n_iter, body, (lo, hi, mid))
+    # umap-learn floor: sigma >= MIN_K_DIST_SCALE * mean distance
+    mean_d = jnp.mean(knn_dist)
+    mean_row = jnp.mean(knn_dist, axis=1)
+    floor = jnp.where(rho > 0.0, MIN_K_DIST_SCALE * mean_row, MIN_K_DIST_SCALE * mean_d)
+    return rho, jnp.maximum(sigma, floor)
+
+
+@jax.jit
+def fuzzy_simplicial_set(
+    knn_idx: jax.Array,  # [n, k] neighbor indices (col 0 = self)
+    knn_dist: jax.Array,  # [n, k]
+    rho: jax.Array,
+    sigma: jax.Array,
+    set_op_mix_ratio: float = 1.0,
+) -> jax.Array:
+    """Symmetrized membership strengths in the fixed [n, k] edge layout.
+
+    w_ij = exp(-max(0, d_ij - rho_i)/sigma_i); the transpose entry w_ji is
+    found with a vectorized membership probe of i in knn[j], then
+    sym = mix*(w + wT - w*wT) + (1-mix)*(w*wT)."""
+    n, k = knn_idx.shape
+    w = jnp.exp(-jnp.maximum(knn_dist - rho[:, None], 0.0) / sigma[:, None])
+    w = jnp.where(knn_idx == jnp.arange(n)[:, None], 0.0, w)  # no self-edges
+
+    # wT[i, j_slot] = weight of edge (knn_idx[i, j_slot] -> i), 0 if absent
+    def row_transpose(i, neigh_row):
+        # neigh_row: [k] neighbor ids j; look for i in knn_idx[j]
+        cand_idx = knn_idx[neigh_row]  # [k, k]
+        cand_w = w[neigh_row]  # [k, k]
+        match = cand_idx == i
+        return jnp.sum(jnp.where(match, cand_w, 0.0), axis=1)
+
+    wT = jax.vmap(row_transpose)(jnp.arange(n), knn_idx)
+    prod = w * wT
+    return set_op_mix_ratio * (w + wT - prod) + (1.0 - set_op_mix_ratio) * prod
+
+
+def spectral_init(
+    knn_idx: np.ndarray, weights: np.ndarray, n_components: int, seed: int
+) -> np.ndarray:
+    """Normalized-Laplacian spectral layout of the fuzzy graph (host scipy,
+    like umap-learn's spectral_layout); falls back to scaled random noise if
+    the eigensolver fails."""
+    import scipy.sparse as sp
+    import scipy.sparse.linalg as spl
+
+    n, k = knn_idx.shape
+    rows = np.repeat(np.arange(n), k)
+    cols = knn_idx.reshape(-1)
+    vals = weights.reshape(-1)
+    g = sp.coo_matrix((vals, (rows, cols)), shape=(n, n))
+    g = (g + g.T) / 2.0
+    g = g.tocsr()
+    deg = np.asarray(g.sum(axis=1)).ravel()
+    d_inv_sqrt = 1.0 / np.sqrt(np.maximum(deg, 1e-12))
+    lap = sp.identity(n) - sp.diags(d_inv_sqrt) @ g @ sp.diags(d_inv_sqrt)
+    try:
+        num = n_components + 1
+        vals_, vecs = spl.eigsh(lap, k=num, sigma=0.0, which="LM", tol=1e-4, maxiter=n * 5)
+        order = np.argsort(vals_)[1 : n_components + 1]
+        emb = vecs[:, order]
+        expansion = 10.0 / max(np.abs(emb).max(), 1e-12)
+        rng = np.random.default_rng(seed)
+        return (emb * expansion + rng.normal(0, 1e-4, emb.shape)).astype(np.float32)
+    except (spl.ArpackError, RuntimeError, np.linalg.LinAlgError) as e:
+        # disconnected graphs / ARPACK non-convergence: umap-learn warns and
+        # falls back the same way — make the degradation visible
+        from ..utils import get_logger
+
+        get_logger("UMAP").warning(
+            "spectral initialization failed (%s: %s); falling back to random init",
+            type(e).__name__, e,
+        )
+        rng = np.random.default_rng(seed)
+        return rng.uniform(-10, 10, (n, n_components)).astype(np.float32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_epochs", "negative_sample_rate", "fit_mode"),
+)
+def optimize_embedding(
+    Y0: jax.Array,  # [n, c] initial embedding (optimized rows)
+    ref: jax.Array,  # [m, c] frozen reference embedding (transform mode)
+    head_idx: jax.Array,  # [E] row of Y0 per edge
+    tail_idx: jax.Array,  # [E] row of the tail set per edge
+    weights: jax.Array,  # [E] membership strengths
+    *,
+    n_epochs: int,
+    a: float,
+    b: float,
+    gamma: float = 1.0,
+    initial_alpha: float = 1.0,
+    negative_sample_rate: int = 5,
+    fit_mode: bool = True,
+    seed: int = 0,
+) -> jax.Array:
+    """Parallel epoch-scheduled SGD over the fuzzy graph (umap-learn's
+    optimize_layout_euclidean force model and epochs_per_sample schedule,
+    applied to all due edges at once with scatter-add updates).
+
+    `fit_mode=True`: tails index the OPTIMIZED embedding and both edge ends
+    move. `fit_mode=False` (transform): tails index the frozen `ref`."""
+    E = head_idx.shape[0]
+    n, c = Y0.shape
+    w_max = jnp.max(weights)
+    eps_per_sample = jnp.where(weights > 0, w_max / jnp.maximum(weights, 1e-12), jnp.inf)
+
+    def clip(g):
+        return jnp.clip(g, -4.0, 4.0)
+
+    def epoch(e, state):
+        Y, next_due = state
+        ef = e.astype(Y.dtype)
+        alpha = initial_alpha * (1.0 - ef / n_epochs)
+        due = next_due <= ef
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), e)
+
+        tails = Y if fit_mode else ref
+        yh = Y[head_idx]  # [E, c]
+        yt = tails[tail_idx]
+        diff = yh - yt
+        d2 = jnp.sum(diff * diff, axis=1)
+        # attraction: d/dy of the a,b membership curve — the d2^(b-1) factor
+        # (negative exponent for the default b≈0.9) needs a zero guard, not an
+        # exponent clamp, to keep the true force model
+        d2_safe = jnp.where(d2 > 0, d2, 1.0)
+        att = (-2.0 * a * b * d2_safe ** (b - 1.0)) / (1.0 + a * d2**b)
+        att = jnp.where(d2 > 0, att, 0.0)
+        g_att = clip(att[:, None] * diff) * jnp.where(due, 1.0, 0.0)[:, None]
+        delta = jnp.zeros((n, c), Y.dtype).at[head_idx].add(alpha * g_att)
+        if fit_mode:
+            delta = delta.at[tail_idx].add(-alpha * g_att)
+
+        # repulsion: negative samples drawn from the tail set
+        m = tails.shape[0]
+        neg = jax.random.randint(key, (E, negative_sample_rate), 0, m)
+        yn = tails[neg]  # [E, S, c]
+        diff_n = yh[:, None, :] - yn
+        d2n = jnp.sum(diff_n * diff_n, axis=2)
+        rep = (2.0 * gamma * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
+        g_rep = clip(rep[..., None] * diff_n)
+        # coincident-but-distinct points repel with the clip bound; a point
+        # drawn as its own negative contributes nothing (umap-learn skips it)
+        g_rep = jnp.where(d2n[..., None] > 0, g_rep, 4.0)
+        if fit_mode:
+            self_hit = neg == head_idx[:, None]
+            g_rep = jnp.where(self_hit[..., None], 0.0, g_rep)
+        g_rep = g_rep * jnp.where(due, 1.0, 0.0)[:, None, None]
+        delta = delta.at[head_idx].add(alpha * jnp.sum(g_rep, axis=1))
+
+        next_due = jnp.where(due, next_due + eps_per_sample, next_due)
+        return Y + delta, next_due
+
+    Y, _ = jax.lax.fori_loop(0, n_epochs, epoch, (Y0, eps_per_sample - 1.0))
+    return Y
+
+
+def default_n_epochs(n: int) -> int:
+    return 500 if n <= 10000 else 200
+
+
+def build_knn_graph(
+    x: np.ndarray, n_neighbors: int, mesh, batch_queries: int = 4096
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact kNN graph incl. self in column 0: ([n, k] idx, [n, k] dist)."""
+    from ..parallel.mesh import make_global_rows
+    from .knn import exact_knn
+
+    from jax import device_put
+
+    xf = np.ascontiguousarray(x, dtype=np.float32)
+    X, w, _ = make_global_rows(mesh, xf)
+    Q = device_put(xf)
+    dist, idx = exact_knn(X, w > 0, Q, mesh=mesh, k=n_neighbors, batch_queries=batch_queries)
+    dist = np.array(dist, dtype=np.float32)  # writable copies: fixed up below
+    idx = np.array(idx)
+    # guarantee self in column 0 (ties can reorder equal-distance neighbors)
+    n = xf.shape[0]
+    row = np.arange(n)
+    self_pos = np.argmax(idx == row[:, None], axis=1)
+    has_self = (idx == row[:, None]).any(axis=1)
+    for i in np.flatnonzero(~has_self):  # degenerate duplicates: force self
+        idx[i, -1] = i
+        dist[i, -1] = 0.0
+        self_pos[i] = n_neighbors - 1
+    idx[row, self_pos], idx[:, 0] = idx[:, 0].copy(), row
+    dist[row, self_pos], dist[:, 0] = dist[:, 0].copy(), 0.0
+    return idx, dist
+
+
+def categorical_intersection(
+    weights: np.ndarray, knn_idx: np.ndarray, labels: np.ndarray, far_dist: float = 5.0
+) -> np.ndarray:
+    """Supervised fit: intersect the fuzzy set with the label metric —
+    different-label edges are downweighted by exp(-far_dist) (umap-learn's
+    categorical_simplicial_set_intersection with unknown labels untouched)."""
+    lab_i = labels[:, None]
+    lab_j = labels[knn_idx]
+    known = ~(np.isnan(lab_i) | np.isnan(lab_j))
+    differ = known & (lab_i != lab_j)
+    return np.where(differ, weights * np.exp(-far_dist), weights).astype(weights.dtype)
+
+
+def umap_fit(
+    x: np.ndarray,
+    y: Optional[np.ndarray],
+    *,
+    mesh,
+    n_neighbors: int = 15,
+    n_components: int = 2,
+    n_epochs: Optional[int] = None,
+    learning_rate: float = 1.0,
+    init: str = "spectral",
+    min_dist: float = 0.1,
+    spread: float = 1.0,
+    set_op_mix_ratio: float = 1.0,
+    local_connectivity: float = 1.0,
+    repulsion_strength: float = 1.0,
+    negative_sample_rate: int = 5,
+    a: Optional[float] = None,
+    b: Optional[float] = None,
+    random_state: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """Full UMAP fit; returns {'embedding_': [n, c]} plus graph internals."""
+    n = x.shape[0]
+    k = min(n_neighbors, n)
+    seed = int(random_state if random_state is not None else 0)
+    if a is None or b is None:
+        a, b = find_ab_params(spread, min_dist)
+    n_epochs = int(n_epochs) if n_epochs else default_n_epochs(n)
+
+    knn_idx, knn_dist = build_knn_graph(x, k, mesh)
+    rho, sigma = smooth_knn(jnp.asarray(knn_dist), local_connectivity)
+    w = np.asarray(fuzzy_simplicial_set(
+        jnp.asarray(knn_idx), jnp.asarray(knn_dist), rho, sigma, set_op_mix_ratio
+    ))
+    if y is not None:
+        w = categorical_intersection(w, knn_idx, np.asarray(y, dtype=np.float64))
+
+    if init == "spectral":
+        Y0 = spectral_init(knn_idx, w, n_components, seed)
+    else:
+        Y0 = np.random.default_rng(seed).uniform(-10, 10, (n, n_components)).astype(np.float32)
+
+    # umap-learn drops edges below max_w/n_epochs before optimization
+    w_opt = np.where(w >= w.max() / float(n_epochs), w, 0.0)
+    head = np.repeat(np.arange(n, dtype=np.int32), k)
+    tail = knn_idx.reshape(-1).astype(np.int32)
+    Y0j = jnp.asarray(Y0)
+    Y = optimize_embedding(
+        Y0j, Y0j, jnp.asarray(head), jnp.asarray(tail), jnp.asarray(w_opt.reshape(-1)),
+        n_epochs=n_epochs, a=float(a), b=float(b), gamma=float(repulsion_strength),
+        initial_alpha=float(learning_rate), negative_sample_rate=int(negative_sample_rate),
+        fit_mode=True, seed=seed,
+    )
+    return {
+        "embedding_": np.asarray(Y, dtype=np.float32),
+        "a_": np.float64(a),
+        "b_": np.float64(b),
+    }
+
+
+def umap_transform(
+    x_new: np.ndarray,
+    raw_data: np.ndarray,
+    embedding: np.ndarray,
+    *,
+    mesh,
+    n_neighbors: int = 15,
+    n_epochs: Optional[int] = None,
+    learning_rate: float = 1.0,
+    local_connectivity: float = 1.0,
+    repulsion_strength: float = 1.0,
+    negative_sample_rate: int = 5,
+    a: float = 1.577,
+    b: float = 0.895,
+    random_state: Optional[int] = None,
+) -> np.ndarray:
+    """Embed NEW points against a fitted model: kNN into the training set,
+    smooth-kNN weights, init at the weighted mean of neighbor embeddings, then
+    a short optimization against the FROZEN training embedding (umap-learn
+    transform semantics)."""
+    from ..parallel.mesh import make_global_rows
+    from .knn import exact_knn
+
+    n_new = x_new.shape[0]
+    k = min(n_neighbors, raw_data.shape[0])
+    seed = int(random_state if random_state is not None else 0)
+
+    X, w_mask, _ = make_global_rows(mesh, np.ascontiguousarray(raw_data, dtype=np.float32))
+    dist, idx = exact_knn(
+        X, w_mask > 0, jax.device_put(np.ascontiguousarray(x_new, dtype=np.float32)),
+        mesh=mesh, k=k,
+    )
+    dist = np.asarray(dist, np.float32)
+    idx = np.asarray(idx)
+
+    rho, sigma = smooth_knn(jnp.asarray(dist), local_connectivity)
+    wgt = np.asarray(jnp.exp(-jnp.maximum(jnp.asarray(dist) - np.asarray(rho)[:, None], 0.0)
+                             / np.asarray(sigma)[:, None]))
+    wsum = np.maximum(wgt.sum(axis=1, keepdims=True), 1e-12)
+    Y0 = (wgt[:, :, None] * embedding[idx]).sum(axis=1) / wsum
+
+    total_epochs = int(n_epochs) if n_epochs else max(default_n_epochs(raw_data.shape[0]) // 3, 30)
+    head = np.repeat(np.arange(n_new, dtype=np.int32), k)
+    tail = idx.reshape(-1).astype(np.int32)
+    Y = optimize_embedding(
+        jnp.asarray(Y0.astype(np.float32)), jnp.asarray(embedding.astype(np.float32)),
+        jnp.asarray(head), jnp.asarray(tail), jnp.asarray(wgt.reshape(-1)),
+        n_epochs=total_epochs, a=float(a), b=float(b), gamma=float(repulsion_strength),
+        initial_alpha=float(learning_rate), negative_sample_rate=int(negative_sample_rate),
+        fit_mode=False, seed=seed,
+    )
+    return np.asarray(Y, dtype=np.float32)

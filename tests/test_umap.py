@@ -1,0 +1,157 @@
+#
+# UMAP tests (reference tests/test_umap.py pattern): embedding quality via
+# trustworthiness, supervised fit, transform consistency, persistence.
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
+
+
+def _blobs(n=600, d=10, k=5, seed=0):
+    from sklearn.datasets import make_blobs
+
+    x, y = make_blobs(n_samples=n, centers=k, n_features=d, cluster_std=1.0, random_state=seed)
+    return x.astype(np.float64), y
+
+
+def _df(x, y=None):
+    d = {"features": list(x)}
+    if y is not None:
+        d["label"] = y.astype(np.float64)
+    return pd.DataFrame(d)
+
+
+def test_umap_fit_quality_trustworthiness():
+    from sklearn.manifold import trustworthiness
+
+    x, y = _blobs()
+    model = UMAP(n_components=2, random_state=42).setFeaturesCol("features").fit(_df(x))
+    emb = np.asarray(model.embedding_)
+    assert emb.shape == (600, 2)
+    tw = trustworthiness(x, emb, n_neighbors=15)
+    assert tw > 0.90, tw
+
+
+def test_umap_separates_blobs():
+    from sklearn.metrics import silhouette_score
+
+    x, y = _blobs()
+    model = UMAP(n_components=2, random_state=1).setFeaturesCol("features").fit(_df(x))
+    score = silhouette_score(model.embedding_, y)
+    assert score > 0.7, score  # well-separated blobs stay separated
+
+
+def test_umap_transform_matches_fit_points():
+    x, y = _blobs(n=400)
+    model = UMAP(n_components=2, random_state=7).setFeaturesCol("features").fit(_df(x))
+    out = model.transform(_df(x[:80] + 0.01))
+    assert model.getOutputCol() in out.columns and "features" in out.columns
+    emb_new = np.stack(out[model.getOutputCol()].to_list())
+    # near-duplicates of training points must land near their trained embedding
+    d = np.linalg.norm(emb_new - model.embedding_[:80], axis=1)
+    scale = np.abs(model.embedding_).max()
+    assert np.median(d) < 0.15 * scale, (np.median(d), scale)
+
+
+def test_umap_supervised_improves_separation():
+    from sklearn.metrics import silhouette_score
+
+    # genuinely overlapping clusters (std comparable to center spread, so the
+    # kNN graph has cross-label edges): labels must pull classes apart
+    from sklearn.datasets import make_blobs
+
+    x, y = make_blobs(
+        n_samples=500, centers=3, n_features=8, cluster_std=6.0, random_state=5
+    )
+    x = x.astype(np.float64)
+    un = UMAP(n_components=2, random_state=3).setFeaturesCol("features").fit(_df(x))
+    sup = (
+        UMAP(n_components=2, random_state=3)
+        .setFeaturesCol("features")
+        .setLabelCol("label")
+        .fit(_df(x, y))
+    )
+    s_un = silhouette_score(un.embedding_, y)
+    s_sup = silhouette_score(sup.embedding_, y)
+    assert s_sup > s_un, (s_sup, s_un)
+
+
+def test_umap_random_init_and_epochs():
+    x, _ = _blobs(n=200)
+    m = (
+        UMAP(n_components=2, init="random", n_epochs=50, random_state=0)
+        .setFeaturesCol("features")
+        .fit(_df(x))
+    )
+    assert np.isfinite(m.embedding_).all()
+
+
+def test_umap_sample_fraction():
+    x, _ = _blobs(n=400)
+    m = (
+        UMAP(n_components=2, sample_fraction=0.5, random_state=0)
+        .setFeaturesCol("features")
+        .fit(_df(x))
+    )
+    assert 100 < m.embedding_.shape[0] < 300  # ~200 rows kept
+    assert m.raw_data_.shape[0] == m.embedding_.shape[0]
+
+
+def test_umap_persistence_npy_sidecar(tmp_path):
+    x, _ = _blobs(n=150)
+    model = UMAP(n_components=2, random_state=11).setFeaturesCol("features").fit(_df(x))
+    p = str(tmp_path / "umap")
+    model.write().overwrite().save(p)
+    import os
+
+    assert os.path.exists(os.path.join(p, "data", "embedding_.npy"))
+    assert os.path.exists(os.path.join(p, "data", "raw_data_.npy"))
+    loaded = UMAPModel.load(p)
+    np.testing.assert_array_equal(loaded.embedding_, model.embedding_)
+    np.testing.assert_array_equal(loaded.raw_data_, model.raw_data_)
+    assert loaded.a_ == model.a_ and loaded.b_ == model.b_
+    out1 = model.transform(_df(x[:20]))
+    out2 = loaded.transform(_df(x[:20]))
+    np.testing.assert_allclose(
+        np.stack(out1[model.getOutputCol()].to_list()),
+        np.stack(out2[model.getOutputCol()].to_list()),
+        rtol=1e-6,
+    )
+
+
+def test_umap_param_surface_and_validation():
+    u = UMAP(n_neighbors=10, min_dist=0.25, spread=2.0)
+    assert u.getNNeighbors() == 10
+    assert u.getMinDist() == 0.25
+    assert u.solver_params["min_dist"] == 0.25
+    u.setNComponents(3)
+    assert u.getNComponents() == 3
+    with pytest.raises(ValueError, match="metric"):
+        UMAP(metric="manhattan")
+    with pytest.raises(ValueError, match="init"):
+        UMAP(init="pca")
+    with pytest.raises(ValueError, match="precomputed_knn"):
+        UMAP(precomputed_knn=[[0, 1]])
+
+
+def test_umap_smooth_knn_hits_target():
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.umap import smooth_knn
+
+    rng = np.random.default_rng(0)
+    d = np.sort(rng.uniform(0.1, 2.0, size=(50, 15)), axis=1)
+    d[:, 0] = 0.0  # self
+    rho, sigma = smooth_knn(jnp.asarray(d.astype(np.float32)))
+    psum = np.sum(np.exp(-np.maximum(d - np.asarray(rho)[:, None], 0) / np.asarray(sigma)[:, None]), axis=1)
+    np.testing.assert_allclose(psum, np.log2(15), rtol=1e-3)
+
+
+def test_umap_find_ab_params():
+    from spark_rapids_ml_tpu.ops.umap import find_ab_params
+
+    a, b = find_ab_params(1.0, 0.1)
+    # umap-learn's canonical values for spread=1, min_dist=0.1
+    assert abs(a - 1.577) < 0.05 and abs(b - 0.895) < 0.02
