@@ -1,0 +1,139 @@
+#
+# OWL-QN (Orthant-Wise Limited-memory Quasi-Newton) — the L1-capable solver
+# behind the reference's full logistic penalty surface
+# (`LogisticRegressionMG(penalty='l1'/'elasticnet')`, reference
+# classification.py:1051-1057; cuML's qn solver implements the same
+# Andrew & Gao 2007 algorithm).
+#
+# TPU-native form: the entire minimization is ONE jitted `lax.while_loop` —
+# fixed-size circular (s, y) history buffers, a two-loop recursion unrolled
+# with `lax.fori_loop`, orthant projection as masked `where`s, and a
+# backtracking line search as an inner while_loop. Every objective/gradient
+# evaluation inside is whatever SPMD program the caller's `smooth_f` closes
+# over (matmul+psum over the mesh), so the solver itself adds no collectives.
+#
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def owlqn_minimize(
+    smooth_f: Callable[[jax.Array], jax.Array],
+    x0: jax.Array,  # flat [n]
+    l1_mask: jax.Array,  # [n]: per-coordinate L1 weight multiplier (0 = unpenalized)
+    lam1: float,
+    *,
+    max_iter: int,
+    tol: float,
+    memory: int = 10,
+    ls_max: int = 25,
+    c1: float = 1e-4,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Minimize smooth_f(x) + lam1 * sum(l1_mask * |x|).
+
+    Returns (x, objective, n_iter). With lam1=0 this degrades to plain
+    two-loop L-BFGS (used as the common path for testing)."""
+    n = x0.shape[0]
+    m = memory
+    lam = lam1 * l1_mask
+    grad_f = jax.grad(smooth_f)
+
+    def f_total(x):
+        return smooth_f(x) + jnp.sum(lam * jnp.abs(x))
+
+    def pseudo_grad(x, g):
+        at0 = jnp.where(g + lam < 0, g + lam, jnp.where(g - lam > 0, g - lam, 0.0))
+        return jnp.where(x > 0, g + lam, jnp.where(x < 0, g - lam, at0))
+
+    def two_loop(pg, S, Y, rho, count, pos):
+        # newest-to-oldest: q -= alpha_j * y_j; oldest-to-newest: add back
+        def bwd(i, carry):
+            q, alphas = carry
+            j = (pos - 1 - i) % m
+            valid = i < count
+            a = jnp.where(valid, rho[j] * jnp.dot(S[j], q), 0.0)
+            q = q - jnp.where(valid, a, 0.0) * Y[j]
+            return q, alphas.at[j].set(a)
+
+        q, alphas = jax.lax.fori_loop(0, m, bwd, (pg, jnp.zeros((m,), pg.dtype)))
+        # initial Hessian scaling from the newest pair
+        newest = (pos - 1) % m
+        sy = jnp.dot(S[newest], Y[newest])
+        yy = jnp.dot(Y[newest], Y[newest])
+        gamma = jnp.where((count > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0)
+        r = gamma * q
+
+        def fwd(i, r):
+            j = (pos - count + i) % m
+            valid = i < count
+            beta = jnp.where(valid, rho[j] * jnp.dot(Y[j], r), 0.0)
+            return r + jnp.where(valid, alphas[j] - beta, 0.0) * S[j]
+
+        r = jax.lax.fori_loop(0, m, fwd, r)
+        return -r  # descent direction for the PSEUDO gradient
+
+    def line_search(x, d, f0, pg, xi):
+        # backtracking with orthant projection: candidate = pi(x + a*d; xi)
+        def proj(z):
+            return jnp.where(z * xi < 0, 0.0, z)
+
+        def cond(carry):
+            a, ok, it = carry[0], carry[3], carry[4]
+            return jnp.logical_and(~ok, it < ls_max)
+
+        def body(carry):
+            a, _, _, _, it = carry
+            xn = proj(x + a * d)
+            fn = f_total(xn)
+            # Armijo on the projected step against the pseudo-gradient
+            ok = fn <= f0 + c1 * jnp.dot(pg, xn - x)
+            return jnp.where(ok, a, a * 0.5), xn, fn, ok, it + 1
+
+        a0 = jnp.asarray(1.0, x.dtype)
+        _, xn, fn, ok, _ = jax.lax.while_loop(
+            cond, body, (a0, x, f0, jnp.asarray(False), jnp.asarray(0, jnp.int32))
+        )
+        return xn, fn, ok
+
+    def cond(state):
+        _, _, _, _, _, _, f_prev, f_cur, it, stalled = state
+        rel = jnp.abs(f_prev - f_cur) / jnp.maximum(jnp.abs(f_cur), 1.0)
+        return jnp.logical_and(jnp.logical_and(it < max_iter, rel > tol), ~stalled)
+
+    def body(state):
+        x, g, S, Y, rho, meta, f_prev, f_cur, it, _ = state
+        count, pos = meta
+        pg = pseudo_grad(x, g)
+        d = two_loop(pg, S, Y, rho, count, pos)
+        # orthant-wise sign alignment: drop components fighting the pseudo-grad
+        d = jnp.where(d * (-pg) > 0, d, 0.0)
+        xi = jnp.where(x != 0, jnp.sign(x), jnp.sign(-pg))
+        xn, fn, ok = line_search(x, d, f_cur, pg, xi)
+        gn = grad_f(xn)
+        s = xn - x
+        y = gn - g
+        sy = jnp.dot(s, y)
+        do_update = ok & (sy > 1e-10)
+        S = jnp.where(do_update, S.at[pos].set(s), S)
+        Y = jnp.where(do_update, Y.at[pos].set(y), Y)
+        rho = jnp.where(do_update, rho.at[pos].set(1.0 / jnp.maximum(sy, 1e-30)), rho)
+        count = jnp.where(do_update, jnp.minimum(count + 1, m), count)
+        pos = jnp.where(do_update, (pos + 1) % m, pos)
+        x = jnp.where(ok, xn, x)
+        g = jnp.where(ok, gn, g)
+        f_new = jnp.where(ok, fn, f_cur)
+        return x, g, S, Y, rho, (count, pos), f_cur, f_new, it + 1, ~ok
+
+    g0 = grad_f(x0)
+    f0 = f_total(x0)
+    state0 = (
+        x0, g0,
+        jnp.zeros((m, n), x0.dtype), jnp.zeros((m, n), x0.dtype), jnp.zeros((m,), x0.dtype),
+        (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
+        jnp.asarray(jnp.inf, x0.dtype), f0, jnp.asarray(0, jnp.int32), jnp.asarray(False),
+    )
+    x, _, _, _, _, _, _, obj, n_iter, _ = jax.lax.while_loop(cond, body, state0)
+    return x, obj, n_iter
