@@ -112,6 +112,9 @@ class _TpuCommon(_TpuParams):
     _supports_sparse_input: bool = False
     _supervised: bool = False
     _use_weight_col: bool = True
+    # Per-solver MXU precision policy (see parallel/mesh.py dtype_scope):
+    # "float32" unless the solver's numeric contract tolerates fewer passes.
+    _matmul_precision: str = "float32"
 
     def _pre_process_data(self, dataset: Any, for_fit: bool = True) -> ExtractedData:
         """Column selection + dense/CSR extraction (reference core.py:458-557)."""
@@ -266,7 +269,7 @@ class _TpuCaller(_TpuCommon):
             )
 
         with ctx_mgr as ctx, dtype_scope(
-            np.float32 if self._float32_inputs else np.float64
+            np.float32 if self._float32_inputs else np.float64, self._matmul_precision
         ):
             inputs = self._build_fit_inputs(extracted, ctx)
             logger.info(
@@ -450,7 +453,9 @@ class _TpuModelWithColumns(_TpuModel):
         is concatenated across batches."""
         from .parallel.mesh import dtype_scope
 
-        with dtype_scope(np.float32 if self._float32_inputs else np.float64):
+        with dtype_scope(
+            np.float32 if self._float32_inputs else np.float64, self._matmul_precision
+        ):
             construct, predict, _ = self._get_transform_func()
             state = construct()
             n = features.shape[0]

@@ -262,11 +262,6 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             alpha = float(params["alpha"])
             l1_ratio = float(params["l1_ratio"])
-            if alpha > 0 and l1_ratio > 0:
-                raise ValueError(
-                    "L1/ElasticNet logistic regression is not supported yet; "
-                    "set elasticNetParam=0.0"
-                )
             # class set must be GLOBAL: merge each rank's local label values
             # (the reference gets this for free because cuML's qn fit allgathers
             # label cardinality internally)
@@ -298,6 +293,8 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 k=k,
                 multinomial=multinomial,
                 lam_l2=alpha * (1.0 - l1_ratio),
+                lam_l1=alpha * l1_ratio,
+                use_l1=alpha * l1_ratio > 0,
                 fit_intercept=bool(params["fit_intercept"]),
                 standardize=bool(params["standardization"]),
                 max_iter=int(params["max_iter"]),

@@ -86,6 +86,10 @@ class KMeans(_KMeansParams, _TpuEstimator):
     clustering.py:339-384).
     """
 
+    # Lloyd's argmin assignment tolerates the 3-pass MXU mode; the center-update
+    # reductions are plain f32 sums — see dtype_scope (parallel/mesh.py) policy.
+    _matmul_precision = "BF16_BF16_F32_X3"
+
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._setDefault(k=2, initMode="k-means||", initSteps=2, maxIter=20, tol=1e-4, seed=1,
@@ -164,6 +168,8 @@ class KMeans(_KMeansParams, _TpuEstimator):
 
 class KMeansModel(_KMeansParams, _TpuModelWithColumns):
     """Fitted KMeans model (reference clustering.py:386-499)."""
+
+    _matmul_precision = "BF16_BF16_F32_X3"
 
     def __init__(
         self,
@@ -291,7 +297,7 @@ class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol)
             "algorithm": "brute",
             "verbose": False,
             "max_mbytes_per_batch": None,
-            "calc_core_sample_indices": True,
+            "calc_core_sample_indices": False,  # cuml-tier default (reference clustering.py:513); Param tier above wins
         }
 
     def getEps(self) -> float:
@@ -425,6 +431,12 @@ class DBSCANModel(_DBSCANParams, _TpuModel):
                 max_mbytes_per_batch=self.getOrDefault("max_mbytes_per_batch"),
                 calc_core_sample_indices=bool(self.getOrDefault("calc_core_sample_indices")),
             )
+        # labels attach positionally: _pre_process_data must not drop/reorder rows
+        assert len(labels) == len(pdf), (
+            f"row count mismatch: {len(labels)} labels vs {len(pdf)} input rows"
+        )
+        # most-recent-transform state, mirroring cuML's fit_predict attribute;
+        # concurrent transforms of one model should each use their own copy()
         self.core_sample_indices_ = core_idx
         out = pdf.copy(deep=False)
         out[self.getOrDefault("predictionCol")] = labels.astype(np.int64)

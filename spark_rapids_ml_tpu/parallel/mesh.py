@@ -79,9 +79,9 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 @contextlib.contextmanager
-def dtype_scope(dtype):
+def dtype_scope(dtype, matmul_precision: str = "float32"):
     """Numerics context for the framework's own computations: real f64 when
-    asked for, and FULL-float32 matmuls always.
+    asked for, and a PER-SOLVER matmul precision.
 
     - JAX's default `jax_enable_x64=False` silently downcasts f64 to f32; a user
       who passed ``float32_inputs=False`` asked for double precision (the
@@ -89,16 +89,30 @@ def dtype_scope(dtype):
       flag is enabled via the scoped context so the user's own JAX code keeps
       its default semantics.
     - TPU matmuls default to one-pass bf16 on the MXU (~3 decimal digits) —
-      fine for neural nets, wrong for classical ML: kNN distance expansions,
-      covariance/gram accumulations and L-BFGS gradients all lose parity
-      (observed ~2% distance error on a v5e chip). `default_matmul_precision
-      ("float32")` selects the multi-pass full-f32 MXU mode, restoring
-      CPU-equivalent f32 accuracy; CPU/GPU backends are unaffected.
+      fine for neural nets, wrong for most classical ML. Each solver picks the
+      cheapest precision that preserves its numeric contract via the estimator's
+      `_matmul_precision` attribute (plumbed here by core._call_fit_func):
+
+        * ``"float32"`` (default, 6-pass MXU): CPU-equivalent f32 accuracy.
+          Required by kNN/DBSCAN distance expansions (sklearn-exact parity
+          asserted in tests; raw bf16 shows ~2% distance error on a v5e chip)
+          and used for covariance/gram/L-BFGS solvers where parity tolerances
+          are tight.
+        * ``"BF16_BF16_F32_X3"`` (3-pass MXU, ~2x the f32 throughput): used by
+          KMeans — Lloyd's argmin assignment tolerates the ~1e-6 relative
+          error of the 3-pass expansion, and the center-update reductions are
+          plain f32 sums (no matmul), so inertia/center parity holds while the
+          dominant distance matmul runs twice as fast.
+
+      CPU/GPU backends ignore the hint (always full f32), so test parity on the
+      virtual CPU mesh is unaffected either way.
     """
     with contextlib.ExitStack() as stack:
         if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
             stack.enter_context(jax.enable_x64(True))  # jax config State: scoped context
-        stack.enter_context(jax.default_matmul_precision("float32"))
+        if np.dtype(dtype) == np.float64:
+            matmul_precision = "float32"  # f64 runs don't want a reduced-pass MXU mode
+        stack.enter_context(jax.default_matmul_precision(matmul_precision))
         yield
 
 

@@ -146,10 +146,89 @@ def test_spark_model_surface(rng):
         mm.intercept
 
 
-def test_elastic_net_rejected_clearly(rng):
-    df, _, _ = _binary_data(rng, n=50)
-    with pytest.raises(ValueError, match="ElasticNet"):
-        LogisticRegression(regParam=0.1, elasticNetParam=0.5).setFeaturesCol("features").fit(df)
+def test_elasticnet_binomial_vs_sklearn(rng):
+    # Spark objective mean-logloss + λ[(1−α)/2‖b‖² + α‖b‖₁]  ==  sklearn saga
+    # with C = 1/(n·λ), l1_ratio = α (standardization off → same space)
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    df, x, y = _binary_data(rng, n=400, d=6)
+    lam, a = 0.02, 0.5
+    model = (
+        LogisticRegression(
+            regParam=lam, elasticNetParam=a, standardization=False,
+            float32_inputs=False, maxIter=500, tol=1e-12,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    sk = SkLR(
+        solver="saga", C=1.0 / (len(y) * lam), l1_ratio=a, max_iter=20000, tol=1e-12
+    ).fit(x, y)
+    np.testing.assert_allclose(model.coef_[0], sk.coef_[0], rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(model.intercept_[0], sk.intercept_[0], rtol=5e-3, atol=5e-3)
+
+
+def test_l1_sparsity_vs_sklearn(rng):
+    # pure L1 (elasticNetParam=1): strong penalty must zero exactly the
+    # coordinates sklearn's saga zeroes
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    df, x, y = _binary_data(rng, n=300, d=8)
+    lam = 0.05
+    model = (
+        LogisticRegression(
+            regParam=lam, elasticNetParam=1.0, standardization=False,
+            float32_inputs=False, maxIter=500, tol=1e-12,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    sk = SkLR(
+        solver="saga", C=1.0 / (len(y) * lam), l1_ratio=1.0, max_iter=20000, tol=1e-12
+    ).fit(x, y)
+    got_zero = np.abs(model.coef_[0]) < 1e-6
+    sk_zero = np.abs(sk.coef_[0]) < 1e-6
+    assert sk_zero.any(), "test data should produce some zeroed coords"
+    np.testing.assert_array_equal(got_zero, sk_zero)
+    np.testing.assert_allclose(model.coef_[0], sk.coef_[0], atol=6e-3)
+
+
+def test_elasticnet_multinomial_vs_sklearn(rng):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    df, x, y = _multi_data(rng, n=500, d=6, k=3)
+    lam, a = 0.01, 0.3
+    model = (
+        LogisticRegression(
+            regParam=lam, elasticNetParam=a, standardization=False,
+            float32_inputs=False, maxIter=500, tol=1e-12,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    sk = SkLR(
+        solver="saga", C=1.0 / (len(y) * lam), l1_ratio=a, max_iter=20000, tol=1e-12
+    ).fit(x, y)
+    out = model.transform(df)
+    agree = (np.asarray(out["prediction"]) == sk.predict(x)).mean()
+    assert agree > 0.98
+    got = np.stack([v.toArray() if hasattr(v, "toArray") else np.asarray(v) for v in out["probability"]])
+    np.testing.assert_allclose(got, sk.predict_proba(x), atol=2e-2)
+
+
+def test_elasticnet_with_standardization(rng):
+    # penalty lives in standardized space; on pre-standardized data the
+    # standardization=True fit must agree with the standardization=False fit
+    df, x, y = _binary_data(rng, n=300, d=5)
+    xs = (x - x.mean(axis=0)) / x.std(axis=0, ddof=1)
+    dfs = pd.DataFrame({"features": list(xs), "label": y})
+    kw = dict(
+        regParam=0.02, elasticNetParam=0.5, float32_inputs=False, maxIter=500, tol=1e-12
+    )
+    m_std = LogisticRegression(standardization=True, **kw).setFeaturesCol("features").fit(dfs)
+    m_raw = LogisticRegression(standardization=False, **kw).setFeaturesCol("features").fit(dfs)
+    np.testing.assert_allclose(m_std.coef_[0], m_raw.coef_[0], rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(m_std.intercept_[0], m_raw.intercept_[0], rtol=1e-3, atol=1e-4)
 
 
 def test_persistence(tmp_path, rng):

@@ -40,3 +40,62 @@ def test_topk_eigh_desc(rng):
     assert evals[0] >= evals[1] >= evals[2]
     for i in range(3):
         np.testing.assert_allclose(sym @ np.asarray(evecs[i]), evals[i] * np.asarray(evecs[i]), atol=1e-8)
+
+
+def test_owlqn_lam0_equals_lbfgs(rng):
+    # with no L1 term OWL-QN must degrade to plain L-BFGS: same minimizer on a
+    # strongly-convex quadratic-ish smooth objective
+    import jax
+
+    from spark_rapids_ml_tpu.ops.logistic import _lbfgs_minimize
+    from spark_rapids_ml_tpu.ops.owlqn import owlqn_minimize
+
+    A = jnp.asarray(rng.normal(size=(20, 6)))
+    b = jnp.asarray(rng.normal(size=20))
+
+    def smooth(x):
+        r = A @ x - b
+        return jnp.sum(jax.nn.softplus(r)) / 20.0 + 0.05 * jnp.sum(x * x)
+
+    x0 = jnp.zeros(6)
+    x_owl, f_owl, _ = jax.jit(
+        lambda: owlqn_minimize(smooth, x0, jnp.ones(6), 0.0, max_iter=200, tol=1e-14)
+    )()
+    x_lb, f_lb, _ = jax.jit(
+        lambda: _lbfgs_minimize(smooth, x0, max_iter=200, tol=1e-14)
+    )()
+    np.testing.assert_allclose(float(f_owl), float(f_lb), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(x_owl), np.asarray(x_lb), atol=1e-4)
+
+
+def test_owlqn_lasso_zeros(rng):
+    # L1-regularized least squares with a known sparse solution: OWL-QN must
+    # drive truly-inactive coordinates to EXACT zero (orthant projection)
+    import jax
+
+    from spark_rapids_ml_tpu.ops.owlqn import owlqn_minimize
+
+    n, d = 120, 10
+    A = jnp.asarray(rng.normal(size=(n, d)))
+    x_true = np.zeros(d)
+    x_true[:3] = [2.0, -1.5, 1.0]
+    b = A @ jnp.asarray(x_true) + 0.01 * jnp.asarray(rng.normal(size=n))
+
+    def smooth(x):
+        r = A @ x - b
+        return 0.5 * jnp.sum(r * r) / n
+
+    lam = 0.08
+    x, _, _ = jax.jit(
+        lambda: owlqn_minimize(smooth, jnp.zeros(d), jnp.ones(d), lam, max_iter=300, tol=1e-14)
+    )()
+    x = np.asarray(x)
+    # compare against sklearn Lasso (identical objective: 1/(2n)·‖Ax−b‖² + λ‖x‖₁
+    # in sklearn is alpha=λ ... sklearn uses 1/(2n) too)
+    from sklearn.linear_model import Lasso
+
+    sk = Lasso(alpha=lam, fit_intercept=False, tol=1e-14, max_iter=100000).fit(
+        np.asarray(A), np.asarray(b)
+    )
+    np.testing.assert_allclose(x, sk.coef_, atol=2e-4)
+    np.testing.assert_array_equal(x == 0.0, sk.coef_ == 0.0)
