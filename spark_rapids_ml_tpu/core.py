@@ -37,8 +37,12 @@ from .utils import get_logger
 # (`spark.sql.execution.arrow.maxRecordsPerBatch`, `spark.rapids.ml.uvm.enabled`;
 # reference core.py:660-665, clustering.py:775-779).
 config: Dict[str, Any] = {
-    "max_records_per_batch": 1 << 16,  # rows per transform batch
+    "max_records_per_batch": 1 << 16,  # rows per transform batch (PER DEVICE on the mesh path)
     "broadcast_chunk_bytes": 8 << 30,  # 8GB broadcast chunking parity (clustering.py:1013-1091)
+    # transform batches at or above this row count are row-sharded over the
+    # whole mesh (model state replicated) instead of running on one device —
+    # the reference's transform is parallel across all GPUs (core.py:1531-1635)
+    "distributed_transform_min_rows": 1 << 15,
 }
 
 # Output-column naming contract shared by all predictive models
@@ -95,6 +99,36 @@ class FitInputs:
         if self.ctx is not None and self.ctx.is_spmd:
             return self.ctx.rendezvous.allgather(payload)
         return [payload]
+
+    def ell_rows(self):
+        """Device-resident padded-ELL form of `X_sparse` (ops/sparse.py),
+        laid out with the SAME row layout/padding as the dense path:
+        returns (values, indices) row-sharded jax.Arrays. Under SPMD the pad
+        width k_max is the rendezvous-agreed GLOBAL widest row so all ranks
+        trace identical shapes."""
+        from .ops.sparse import csr_to_ell
+
+        assert self.X_sparse is not None, "ell_rows() requires a sparse fit input"
+        local_kmax = (
+            int(np.diff(self.X_sparse.indptr).max()) if self.X_sparse.shape[0] else 0
+        )
+        k_max = max(int(g) for g in self.allgather_host(str(local_kmax)))
+        idx_h, val_h, _ = csr_to_ell(self.X_sparse, k_max=k_max, dtype=self.dtype)
+        return self.put_rows(val_h), self.put_rows(idx_h)
+
+    def allgather_array(self, arr: np.ndarray) -> np.ndarray:
+        """Control-plane allgather of a host numpy block, concatenated in rank
+        order along axis 0. Identity in single-controller mode. Used to merge
+        host-side per-rank samples (KMeans init candidates, RF quantile-sketch
+        rows) — the reference's BarrierTaskContext.allGather of base64 payloads
+        (e.g. tree.py:343, classification.py:1006-1012)."""
+        if self.ctx is None or not self.ctx.is_spmd:
+            return arr
+        from .parallel.context import allgather_ndarray
+
+        return np.concatenate(
+            allgather_ndarray(self.ctx.rendezvous, arr), axis=0
+        )
 
 
 # A fit function maps (inputs, solver_params) -> model-attribute dict.
@@ -241,8 +275,14 @@ class _TpuCaller(_TpuCommon):
         reuses it. Returns one model-attribute dict per param map (or a single
         one when param_maps is None).
         """
+        import time
+
         logger = get_logger(type(self))
+        verbose = bool(self._solver_params.get("verbose"))
+        t_start = time.perf_counter()
         extracted = self._pre_process_data(dataset, for_fit=True)
+        if verbose:
+            logger.info("stage ingest: %.3fs", time.perf_counter() - t_start)
         fit_func = self._get_tpu_fit_func(extracted)
 
         import contextlib
@@ -268,10 +308,23 @@ class _TpuCaller(_TpuCommon):
                 0, 1, num_devices=min(self.num_workers, len(default_devices()))
             )
 
-        with ctx_mgr as ctx, dtype_scope(
+        # Opt-in tracing (the NVTX/xprof analog, SURVEY.md §5): when
+        # SRML_PROFILE_DIR is set, the whole fit runs under a jax.profiler
+        # trace viewable in xprof/tensorboard.
+        profile_dir = os.environ.get("SRML_PROFILE_DIR")
+        profile_cm: Any = contextlib.nullcontext()
+        if profile_dir:
+            import jax
+
+            profile_cm = jax.profiler.trace(profile_dir)
+
+        with profile_cm, ctx_mgr as ctx, dtype_scope(
             np.float32 if self._float32_inputs else np.float64, self._matmul_precision
         ):
+            t_layout = time.perf_counter()
             inputs = self._build_fit_inputs(extracted, ctx)
+            if verbose:
+                logger.info("stage device layout: %.3fs", time.perf_counter() - t_layout)
             logger.info(
                 "fit: %d rows x %d cols on %d-device mesh (%s)%s",
                 inputs.n_valid, inputs.n_cols, inputs.mesh.devices.size,
@@ -292,7 +345,17 @@ class _TpuCaller(_TpuCommon):
                         if mapped:
                             est._set_solver_param(mapped, v, silent=True)
                     solver_param_sets.append(dict(est._solver_params))
-            rows = [fit_func(inputs, sp) for sp in solver_param_sets]
+            rows = []
+            for i, sp in enumerate(solver_param_sets):
+                t_solve = time.perf_counter()
+                rows.append(fit_func(inputs, sp))
+                if verbose:
+                    logger.info(
+                        "stage solve[%d/%d]: %.3fs", i + 1, len(solver_param_sets),
+                        time.perf_counter() - t_solve,
+                    )
+            if verbose:
+                logger.info("stage total fit: %.3fs", time.perf_counter() - t_start)
         return rows
 
 
@@ -450,8 +513,24 @@ class _TpuModelWithColumns(_TpuModel):
     def _transform_arrays(self, features: Any) -> Any:
         """Batched predict over a host feature block. The per-algo `predict` may
         return one array or a tuple of arrays (multi-output models); each output
-        is concatenated across batches."""
-        from .parallel.mesh import dtype_scope
+        is concatenated across batches.
+
+        Small blocks run on one device (the reference's one-task-per-batch
+        pandas_udf shape). At ``config["distributed_transform_min_rows"]`` rows
+        and up, each batch is row-sharded over the full mesh with the model
+        state replicated — every per-algo `predict` is a row-parallel jitted
+        program, so GSPMD partitions it with zero collectives (the reference's
+        all-GPU parallel transform, core.py:1531-1635)."""
+        import jax
+
+        from .parallel.mesh import (
+            default_devices,
+            dtype_scope,
+            get_mesh,
+            pad_rows,
+            replicated,
+            row_sharding,
+        )
 
         with dtype_scope(
             np.float32 if self._float32_inputs else np.float64, self._matmul_precision
@@ -460,17 +539,45 @@ class _TpuModelWithColumns(_TpuModel):
             state = construct()
             n = features.shape[0]
             batch = int(config["max_records_per_batch"])
+            n_dev = min(self.num_workers, len(default_devices()))
+            # multi-process SPMD transforms rank-LOCAL batches: stay on local
+            # devices (sharding a local batch over the global mesh would mix
+            # ranks' unrelated rows and target non-addressable devices)
+            use_mesh = (
+                n >= int(config["distributed_transform_min_rows"])
+                and n_dev > 1
+                and jax.process_count() == 1
+            )
+            mesh = None
+            if use_mesh:
+                mesh = get_mesh(n_dev)
+                state = jax.tree.map(
+                    lambda a: jax.device_put(a, replicated(mesh))
+                    if isinstance(a, (np.ndarray, jax.Array))
+                    else a,
+                    state,
+                )
+                batch *= n_dev  # per-device batch budget stays constant
             outs: List[Any] = []
             for start in range(0, n, batch):
                 stop = min(start + batch, n)
                 xb = features[start:stop]
                 if hasattr(xb, "todense"):
                     xb = np.asarray(xb.todense())
-                result = predict(state, xb)
-                if isinstance(result, tuple):
-                    outs.append(tuple(np.asarray(r) for r in result))
+                if mesh is not None:
+                    xp, n_valid = pad_rows(np.asarray(xb), n_dev)
+                    xp = jax.device_put(xp, row_sharding(mesh, xp.ndim))
+                    result = predict(state, xp)
+                    if isinstance(result, tuple):
+                        outs.append(tuple(np.asarray(r)[:n_valid] for r in result))
+                    else:
+                        outs.append(np.asarray(result)[:n_valid])
                 else:
-                    outs.append(np.asarray(result))
+                    result = predict(state, xb)
+                    if isinstance(result, tuple):
+                        outs.append(tuple(np.asarray(r) for r in result))
+                    else:
+                        outs.append(np.asarray(result))
             if not outs:
                 return np.zeros((0,), dtype=np.float64)
             if isinstance(outs[0], tuple):

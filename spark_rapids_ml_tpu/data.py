@@ -138,8 +138,51 @@ def extract_dataset(
     ``enable_sparse_data_optim``: None autodetects (CSR kept sparse); True requires
     a sparse input (raises otherwise); False densifies (reference params.py:44-65).
     """
-    pdf = as_pandas(dataset)
     dtype = np.float32 if float32_inputs else np.float64
+
+    # Fast path for dict datasets whose feature entry is ALREADY a 2-D block
+    # (ndarray or scipy CSR): skip the per-row object column entirely. This is
+    # the at-scale ingest used by the benchmark suite — the reference reads
+    # parquet into whole Arrow batches the same way (core.py:724-760) rather
+    # than per-row vectors.
+    if (
+        isinstance(dataset, dict)
+        and input_col is not None
+        and input_col in dataset
+        and (
+            (isinstance(dataset[input_col], np.ndarray) and dataset[input_col].ndim == 2)
+            or (_sp is not None and _sp.issparse(dataset[input_col]))
+        )
+    ):
+        features = dataset[input_col]
+        if _sp is not None and _sp.issparse(features):
+            features = features.tocsr()
+            if enable_sparse_data_optim is False:
+                features = np.asarray(features.todense(), dtype=dtype)
+            kind = "vector"
+        else:
+            features = np.ascontiguousarray(features, dtype=dtype)
+            kind = "array"
+            if enable_sparse_data_optim is True:
+                raise ValueError("enable_sparse_data_optim=True requires sparse input")
+
+        def _dict_scalar(colname, dt):
+            if colname is None or colname == "":
+                return None
+            if colname not in dataset:
+                raise ValueError(f"column {colname!r} not in dataset")
+            return np.asarray(dataset[colname], dtype=dt)
+
+        return ExtractedData(
+            features=features,
+            label=_dict_scalar(label_col, dtype),
+            weight=_dict_scalar(weight_col, dtype),
+            row_id=_dict_scalar(id_col, np.int64),
+            feature_kind=kind,
+            feature_names=[input_col],
+        )
+
+    pdf = as_pandas(dataset)
 
     if input_cols is not None:
         missing = [c for c in input_cols if c not in pdf.columns]

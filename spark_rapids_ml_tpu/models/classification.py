@@ -18,6 +18,7 @@ from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithColumns, pre
 from ..data import ExtractedData, as_pandas, vectors_to_pandas_column
 from ..params import (
     HasElasticNetParam,
+    HasEnableSparseDataOptim,
     HasFeaturesCol,
     HasFeaturesCols,
     HasFitIntercept,
@@ -119,6 +120,7 @@ class RandomForestClassificationModel(HasProbabilityCol, HasRawPredictionCol, _R
 
 
 class _LogisticRegressionParams(
+    HasEnableSparseDataOptim,
     HasFeaturesCol,
     HasFeaturesCols,
     HasLabelCol,
@@ -250,11 +252,14 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
     # host-side class discovery is rendezvous-merged below; everything else is
     # one pure SPMD program — correct under multi-process
     _supports_multiprocess = True
+    # CSR input fits via the padded-ELL sparse program (ops/sparse.py) without
+    # densifying — the reference's sparse qn path (classification.py:975-1098)
+    _supports_sparse_input = True
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
         import json
 
-        from ..ops.logistic import logistic_fit
+        from ..ops.logistic import logistic_fit, logistic_fit_ell
 
         labels_host = extracted.label
         family = self.getOrDefault("family")
@@ -286,10 +291,7 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 raise ValueError(f"family='binomial' but found {k} classes")
             y_idx_host = np.searchsorted(classes, labels_host).astype(np.int32)
             y_idx = inputs.put_rows(y_idx_host)
-            state = logistic_fit(
-                inputs.X,
-                y_idx,
-                inputs.w,
+            common = dict(
                 k=k,
                 multinomial=multinomial,
                 lam_l2=alpha * (1.0 - l1_ratio),
@@ -301,6 +303,14 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 tol=float(params["tol"]),
                 lbfgs_memory=int(params["lbfgs_memory"]),
             )
+            if inputs.X_sparse is not None:
+                ell_val, ell_idx = inputs.ell_rows()
+                w_dev = inputs.put_rows(np.asarray(inputs.w, dtype=inputs.dtype))
+                state = logistic_fit_ell(
+                    ell_val, ell_idx, y_idx, w_dev, d=inputs.n_cols, **common
+                )
+            else:
+                state = logistic_fit(inputs.X, y_idx, inputs.w, **common)
             return {
                 "coef_": np.asarray(state["coef_"], dtype=np.float64),
                 "intercept_": np.asarray(state["intercept_"], dtype=np.float64),
@@ -415,14 +425,14 @@ class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
         import jax
 
         from ..ops.logistic import logistic_predict
-        from ..parallel.mesh import default_devices
+        from ..parallel.mesh import default_local_device
 
         coef_np, intercept_np = self.coef_, self.intercept_
         multinomial = self._is_multinomial
         dtype = np.float32 if self._float32_inputs else np.float64
 
         def construct():
-            dev = default_devices()[0]
+            dev = default_local_device()
             return (
                 jax.device_put(coef_np.astype(dtype), dev),
                 jax.device_put(intercept_np.astype(dtype), dev),

@@ -90,6 +90,10 @@ class KMeans(_KMeansParams, _TpuEstimator):
     # reductions are plain f32 sums — see dtype_scope (parallel/mesh.py) policy.
     _matmul_precision = "BF16_BF16_F32_X3"
 
+    # the Lloyd loop is one pure SPMD program; the only host-side state — the
+    # init centers — is computed from a rendezvous-gathered row sample below
+    _supports_multiprocess = True
+
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._setDefault(k=2, initMode="k-means||", initSteps=2, maxIter=20, tol=1e-4, seed=1,
@@ -138,10 +142,30 @@ class KMeans(_KMeansParams, _TpuEstimator):
                 raise ValueError(f"k={k} exceeds number of rows {inputs.n_valid}")
             init_mode = params.get("init", "scalable-k-means++")
             seed = int(params.get("random_state", 1) or 1)
+            # under multi-process SPMD the init must be computed from GLOBAL
+            # rows: every rank contributes a bounded sample (the whole local
+            # block when small), the rendezvous concatenates them in rank
+            # order, and every rank runs the SAME seeded init on the union —
+            # so all ranks enter the Lloyd loop with identical centers (the
+            # reference's distributed k-means|| init runs inside KMeansMG)
+            x_init, w_init = x_host, w_host
+            if inputs.ctx is not None and inputs.ctx.is_spmd:
+                cap = max(4 * k, 262_144 // inputs.ctx.nranks)
+                n_loc = x_host.shape[0]
+                if n_loc > cap:
+                    rs = np.random.default_rng(seed * 100_003 + inputs.ctx.rank)
+                    sel = np.sort(rs.choice(n_loc, cap, replace=False))
+                    xs = np.asarray(x_host[sel], dtype=np.float64)
+                    ws = None if w_host is None else np.asarray(w_host[sel], dtype=np.float64)
+                else:
+                    xs = np.asarray(x_host, dtype=np.float64)
+                    ws = None if w_host is None else np.asarray(w_host, dtype=np.float64)
+                x_init = inputs.allgather_array(xs)
+                w_init = None if ws is None else inputs.allgather_array(ws)
             if init_mode == "random":
-                centers0 = random_init(x_host, k, seed)
+                centers0 = random_init(x_init, k, seed)
             else:  # 'k-means||' / 'scalable-k-means++'
-                centers0 = kmeans_plus_plus_init(x_host, k, seed, w_host)
+                centers0 = kmeans_plus_plus_init(x_init, k, seed, w_init)
             centers0 = centers0.astype(inputs.dtype)
             state = kmeans_fit(
                 inputs.X,
@@ -223,13 +247,13 @@ class KMeansModel(_KMeansParams, _TpuModelWithColumns):
         import jax
 
         from ..ops.kmeans import kmeans_predict
-        from ..parallel.mesh import default_devices
+        from ..parallel.mesh import default_local_device
 
         centers = self.cluster_centers_
         dtype = np.float32 if self._float32_inputs else np.float64
 
         def construct():
-            return jax.device_put(centers.astype(dtype), default_devices()[0])
+            return jax.device_put(centers.astype(dtype), default_local_device())
 
         def predict(state, xb):
             return kmeans_predict(xb.astype(dtype), state)
@@ -405,7 +429,7 @@ class DBSCANModel(_DBSCANParams, _TpuModel):
         from ..data import as_pandas
         from ..ops.dbscan import dbscan_fit
         from ..parallel import TpuContext, get_mesh
-        from ..parallel.mesh import default_devices, dtype_scope
+        from ..parallel.mesh import default_devices, default_local_device, dtype_scope
 
         active = TpuContext.current()
         if active is not None and active.is_spmd:

@@ -166,7 +166,7 @@ class PCAModel(_PCAParams, _TpuModelWithColumns):
         import jax
 
         from ..ops.pca import pca_transform
-        from ..parallel.mesh import default_devices
+        from ..parallel.mesh import default_local_device
 
         components = self.components_
         explained_variance = self.explained_variance_
@@ -174,7 +174,7 @@ class PCAModel(_PCAParams, _TpuModelWithColumns):
         dtype = np.float32 if self._float32_inputs else np.float64
 
         def construct():
-            dev = default_devices()[0]
+            dev = default_local_device()
             return (
                 jax.device_put(components.astype(dtype), dev),
                 jax.device_put(explained_variance.astype(dtype), dev),

@@ -112,12 +112,21 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
 
     def kneighbors(self, query_df: Any) -> Tuple[Any, Any, Any]:
         """Returns (item_df, query_df, knn_df) — knn_df has columns
-        (query_id, indices, distances), indices being item id values."""
+        (query_id, indices, distances), indices being item id values.
+
+        Under multi-process SPMD (an active ``TpuContext`` with nranks > 1):
+        each rank holds LOCAL item and query blocks; items are laid out
+        globally on the mesh, query blocks are rendezvous-replicated (the
+        reference allgathers sizes/ids for the UCX shuffle the same way,
+        knn.py:689-700), every rank computes the full result, and returns the
+        rows for ITS OWN queries."""
         import pandas as pd
 
-        from ..ops.knn import exact_knn
-        from ..parallel import get_mesh, make_global_rows
+        from ..parallel import PartitionDescriptor, TpuContext, get_mesh, make_global_rows
+        from ..parallel.context import allgather_ndarray
         from ..parallel.mesh import default_devices, dtype_scope
+
+        from ..ops.knn import exact_knn
 
         assert self._item_pdf is not None, "model is not bound to an item dataframe"
         k = int(self._solver_params["n_neighbors"])
@@ -126,30 +135,68 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
         query_ex = self._pre_process_data(query_df, for_fit=False)
         item_ids = self._ensure_id(self._item_pdf, item_ex)
         query_ids = self._ensure_id(query_pdf, query_ex)
-        if k > item_ex.n_rows:
-            raise ValueError(f"k={k} exceeds the number of item rows {item_ex.n_rows}")
+
+        active = TpuContext.current()
+        spmd = active is not None and active.is_spmd
 
         np_dtype = np.float32 if self._float32_inputs else np.float64
         with dtype_scope(np_dtype):
             import jax
 
-            n_dev = min(self.num_workers, len(default_devices()))
-            mesh = get_mesh(n_dev)
             items = item_ex.features
             if hasattr(items, "todense"):
                 items = np.asarray(items.todense())
             queries = query_ex.features
             if hasattr(queries, "todense"):
                 queries = np.asarray(queries.todense())
-            X, w, _ = make_global_rows(mesh, items.astype(np_dtype))
-            Q = jax.device_put(queries.astype(np_dtype))
+
+            if spmd:
+                mesh = active.mesh
+                # agree on the global item layout (ragged local blocks ->
+                # common padded per-process size), like _build_fit_inputs
+                desc = PartitionDescriptor.build(
+                    [items.shape[0]], item_ex.n_cols,
+                    rank=active.rank, rendezvous=active.rendezvous,
+                )
+                if k > desc.m:
+                    raise ValueError(f"k={k} exceeds the number of item rows {desc.m}")
+                n_local_dev = jax.local_device_count()
+                max_rows = max(r for _, r in desc.parts_rank_size)
+                local_rows_target = -(-max_rows // n_local_dev) * n_local_dev
+                X, w, _ = make_global_rows(
+                    mesh, items.astype(np_dtype), local_rows_target=local_rows_target
+                )
+                # global padded-position -> user item id map (pad with -1)
+                ids_padded = np.full(local_rows_target, -1, np.int64)
+                ids_padded[: len(item_ids)] = item_ids
+                global_item_ids = np.concatenate(
+                    allgather_ndarray(active.rendezvous, ids_padded)
+                )
+                # replicate the query blocks; remember this rank's slice
+                q_blocks = allgather_ndarray(active.rendezvous, queries.astype(np_dtype))
+                q_offset = sum(len(b) for b in q_blocks[: active.rank])
+                nq_local = queries.shape[0]
+                queries_global = np.concatenate(q_blocks, axis=0)
+                Q = jax.device_put(queries_global)
+            else:
+                if k > item_ex.n_rows:
+                    raise ValueError(
+                        f"k={k} exceeds the number of item rows {item_ex.n_rows}"
+                    )
+                n_dev = min(self.num_workers, len(default_devices()))
+                mesh = get_mesh(n_dev)
+                X, w, _ = make_global_rows(mesh, items.astype(np_dtype))
+                global_item_ids = item_ids
+                Q = jax.device_put(queries.astype(np_dtype))
+                q_offset, nq_local = 0, queries.shape[0]
+
             dist, gidx = exact_knn(
                 X, w > 0, Q, mesh=mesh, k=k,
                 batch_queries=int(self._solver_params["batch_queries"]),
             )
-        dist = np.asarray(dist, dtype=np.float64)
-        gidx = np.asarray(gidx)
-        indices = item_ids[gidx]  # map global row position -> user item id
+        dist = np.asarray(dist, dtype=np.float64)[q_offset : q_offset + nq_local]
+        gidx = np.asarray(gidx)[q_offset : q_offset + nq_local]
+        indices = global_item_ids[gidx]  # map global row position -> user item id
 
         knn_df = pd.DataFrame(
             {
@@ -173,18 +220,21 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
 
         item_out, query_out, knn_df = self.kneighbors(query_df)
         id_col = self.getOrDefault("idCol") if self.isDefined("idCol") else alias.row_number
-        rows = []
         item_by_id = item_out.set_index(id_col)
         query_by_id = query_out.set_index(id_col)
-        for _, r in knn_df.iterrows():
-            for item_id, d in zip(r["indices"], r["distances"]):
-                # ANN search pads under-filled probe results with +inf
-                # distance — those aren't real neighbors, skip them (a real
-                # hit always has finite distance, whatever its user id)
-                if not np.isfinite(d):
-                    continue
-                rows.append((r["query_id"], item_id, d))
-        pairs = pd.DataFrame(rows, columns=["_query_id", "_item_id", distCol])
+        # vectorized explode of the [nq, k] neighbor lists; ANN search pads
+        # under-filled probe results with +inf distance — those aren't real
+        # neighbors, drop them (a real hit always has finite distance)
+        indices = np.stack(knn_df["indices"].to_numpy())
+        dists = np.stack(knn_df["distances"].to_numpy())
+        k = indices.shape[1]
+        flat_q = np.repeat(knn_df["query_id"].to_numpy(), k)
+        flat_i = indices.ravel()
+        flat_d = dists.ravel()
+        finite = np.isfinite(flat_d)
+        pairs = pd.DataFrame(
+            {"_query_id": flat_q[finite], "_item_id": flat_i[finite], distCol: flat_d[finite]}
+        )
         item_side = item_by_id.loc[pairs["_item_id"]].reset_index()
         item_side.columns = [f"item_{c}" if c != id_col else f"item_{id_col}" for c in item_side.columns]
         query_side = query_by_id.loc[pairs["_query_id"]].reset_index()
@@ -203,7 +253,7 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
 
 
 class _ANNParams(_KNNParams):
-    algorithm = Param("algorithm", "ANN algorithm: 'ivfflat'", TypeConverters.toString)
+    algorithm = Param("algorithm", "ANN algorithm: 'ivfflat' or 'ivfpq'", TypeConverters.toString)
     algoParams = Param("algoParams", "algorithm-specific parameters dict", TypeConverters.identity)
 
     def _get_solver_params_default(self) -> Dict[str, Any]:
@@ -212,16 +262,24 @@ class _ANNParams(_KNNParams):
             "batch_queries": 1024,
             "n_lists": 64,
             "n_probes": 8,
+            "pq_m": 8,       # cuML algoParams key "M": subquantizer count
+            "pq_n_bits": 8,  # cuML algoParams key "n_bits": bits per PQ code
+            # ivfpq retrieves k*refine_ratio ADC candidates, then re-ranks them
+            # with exact distances (the cuVS refine step) — raw ADC ordering
+            # alone caps recall well below the probe ceiling
+            "refine_ratio": 4,
             "verbose": False,
         }
 
 
 class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
-    """Approximate kNN via IVFFlat (reference knn.py:787-1544).
+    """Approximate kNN via IVFFlat or IVFPQ (reference knn.py:787-1544,
+    ivfflat/ivfpq algorithms knn.py:1393-1404).
 
     Local-index strategy like the reference: a coarse KMeans quantizer with
-    padded inverted lists; queries probe `n_probes` lists. `algoParams` accepts
-    the cuML-style keys {"nlist", "nprobe"}.
+    padded inverted lists; queries probe `n_probes` lists. IVFPQ additionally
+    product-quantizes the residuals and searches via ADC lookup tables.
+    `algoParams` accepts the cuML-style keys {"nlist", "nprobe", "M", "n_bits"}.
     """
 
     def __init__(self, **kwargs: Any) -> None:
@@ -230,13 +288,16 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
         self._set_params(**kwargs)
 
     def _set_params(self, **kwargs):
-        if "algorithm" in kwargs and kwargs["algorithm"] not in ("ivfflat",):
+        if "algorithm" in kwargs and kwargs["algorithm"] not in ("ivfflat", "ivfpq"):
             raise ValueError(
-                f"algorithm {kwargs['algorithm']!r} not supported (ivfflat only in this build)"
+                f"algorithm {kwargs['algorithm']!r} not supported (ivfflat | ivfpq)"
             )
         if "algoParams" in kwargs:
             ap = kwargs.pop("algoParams") or {}
-            mapped = {"nlist": "n_lists", "nprobe": "n_probes"}
+            mapped = {
+                "nlist": "n_lists", "nprobe": "n_probes", "M": "pq_m",
+                "n_bits": "pq_n_bits", "refine_ratio": "refine_ratio",
+            }
             for key, v in ap.items():
                 self._solver_params[mapped.get(key, key)] = v
         return super()._set_params(**kwargs)
@@ -254,17 +315,27 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
         raise NotImplementedError
 
     def _fit_internal(self, dataset: Any, paramMaps):
-        from ..ops.knn import build_ivfflat
+        from ..ops.knn import build_ivfflat, build_ivfpq
+        from ..parallel.mesh import dtype_scope
 
         pdf = as_pandas(dataset)
         extracted = self._pre_process_data(dataset, for_fit=True)
         feats = extracted.features
         if hasattr(feats, "todense"):
             feats = np.asarray(feats.todense())
-        index = build_ivfflat(
-            feats, int(self._solver_params["n_lists"]),
-            seed=0,
-        )
+        algo = self.getOrDefault("algorithm")
+        # index BUILD needs full-f32 matmuls too (quantizer training + code
+        # assignment run distance expansions; TPU default bf16 wrecks recall)
+        with dtype_scope(np.float32):
+            if algo == "ivfpq":
+                index = build_ivfpq(
+                    feats, int(self._solver_params["n_lists"]),
+                    M=int(self._solver_params["pq_m"]),
+                    n_bits=int(self._solver_params["pq_n_bits"]),
+                    seed=0,
+                )
+            else:
+                index = build_ivfflat(feats, int(self._solver_params["n_lists"]), seed=0)
         model = ApproximateNearestNeighborsModel(
             n_cols=extracted.n_cols, dtype="float32" if self._float32_inputs else "float64"
         )
@@ -273,6 +344,7 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
         model._item_pdf = pdf
         model._item_extracted = extracted
         model._index = index
+        model._algorithm = algo
         return [model]
 
     def _create_model(self, attrs):  # pragma: no cover
@@ -286,6 +358,24 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._index = None
+        self._algorithm = "ivfflat"
+
+    def _refine_exact(self, queries: np.ndarray, cand_idx: np.ndarray, k: int):
+        """Exact re-rank of ADC candidates (cuVS refine): gather the candidate
+        item vectors and score true euclidean distances; −1 pads stay last."""
+        items = self._item_extracted.features
+        if hasattr(items, "todense"):
+            items = np.asarray(items.todense())
+        items = np.asarray(items, dtype=np.float64)
+        q = np.asarray(queries, dtype=np.float64)
+        safe = np.maximum(cand_idx, 0)
+        cand = items[safe]  # [nq, k_adc, d]
+        d2 = ((cand - q[:, None, :]) ** 2).sum(axis=2)
+        d2 = np.where(cand_idx >= 0, d2, np.inf)
+        order = np.argsort(d2, axis=1)[:, :k]
+        dist = np.sqrt(np.take_along_axis(d2, order, axis=1))
+        idx = np.take_along_axis(cand_idx, order, axis=1)
+        return dist, idx
 
     def _get_solver_params_default(self) -> Dict[str, Any]:
         return _ANNParams._get_solver_params_default(self)
@@ -294,7 +384,7 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
         import jax
         import pandas as pd
 
-        from ..ops.knn import ivfflat_search
+        from ..ops.knn import ivfflat_search, ivfpq_search
         from ..parallel.mesh import dtype_scope
 
         assert self._index is not None and self._item_pdf is not None
@@ -309,15 +399,28 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
             queries = query_ex.features
             if hasattr(queries, "todense"):
                 queries = np.asarray(queries.todense())
-            dist, idx = ivfflat_search(
-                jax.device_put(queries.astype(np.float32)),
-                jax.device_put(self._index["centroids"].astype(np.float32)),
-                jax.device_put(self._index["buckets"]),
-                jax.device_put(self._index["bucket_ids"]),
-                k=k,
-                n_probes=int(self._solver_params["n_probes"]),
-                batch_queries=int(self._solver_params["batch_queries"]),
-            )
+            if self._algorithm == "ivfpq":
+                refine = max(1, int(self._solver_params.get("refine_ratio", 4)))
+                k_adc = min(k * refine, item_ex.n_rows)
+                dist, idx = ivfpq_search(
+                    jax.device_put(queries.astype(np.float32)),
+                    self._index,
+                    k=k_adc,
+                    n_probes=int(self._solver_params["n_probes"]),
+                    batch_queries=int(self._solver_params["batch_queries"]),
+                )
+                if k_adc > k:
+                    dist, idx = self._refine_exact(np.asarray(queries), np.asarray(idx), k)
+            else:
+                dist, idx = ivfflat_search(
+                    jax.device_put(queries.astype(np.float32)),
+                    jax.device_put(self._index["centroids"].astype(np.float32)),
+                    jax.device_put(self._index["buckets"]),
+                    jax.device_put(self._index["bucket_ids"]),
+                    k=k,
+                    n_probes=int(self._solver_params["n_probes"]),
+                    batch_queries=int(self._solver_params["batch_queries"]),
+                )
         dist = np.asarray(dist, dtype=np.float64)
         idx = np.asarray(idx)
         indices = np.where(idx >= 0, item_ids[np.maximum(idx, 0)], -1)

@@ -17,6 +17,7 @@ from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithColumns, pre
 from ..data import ExtractedData
 from ..params import (
     HasElasticNetParam,
+    HasEnableSparseDataOptim,
     HasFeaturesCol,
     HasFeaturesCols,
     HasFitIntercept,
@@ -83,6 +84,7 @@ class RandomForestRegressionModel(_RandomForestModel):
 
 
 class _LinearRegressionParams(
+    HasEnableSparseDataOptim,
     HasFeaturesCol,
     HasFeaturesCols,
     HasLabelCol,
@@ -187,18 +189,19 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
 
     # fit is one pure SPMD program over (X, y, w): correct under multi-process
     _supports_multiprocess = True
+    # CSR fits via the padded-ELL gram accumulation (ops/linear.py
+    # linear_fit_ell) with full dense parity — centering happens on the
+    # sufficient statistics, never the data
+    _supports_sparse_input = True
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
-        from ..ops.linear import linear_fit
+        from ..ops.linear import linear_fit, linear_fit_ell
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             alpha = float(params["alpha"])
             l1_ratio = float(params["l1_ratio"])
             use_cd = bool(alpha > 0 and l1_ratio > 0)
-            state = linear_fit(
-                inputs.X,
-                inputs.y,
-                inputs.w,
+            common = dict(
                 alpha=alpha,
                 l1_ratio=l1_ratio,
                 fit_intercept=bool(params["fit_intercept"]),
@@ -207,6 +210,18 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
             )
+            if inputs.X_sparse is not None:
+                ell_val, ell_idx = inputs.ell_rows()
+                state = linear_fit_ell(
+                    ell_val,
+                    ell_idx,
+                    inputs.put_rows(np.asarray(inputs.y, dtype=inputs.dtype)),
+                    inputs.put_rows(np.asarray(inputs.w, dtype=inputs.dtype)),
+                    d=inputs.n_cols,
+                    **common,
+                )
+            else:
+                state = linear_fit(inputs.X, inputs.y, inputs.w, **common)
             return {
                 "coef_": np.asarray(state["coef_"]),
                 "intercept_": float(state["intercept_"]),
@@ -332,14 +347,14 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
         import jax
 
         from ..ops.linear import linear_predict
-        from ..parallel.mesh import default_devices
+        from ..parallel.mesh import default_local_device
 
         coef = self.coef_
         intercept = self.intercept_
         dtype = np.float32 if self._float32_inputs else np.float64
 
         def construct():
-            dev = default_devices()[0]
+            dev = default_local_device()
             return (
                 jax.device_put(coef.astype(dtype), dev),
                 jax.device_put(np.asarray(intercept, dtype=dtype), dev),

@@ -123,6 +123,12 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
     """Shared fit orchestration (reference tree.py:240-431)."""
 
     _is_classification: bool = False
+    # ensemble-split growth is per-device-local by design; the host-side state
+    # (class set, quantile bin edges) is rendezvous-merged in _get_tpu_fit_func.
+    # Like the reference's cuRF, the exact trees depend on the partition layout
+    # (bootstrap draws are keyed per device) — parity across rank counts is
+    # statistical, not bitwise.
+    _supports_multiprocess = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -180,13 +186,33 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
             d = inputs.n_cols
             max_bins = int(params["n_bins"])
             max_depth = int(params["max_depth"])
-            classes = (
-                np.unique(labels_host).astype(np.float64)
-                if self._is_classification
-                else np.zeros(0)
-            )
+            seed = int(params["random_state"] or 0)
+            if self._is_classification:
+                # class set must be GLOBAL (a rank may hold a label subset)
+                import json
+
+                local_classes = np.unique(labels_host).astype(np.float64)
+                gathered = inputs.allgather_host(json.dumps(local_classes.tolist()))
+                classes = np.unique(
+                    np.concatenate([np.asarray(json.loads(g)) for g in gathered])
+                )
+            else:
+                classes = np.zeros(0)
             impurity = params["split_criterion"]
-            edges_host = quantile_bins(x_host, max_bins, seed=int(params["random_state"] or 0))
+            # quantile sketch rows must be GLOBAL too: each rank contributes a
+            # bounded sample, all ranks derive IDENTICAL bin edges from the
+            # union (cuRF's distributed quantile computation analog)
+            x_sketch = x_host
+            if inputs.ctx is not None and inputs.ctx.is_spmd:
+                cap = 100_000 // inputs.ctx.nranks
+                n_loc = x_host.shape[0]
+                if n_loc > cap:
+                    rs = np.random.default_rng(seed * 99_991 + inputs.ctx.rank)
+                    sel = np.sort(rs.choice(n_loc, cap, replace=False))
+                    x_sketch = inputs.allgather_array(np.asarray(x_host[sel], dtype=np.float64))
+                else:
+                    x_sketch = inputs.allgather_array(np.asarray(x_host, dtype=np.float64))
+            edges_host = quantile_bins(x_sketch, max_bins, seed=seed)
             edges = edges_host.astype(np.float32)
             stats_host = self._row_stats(labels_host, classes)
 
@@ -300,6 +326,108 @@ class _RandomForestModel(_RandomForestParams, _TpuModelWithColumns):
         """Per-node output values fed to the traversal (subclass defines)."""
         raise NotImplementedError
 
+    # -- Spark-interop surface (reference tree.py:524-569, utils.py:311-481:
+    # featureImportances, per-tree JSON, debug dump) ------------------------
+
+    def _node_impurity_weight(self, stats: np.ndarray):
+        """(impurity [..., M], weight [..., M]) from node stats.
+
+        Classification stats are per-class counts (gini/entropy from the
+        distribution); regression stats are (n, Σy, Σy²) (variance)."""
+        if self._is_classification:
+            tot = stats.sum(axis=-1)
+            p = stats / np.maximum(tot[..., None], 1e-30)
+            if str(self._solver_params.get("split_criterion")) == "entropy":
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    plogp = np.where(p > 0, p * np.log2(np.maximum(p, 1e-30)), 0.0)
+                imp = -plogp.sum(axis=-1)
+            else:  # gini
+                imp = 1.0 - (p * p).sum(axis=-1)
+            return imp, tot
+        n = stats[..., 0]
+        mean = stats[..., 1] / np.maximum(n, 1e-30)
+        var = stats[..., 2] / np.maximum(n, 1e-30) - mean * mean
+        return np.maximum(var, 0.0), n
+
+    @property
+    def featureImportances(self):
+        """Impurity-gain feature importances, Spark semantics: per-node gain
+        = w·imp − w_l·imp_l − w_r·imp_r accumulated by split feature,
+        normalized per tree, averaged over trees, normalized again."""
+        from ..linalg import DenseVector
+
+        T, M = self.feature.shape
+        imp, w = self._node_impurity_weight(self.node_stats.astype(np.float64))
+        total = np.zeros(self.n_cols, dtype=np.float64)
+        for t in range(T):
+            per_tree = np.zeros(self.n_cols, dtype=np.float64)
+            for i in range(M):
+                f = int(self.feature[t, i])
+                l, r = 2 * i + 1, 2 * i + 2
+                if f < 0 or r >= M:
+                    continue
+                gain = w[t, i] * imp[t, i] - w[t, l] * imp[t, l] - w[t, r] * imp[t, r]
+                per_tree[f] += max(gain, 0.0)
+            s = per_tree.sum()
+            if s > 0:
+                total += per_tree / s
+        s = total.sum()
+        return DenseVector(total / s if s > 0 else total)
+
+    def _tree_to_dict(self, t: int, i: int = 0, leaves: Optional[np.ndarray] = None):
+        """Nested-dict form of tree `t` (the per-tree JSON parity of the
+        reference's cuML model_json -> Spark tree translation). `leaves` is
+        computed once per forest and threaded through the recursion."""
+        if leaves is None:
+            leaves = self._leaf_values()
+        M = self.feature.shape[1]
+        f = int(self.feature[t, i])
+        if f < 0 or 2 * i + 2 >= M:
+            value = leaves[t, i]
+            return {"leaf_value": [float(v) for v in np.atleast_1d(value)]}
+        return {
+            "split_feature": f,
+            "threshold": float(self.threshold[t, i]),
+            "yes": self._tree_to_dict(t, 2 * i + 1, leaves),  # feature <= threshold
+            "no": self._tree_to_dict(t, 2 * i + 2, leaves),
+        }
+
+    @property
+    def trees(self):
+        """List of per-tree nested dicts (portable serialization surface)."""
+        leaves = self._leaf_values()
+        return [self._tree_to_dict(t, 0, leaves) for t in range(self.num_trees)]
+
+    def treesToJson(self) -> List[str]:
+        import json
+
+        return [json.dumps(t) for t in self.trees]
+
+    def toDebugString(self) -> str:
+        """Spark-style textual dump of the forest."""
+        lines = [
+            f"{type(self).__name__}: numTrees={self.num_trees}, "
+            f"numFeatures={self.n_cols}, totalNumNodes={self.totalNumNodes}"
+        ]
+
+        def walk(node, indent):
+            pad = " " * indent
+            if "leaf_value" in node:
+                vals = node["leaf_value"]
+                pretty = vals[0] if len(vals) == 1 else vals
+                lines.append(f"{pad}Predict: {pretty}")
+                return
+            f, thr = node["split_feature"], node["threshold"]
+            lines.append(f"{pad}If (feature {f} <= {thr})")
+            walk(node["yes"], indent + 1)
+            lines.append(f"{pad}Else (feature {f} > {thr})")
+            walk(node["no"], indent + 1)
+
+        for t, tree in enumerate(self.trees):
+            lines.append(f"  Tree {t} (weight 1.0):")
+            walk(tree, 4)
+        return "\n".join(lines)
+
     def _raw_forest_output(self, features) -> np.ndarray:
         """Batched mean-of-leaf-values [n, S] through the shared batching."""
         return self._transform_arrays(features)
@@ -308,7 +436,7 @@ class _RandomForestModel(_RandomForestParams, _TpuModelWithColumns):
         import jax
 
         from ..ops.trees import forest_raw_predict
-        from ..parallel.mesh import default_devices
+        from ..parallel.mesh import default_local_device
 
         feature = self.feature
         threshold = self.threshold
@@ -317,7 +445,7 @@ class _RandomForestModel(_RandomForestParams, _TpuModelWithColumns):
         dtype = np.float32 if self._float32_inputs else np.float64
 
         def construct():
-            dev = default_devices()[0]
+            dev = default_local_device()
             return (
                 jax.device_put(feature, dev),
                 jax.device_put(threshold.astype(dtype), dev),

@@ -27,15 +27,15 @@ from ..parallel.mesh import ROWS_AXIS
 def _tile_assign_accumulate(
     Xl: jax.Array, wl: jax.Array, centers: jax.Array, batch_rows: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Scan one device's rows in tiles; returns (sums [k,d], counts [k], inertia)."""
+    """Scan one device's rows in tiles; returns (sums [k,d], counts [k], inertia).
+
+    Tiles are cut with `dynamic_slice` DIRECTLY out of Xl inside a fori_loop,
+    and the ragged tail is one extra direct step. Neither `jnp.pad` of the
+    shard nor a `lax.scan` over a reshaped view is safe here: both make XLA
+    materialize a second X-sized buffer (11 GiB at the 1M x 3k benchmark
+    shape, measured) — the slice-in-loop form keeps X single-buffered."""
     nl, d = Xl.shape
     k = centers.shape[0]
-    n_tiles = max(1, -(-nl // batch_rows))
-    pad = n_tiles * batch_rows - nl
-    Xp = jnp.pad(Xl, ((0, pad), (0, 0)))
-    wp = jnp.pad(wl, (0, pad))
-    Xt = Xp.reshape(n_tiles, batch_rows, d)
-    wt = wp.reshape(n_tiles, batch_rows)
     c_sq = jnp.sum(centers * centers, axis=1)  # [k]
 
     def step(carry, xw):
@@ -57,14 +57,110 @@ def _tile_assign_accumulate(
         jnp.zeros((k,), Xl.dtype),
         jnp.zeros((), Xl.dtype),
     )
-    # carry must be typed as varying over the mesh axis to match the per-shard
-    # accumulators (JAX shard_map vma typing)
+    # carry must be typed as varying over the mesh axis to match the
+    # per-shard accumulators (JAX shard_map vma typing)
     init = jax.tree.map(lambda t: jax.lax.pcast(t, ROWS_AXIS, to="varying"), init)
-    (sums, counts, inertia), _ = jax.lax.scan(step, init, (Xt, wt))
-    return sums, counts, inertia
+    batch_rows = min(batch_rows, nl)
+    n_full = (nl // batch_rows) * batch_rows
+
+    def tile_body(i, carry):
+        xb = jax.lax.dynamic_slice_in_dim(Xl, i * batch_rows, batch_rows, 0)
+        wb = jax.lax.dynamic_slice_in_dim(wl, i * batch_rows, batch_rows, 0)
+        return step(carry, (xb, wb))[0]
+
+    carry = jax.lax.fori_loop(0, n_full // batch_rows, tile_body, init)
+    if nl - n_full:
+        carry, _ = step(carry, (Xl[n_full:], wl[n_full:]))
+    return carry
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_iter", "batch_rows"))
+def _finish_centers(sums, counts, inertia, centers):
+    # empty clusters keep their previous center (cuML behavior)
+    new_centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts, 1e-30)[:, None], centers
+    )
+    shift = jnp.sum((new_centers - centers) ** 2)
+    return new_centers, inertia, shift
+
+
+_finish_centers_jit = jax.jit(_finish_centers)
+
+
+@partial(jax.jit, static_argnames=("mesh", "batch_rows"))
+def _lloyd_step(X, w, centers, *, mesh, batch_rows):
+    """One Lloyd iteration as a TOP-LEVEL XLA program: per-shard tiled
+    assignment + accumulation, psum'd (k,d) sums/counts/inertia, center update.
+
+    Kept out of a `lax.while_loop` deliberately: XLA duplicates any array whose
+    consumer sits inside nested loops (the tile scan inside a while body costs
+    +1 full copy of X — 11 GiB at the 1M x 3k benchmark shape, an OOM on one
+    chip). The iteration loop lives on the host instead; each step is one
+    dispatch (~ms) against seconds of compute, and the convergence scalar is a
+    replicated global value so every SPMD rank steps identically."""
+
+    def local(Xl, wl):
+        sums, counts, inertia = _tile_assign_accumulate(Xl, wl, centers, batch_rows)
+        sums = jax.lax.psum(sums, ROWS_AXIS)
+        counts = jax.lax.psum(counts, ROWS_AXIS)
+        inertia = jax.lax.psum(inertia, ROWS_AXIS)
+        return sums, counts, inertia
+
+    sums, counts, inertia = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS)),
+        out_specs=(P(), P(), P()),
+    )(X, w)
+    return _finish_centers(sums, counts, inertia, centers)
+
+
+@partial(jax.jit, static_argnames=("size",), donate_argnums=(3, 4, 5))
+def _tile_accum_1dev(X, w, centers, sums, counts, inertia, start, *, size):
+    """Single-device tile accumulation: dynamic_slice at the PROGRAM TOP LEVEL
+    (no in-program loop over X at all). XLA's choice to duplicate a loop-
+    consumed operand is size-dependent — at the 1M x 3k benchmark shape even
+    the fori_loop-of-dynamic_slice form gets a full X copy — so on one device
+    the tile loop lives on the host and the (k,d) accumulators are DONATED
+    device buffers updated in place."""
+    xb = jax.lax.dynamic_slice_in_dim(X, start, size, 0)
+    wb = jax.lax.dynamic_slice_in_dim(w, start, size, 0)
+    k = centers.shape[0]
+    c_sq = jnp.sum(centers * centers, axis=1)
+    xc = xb @ centers.T
+    d2 = c_sq[None, :] - 2.0 * xc
+    assign = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.min(d2, axis=1) + jnp.sum(xb * xb, axis=1)
+    oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
+    return (
+        sums + oh.T @ xb,
+        counts + jnp.sum(oh, axis=0),
+        inertia + jnp.sum(jnp.maximum(min_d2, 0.0) * wb),
+    )
+
+
+def _lloyd_step_1dev(X, w, centers, batch_rows):
+    """Host-tiled Lloyd iteration for a 1-device mesh (see _tile_accum_1dev)."""
+    import numpy as np
+
+    n, d = X.shape
+    k = centers.shape[0]
+    dtype = X.dtype
+    batch_rows = min(batch_rows, n)
+    sums = jnp.zeros((k, d), dtype)
+    counts = jnp.zeros((k,), dtype)
+    inertia = jnp.zeros((), dtype)
+    n_full = (n // batch_rows) * batch_rows
+    for start in range(0, n_full, batch_rows):
+        sums, counts, inertia = _tile_accum_1dev(
+            X, w, centers, sums, counts, inertia, np.int32(start), size=batch_rows
+        )
+    if n - n_full:
+        sums, counts, inertia = _tile_accum_1dev(
+            X, w, centers, sums, counts, inertia, np.int32(n_full), size=n - n_full
+        )
+    return _finish_centers_jit(sums, counts, inertia, centers)
+
+
 def kmeans_fit(
     X: jax.Array,
     w: jax.Array,
@@ -79,43 +175,30 @@ def kmeans_fit(
     cluster_centers_ [k,d], inertia_, n_iter_.
 
     Convergence: squared center movement <= tol (sklearn/cuML semantics; the
-    reference maps Spark's `tol` straight through, clustering.py:96-108)."""
+    reference maps Spark's `tol` straight through, clustering.py:96-108).
+    Host-stepped loop of jitted `_lloyd_step` programs — see the step's
+    docstring for why the loop is not a `lax.while_loop`."""
+    centers = jnp.asarray(init_centers)
+    inertia = jnp.zeros((), X.dtype)
+    n_iter = 0
 
-    def one_iteration(centers):
-        def local(Xl, wl):
-            sums, counts, inertia = _tile_assign_accumulate(Xl, wl, centers, batch_rows)
-            sums = jax.lax.psum(sums, ROWS_AXIS)
-            counts = jax.lax.psum(counts, ROWS_AXIS)
-            inertia = jax.lax.psum(inertia, ROWS_AXIS)
-            return sums, counts, inertia
+    def step(c):
+        if mesh.devices.size == 1:
+            return _lloyd_step_1dev(X, w, c, batch_rows)
+        return _lloyd_step(X, w, c, mesh=mesh, batch_rows=batch_rows)
 
-        sums, counts, inertia = shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS)),
-            out_specs=(P(), P(), P()),
-        )(X, w)
-        # empty clusters keep their previous center (cuML behavior)
-        new_centers = jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts, 1e-30)[:, None], centers
-        )
-        return new_centers, inertia
-
-    def cond(state):
-        centers, prev_shift, inertia, it = state
-        return jnp.logical_and(it < max_iter, prev_shift > tol)
-
-    def body(state):
-        centers, _, _, it = state
-        new_centers, inertia = one_iteration(centers)
-        shift = jnp.sum((new_centers - centers) ** 2)
-        return (new_centers, shift, inertia, it + 1)
-
-    init_state = (init_centers, jnp.array(jnp.inf, X.dtype), jnp.zeros((), X.dtype), 0)
-    centers, _, inertia, n_iter = jax.lax.while_loop(cond, body, init_state)
-    # final inertia is one iteration stale; recompute once with final centers
-    _, final_inertia = one_iteration(centers)
-    return {"cluster_centers_": centers, "inertia_": final_inertia, "n_iter_": n_iter}
+    for _ in range(max_iter):
+        centers, inertia, shift = step(centers)
+        n_iter += 1
+        if float(shift) <= tol:
+            break
+    # inertia reported is one iteration stale; recompute once with final centers
+    _, final_inertia, _ = step(centers)
+    return {
+        "cluster_centers_": centers,
+        "inertia_": final_inertia,
+        "n_iter_": jnp.asarray(n_iter, jnp.int32),
+    }
 
 
 @jax.jit

@@ -98,7 +98,15 @@ def exact_knn(
     neg_d, pos = jax.lax.top_k(-d2_cat, k)
     final_idx = jnp.take_along_axis(gidx_cat, pos, axis=1)
     d2_final = jnp.maximum(-neg_d, 0.0)
-    return jnp.sqrt(d2_final), final_idx
+    # replicate the [nq, k] result so every process can fetch it whole under
+    # multi-process SPMD (each rank then slices its own queries' rows)
+    from jax.sharding import NamedSharding
+
+    rep = NamedSharding(mesh, P())
+    return (
+        jax.lax.with_sharding_constraint(jnp.sqrt(d2_final), rep),
+        jax.lax.with_sharding_constraint(final_idx, rep),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -109,7 +117,26 @@ def exact_knn(
 
 def build_ivfflat(x, n_lists: int, seed: int = 0, kmeans_iters: int = 10):
     """Build an IVFFlat index on host+device: returns dict with centroids
-    [n_lists, d], buckets [n_lists, L, d], bucket_ids [n_lists, L] (−1 pad)."""
+    [n_lists, d], buckets [n_lists, L, d], bucket_ids [n_lists, L] (−1 pad).
+
+    Bucket fill is vectorized: stable-sort rows by list, compute each row's
+    offset within its list, one fancy-index scatter (no Python loop)."""
+    import numpy as np
+
+    x, centroids, assign, sorted_assign, order, offsets, n_lists, L = _coarse_quantizer(
+        x, n_lists, seed, kmeans_iters
+    )
+    n, d = x.shape
+    buckets = np.zeros((n_lists, L, d), np.float32)
+    bucket_ids = np.full((n_lists, L), -1, np.int64)
+    buckets[sorted_assign, offsets] = x[order]
+    bucket_ids[sorted_assign, offsets] = order
+    return {"centroids": centroids, "buckets": buckets, "bucket_ids": bucket_ids}
+
+
+def _coarse_quantizer(x, n_lists: int, seed: int, kmeans_iters: int = 10):
+    """Shared IVF coarse step: KMeans centroids + per-row assignment + the
+    sorted-fill layout (order, offsets, counts, L)."""
     import numpy as np
 
     from .kmeans import kmeans_fit, kmeans_plus_plus_init
@@ -119,30 +146,157 @@ def build_ivfflat(x, n_lists: int, seed: int = 0, kmeans_iters: int = 10):
     n, d = x.shape
     n_lists = min(n_lists, n)
     centers0 = kmeans_plus_plus_init(x, n_lists, seed).astype(np.float32)
-    mesh1 = get_mesh(1)
     state = kmeans_fit(
         jax.device_put(x), jnp.ones((n,), jnp.float32), jax.device_put(centers0),
-        mesh=mesh1, max_iter=kmeans_iters, tol=1e-6,
+        mesh=get_mesh(1), max_iter=kmeans_iters, tol=1e-6,
     )
     centroids = np.asarray(state["cluster_centers_"])
-    d2 = ((x[:, None, :] - centroids[None, :, :]) ** 2).sum(-1) if n * n_lists * d < 5e7 else None
-    if d2 is None:
-        assign = np.asarray(
-            jax.jit(lambda X, C: jnp.argmin(
-                jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1
-            ))(jax.device_put(x), jax.device_put(centroids))
+    assign = np.asarray(
+        jax.jit(lambda X, C: jnp.argmin(
+            jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1
+        ))(jax.device_put(x), jax.device_put(centroids))
+    )
+    counts = np.bincount(assign, minlength=n_lists)
+    L = max(1, int(counts.max()))
+    order = np.argsort(assign, kind="stable")
+    sorted_assign = assign[order]
+    offsets = np.arange(n) - (np.cumsum(counts) - counts)[sorted_assign]
+    return x, centroids, assign, sorted_assign, order, offsets, n_lists, L
+
+
+def build_ivfpq(
+    x, n_lists: int, *, M: int = 8, n_bits: int = 8, seed: int = 0,
+    kmeans_iters: int = 10, pq_iters: int = 10, train_cap: int = 65536,
+):
+    """Build an IVFPQ index: coarse quantizer + per-subspace product
+    quantization of the RESIDUALS (x − centroid), ADC-searchable.
+
+    `algoParams` naming follows cuML ({"M": subquantizers, "n_bits": bits per
+    code}, reference knn.py:1393-1404). Returns dict with centroids
+    [C, d], codebooks [M, K, dsub] (K = 2^n_bits), code_buckets [C, L, M] uint8,
+    bucket_ids [C, L] (−1 pad).
+    """
+    import numpy as np
+
+    from .kmeans import kmeans_fit, kmeans_plus_plus_init
+    from ..parallel.mesh import get_mesh
+
+    x, centroids, assign, sorted_assign, order, offsets, n_lists, L = _coarse_quantizer(
+        x, n_lists, seed, kmeans_iters
+    )
+    n, d = x.shape
+    if d % M:
+        raise ValueError(f"M={M} must divide the feature dimension d={d}")
+    dsub = d // M
+    K = 1 << n_bits
+    resid = (x - centroids[assign]).astype(np.float32)  # [n, d]
+
+    # train per-subspace codebooks on a residual subsample
+    rs = np.random.default_rng(seed)
+    train = resid[rs.choice(n, min(n, train_cap), replace=False)]
+    codebooks = np.zeros((M, K, dsub), np.float32)
+    mesh1 = get_mesh(1)
+    for m in range(M):
+        sub = train[:, m * dsub : (m + 1) * dsub]
+        k_eff = min(K, len(sub))
+        c0 = kmeans_plus_plus_init(sub, k_eff, seed + m).astype(np.float32)
+        st = kmeans_fit(
+            jax.device_put(sub), jnp.ones((len(sub),), jnp.float32), jax.device_put(c0),
+            mesh=mesh1, max_iter=pq_iters, tol=1e-6,
         )
-    else:
-        assign = d2.argmin(1)
-    L = max(1, int(np.bincount(assign, minlength=n_lists).max()))
-    buckets = np.zeros((n_lists, L, d), np.float32)
+        codebooks[m, :k_eff] = np.asarray(st["cluster_centers_"])
+        if k_eff < K:  # degenerate tiny datasets: repeat the first centroid
+            codebooks[m, k_eff:] = codebooks[m, 0]
+
+    # encode all residuals: nearest codeword per subspace (device matmul)
+    @jax.jit
+    def encode(R, CB):  # R [n, M, dsub], CB [M, K, dsub]
+        d2 = (
+            jnp.sum(CB * CB, axis=2)[None, :, :]           # [1, M, K]
+            - 2.0 * jnp.einsum("nmd,mkd->nmk", R, CB)      # [n, M, K]
+        )
+        return jnp.argmin(d2, axis=2).astype(jnp.int32)    # [n, M]
+
+    codes = np.asarray(encode(
+        jax.device_put(resid.reshape(n, M, dsub)), jax.device_put(codebooks)
+    )).astype(np.uint8 if n_bits <= 8 else np.int32)
+
+    code_buckets = np.zeros((n_lists, L, M), codes.dtype)
     bucket_ids = np.full((n_lists, L), -1, np.int64)
-    fill = np.zeros(n_lists, np.int64)
-    for i, c in enumerate(assign):
-        buckets[c, fill[c]] = x[i]
-        bucket_ids[c, fill[c]] = i
-        fill[c] += 1
-    return {"centroids": centroids, "buckets": buckets, "bucket_ids": bucket_ids}
+    code_buckets[sorted_assign, offsets] = codes[order]
+    bucket_ids[sorted_assign, offsets] = order
+    return {
+        "centroids": centroids,
+        "codebooks": codebooks,
+        "code_buckets": code_buckets,
+        "bucket_ids": bucket_ids,
+    }
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "batch_queries"))
+def _ivfpq_search_impl(
+    queries, centroids, codebooks, code_buckets, bucket_ids,
+    *, k: int, n_probes: int, batch_queries: int,
+):
+    nq, d = queries.shape
+    C, L, M = code_buckets.shape
+    K = codebooks.shape[1]
+    dsub = d // M
+    n_probes = min(n_probes, C)
+    n_tiles = max(1, -(-nq // batch_queries))
+    pad = n_tiles * batch_queries - nq
+    qp = jnp.pad(queries, ((0, pad), (0, 0)))
+    cb_sq = jnp.sum(codebooks * codebooks, axis=2)  # [M, K]
+
+    def one_tile(q):  # [B, d]
+        B = q.shape[0]
+        cd = jnp.sum(centroids * centroids, 1)[None, :] - 2.0 * q @ centroids.T
+        probe_d, probe = jax.lax.top_k(-cd, n_probes)  # [B, P]
+        # residual per probed list, split into subspaces
+        q_res = q[:, None, :] - centroids[probe]  # [B, P, d]
+        q_res = q_res.reshape(B, n_probes, M, dsub)
+        # ADC lookup table: ||q_res_m − cb_mk||² (the einsum rides the MXU)
+        lut = (
+            jnp.sum(q_res * q_res, axis=3)[..., None]      # [B, P, M, 1]
+            - 2.0 * jnp.einsum("bpmd,mkd->bpmk", q_res, codebooks)
+            + cb_sq[None, None, :, :]
+        )  # [B, P, M, K]
+        cand_codes = code_buckets[probe].astype(jnp.int32)  # [B, P, L, M]
+        cand_ids = bucket_ids[probe]  # [B, P, L]
+        # dist[b,p,l] = Σ_m lut[b,p,m,codes[b,p,l,m]] — index the K axis
+        # directly with codes transposed to [B, P, M, L]; broadcasting lut to
+        # a [B,P,L,M,K] intermediate would materialize tens of GB
+        codes_t = jnp.swapaxes(cand_codes, 2, 3)  # [B, P, M, L]
+        picked = jnp.take_along_axis(lut, codes_t, axis=3)  # [B, P, M, L]
+        dist = jnp.sum(picked, axis=2)  # [B, P, L]
+        dist = jnp.where(cand_ids >= 0, dist, jnp.inf)
+        dist = dist.reshape(B, n_probes * L)
+        ids = cand_ids.reshape(B, n_probes * L)
+        kk = min(k, n_probes * L)
+        neg_d, pos = jax.lax.top_k(-dist, kk)
+        out_ids = jnp.take_along_axis(ids, pos, axis=1)
+        out_d = jnp.maximum(-neg_d, 0.0)
+        if kk < k:
+            out_d = jnp.pad(out_d, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
+            out_ids = jnp.pad(out_ids, ((0, 0), (0, k - kk)), constant_values=-1)
+        return jnp.sqrt(out_d), out_ids
+
+    qt = qp.reshape(n_tiles, batch_queries, d)
+    dists, idxs = jax.lax.map(one_tile, qt)
+    return dists.reshape(-1, k)[:nq], idxs.reshape(-1, k)[:nq]
+
+
+def ivfpq_search(queries, index, *, k: int, n_probes: int, batch_queries: int = 256):
+    """ADC search over an IVFPQ index (see build_ivfpq). Returns (approximate
+    euclidean distances [nq, k], item ids [nq, k], −1 where short)."""
+    return _ivfpq_search_impl(
+        queries,
+        jax.device_put(jnp.asarray(index["centroids"], jnp.float32)),
+        jax.device_put(jnp.asarray(index["codebooks"], jnp.float32)),
+        jax.device_put(jnp.asarray(index["code_buckets"])),
+        jax.device_put(jnp.asarray(index["bucket_ids"])),
+        k=k, n_probes=n_probes, batch_queries=batch_queries,
+    )
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "batch_queries"))

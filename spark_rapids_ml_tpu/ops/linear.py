@@ -101,8 +101,90 @@ def linear_fit(
     `alpha` is Spark's regParam (per-sample-normalized objective); the Σw
     scaling for the ridge path happens inside.
     """
-    dtype = X.dtype
-    sw, sx, sy, G, c, syy = _sufficient_stats(X, y, w)
+    stats = _sufficient_stats(X, y, w)
+    return _solve_from_stats(
+        stats, X.dtype,
+        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("d", "tile", "fit_intercept", "standardize", "max_iter", "use_cd"),
+)
+def linear_fit_ell(
+    values: jax.Array,  # [n, k_max] padded-ELL (ops/sparse.py)
+    indices: jax.Array,  # [n, k_max] int32
+    y: jax.Array,
+    w: jax.Array,
+    *,
+    d: int,
+    alpha: float,
+    l1_ratio: float,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    use_cd: bool = False,
+    max_iter: int = 1000,
+    tol: float = 1e-6,
+    tile: int = 8192,
+) -> Dict[str, jax.Array]:
+    """Sparse linear regression: identical math to `linear_fit` — the gram and
+    moment sufficient statistics are accumulated from the ELL layout by
+    scatter-adding per-row outer products (tiled over `tile`-row blocks to
+    bound the [tile, k_max, k_max] intermediate), then the SAME replicated
+    (d, d) solve runs. Centering/standardization operate on the statistics,
+    never the data, so sparsity is preserved AND full dense-parity holds
+    (unlike the logistic path, no scale-only compromise is needed)."""
+    from .sparse import ell_rmatvec
+
+    dtype = values.dtype
+    sw = jnp.sum(w)
+    sy = jnp.sum(w * y)
+    syy = jnp.sum(w * y * y)
+    sx = ell_rmatvec(values, indices, w, d)
+    c = ell_rmatvec(values, indices, w * y, d)
+
+    # tiled gram accumulation: scan a reshape of the full-tile prefix (free,
+    # contiguous view) + one direct tail step — never jnp.pad the whole block
+    # (that would materialize a second ELL-sized buffer)
+    n = values.shape[0]
+    k_max = values.shape[1]
+    tile = min(tile, n)
+    n_full = (n // tile) * tile
+
+    def add_tile(G, args):
+        v, i, wt = args  # [b, k_max] ...
+        contrib = jnp.einsum("nk,n,nl->nkl", v, wt, v)
+        ii = jnp.broadcast_to(i[:, :, None], contrib.shape)
+        jj = jnp.broadcast_to(i[:, None, :], contrib.shape)
+        G = G.at[ii.ravel(), jj.ravel()].add(contrib.ravel())
+        return G, None
+
+    G = jnp.zeros((d, d), dtype)
+    if n_full:
+        G, _ = jax.lax.scan(
+            add_tile,
+            G,
+            (
+                values[:n_full].reshape(-1, tile, k_max),
+                indices[:n_full].reshape(-1, tile, k_max),
+                w[:n_full].reshape(-1, tile),
+            ),
+        )
+    if n - n_full:
+        G, _ = add_tile(G, (values[n_full:], indices[n_full:], w[n_full:]))
+    return _solve_from_stats(
+        (sw, sx, sy, G, c, syy), dtype,
+        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+    )
+
+
+def _solve_from_stats(
+    stats, dtype, *, alpha, l1_ratio, fit_intercept, standardize, use_cd, max_iter, tol
+) -> Dict[str, jax.Array]:
+    sw, sx, sy, G, c, syy = stats
 
     if fit_intercept:
         xm = sx / sw

@@ -20,6 +20,36 @@ import jax
 import jax.numpy as jnp
 
 
+def lbfgs_two_loop(pg, S, Y, rho, count, pos, m):
+    """Shared L-BFGS two-loop recursion over circular (s, y) history buffers:
+    returns the descent direction −H·pg. Used by OWL-QN below and by the
+    GLM quasi-Newton solver (ops/logistic.py)."""
+
+    def bwd(i, carry):
+        q, alphas = carry
+        j = (pos - 1 - i) % m
+        valid = i < count
+        a = jnp.where(valid, rho[j] * jnp.dot(S[j], q), 0.0)
+        q = q - jnp.where(valid, a, 0.0) * Y[j]
+        return q, alphas.at[j].set(a)
+
+    q, alphas = jax.lax.fori_loop(0, m, bwd, (pg, jnp.zeros((m,), pg.dtype)))
+    newest = (pos - 1) % m
+    sy = jnp.dot(S[newest], Y[newest])
+    yy = jnp.dot(Y[newest], Y[newest])
+    gamma = jnp.where((count > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0)
+    r = gamma * q
+
+    def fwd(i, r):
+        j = (pos - count + i) % m
+        valid = i < count
+        beta = jnp.where(valid, rho[j] * jnp.dot(Y[j], r), 0.0)
+        return r + jnp.where(valid, alphas[j] - beta, 0.0) * S[j]
+
+    r = jax.lax.fori_loop(0, m, fwd, r)
+    return -r
+
+
 def owlqn_minimize(
     smooth_f: Callable[[jax.Array], jax.Array],
     x0: jax.Array,  # flat [n]
@@ -49,31 +79,8 @@ def owlqn_minimize(
         return jnp.where(x > 0, g + lam, jnp.where(x < 0, g - lam, at0))
 
     def two_loop(pg, S, Y, rho, count, pos):
-        # newest-to-oldest: q -= alpha_j * y_j; oldest-to-newest: add back
-        def bwd(i, carry):
-            q, alphas = carry
-            j = (pos - 1 - i) % m
-            valid = i < count
-            a = jnp.where(valid, rho[j] * jnp.dot(S[j], q), 0.0)
-            q = q - jnp.where(valid, a, 0.0) * Y[j]
-            return q, alphas.at[j].set(a)
-
-        q, alphas = jax.lax.fori_loop(0, m, bwd, (pg, jnp.zeros((m,), pg.dtype)))
-        # initial Hessian scaling from the newest pair
-        newest = (pos - 1) % m
-        sy = jnp.dot(S[newest], Y[newest])
-        yy = jnp.dot(Y[newest], Y[newest])
-        gamma = jnp.where((count > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0)
-        r = gamma * q
-
-        def fwd(i, r):
-            j = (pos - count + i) % m
-            valid = i < count
-            beta = jnp.where(valid, rho[j] * jnp.dot(Y[j], r), 0.0)
-            return r + jnp.where(valid, alphas[j] - beta, 0.0) * S[j]
-
-        r = jax.lax.fori_loop(0, m, fwd, r)
-        return -r  # descent direction for the PSEUDO gradient
+        # descent direction for the PSEUDO gradient (shared recursion above)
+        return lbfgs_two_loop(pg, S, Y, rho, count, pos, m)
 
     def line_search(x, d, f0, pg, xi):
         # backtracking with orthant projection: candidate = pi(x + a*d; xi)

@@ -286,7 +286,16 @@ def forest_fit(
         in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS)),
         out_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS, None, None)),
     )(Xb, stats_row, w)
-    # out axis 0 is [n_dev * trees_per_dev] (device-major) — the tree concat
+    # out axis 0 is [n_dev * trees_per_dev] (device-major) — the tree concat.
+    # Replicate the (small) tree arrays so every process can fetch the full
+    # forest under multi-process SPMD — the in-graph form of the reference's
+    # serialized-tree allGather + concat (tree.py:333-378).
+    from jax.sharding import NamedSharding
+
+    rep = NamedSharding(mesh, P())
+    feats, bins_, nstats = (
+        jax.lax.with_sharding_constraint(a, rep) for a in (feats, bins_, nstats)
+    )
     return {"feature": feats, "split_bin": bins_, "node_stats": nstats}
 
 

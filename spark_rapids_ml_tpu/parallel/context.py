@@ -21,7 +21,31 @@ import threading
 import time
 from typing import List, Optional
 
-__all__ = ["Rendezvous", "LocalRendezvous", "FileRendezvous", "TpuContext"]
+__all__ = [
+    "Rendezvous",
+    "LocalRendezvous",
+    "FileRendezvous",
+    "TpuContext",
+    "allgather_ndarray",
+]
+
+
+def allgather_ndarray(rendezvous: "Rendezvous", arr) -> List:
+    """Allgather a host numpy array through the string control plane (base64 of
+    the .npy encoding); returns the per-rank arrays in rank order. The analog of
+    the reference's base64-over-BarrierTaskContext.allGather payloads
+    (reference tree.py:343, knn.py:689-700)."""
+    import base64
+    import io
+
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    payloads = rendezvous.allgather(base64.b64encode(buf.getvalue()).decode("ascii"))
+    return [
+        np.load(io.BytesIO(base64.b64decode(p)), allow_pickle=False) for p in payloads
+    ]
 
 
 class Rendezvous:

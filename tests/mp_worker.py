@@ -23,15 +23,18 @@ def main() -> None:
     import pandas as pd
 
     from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.clustering import KMeans
     from spark_rapids_ml_tpu.models.feature import PCA
-    from spark_rapids_ml_tpu.models.regression import LinearRegression
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+    from spark_rapids_ml_tpu.models.regression import LinearRegression, RandomForestRegressor
     from spark_rapids_ml_tpu.parallel import FileRendezvous, TpuContext
 
     X, y_log, y_lin = make_dataset()
     bounds = split_bounds(len(X), nranks)
     lo, hi = bounds[rank], bounds[rank + 1]
     df = pd.DataFrame(
-        {"features": list(X[lo:hi]), "label": y_log[lo:hi], "target": y_lin[lo:hi]}
+        {"features": list(X[lo:hi]), "label": y_log[lo:hi], "target": y_lin[lo:hi],
+         "id": np.arange(lo, hi, dtype=np.int64)}
     )
 
     rdv = FileRendezvous(rank, nranks, rdv_dir, timeout_s=120.0, run_id=run_id)
@@ -47,6 +50,28 @@ def main() -> None:
             .setFeaturesCol("features")
             .fit(df)
         )
+        km = (
+            KMeans(k=4, maxIter=15, seed=3, float32_inputs=False)
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+        rf = (
+            RandomForestRegressor(
+                numTrees=8, maxDepth=4, seed=1, labelCol="target", float32_inputs=False
+            )
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+        rf_pred = rf.transform(df)["prediction"].to_numpy()
+        # kNN: items AND queries are rank-local; ids are global user ids
+        gnn = (
+            NearestNeighbors(k=3, float32_inputs=False)
+            .setInputCol("features")
+            .setIdCol("id")
+            .fit(df)
+        )
+        query_df = df.iloc[:5]
+        _, _, knn_df = gnn.kneighbors(query_df)
     np.savez(
         os.path.join(out_dir, f"rank{rank}.npz"),
         pca_components=pca.components_,
@@ -57,6 +82,13 @@ def main() -> None:
         lr_coef=lr.coef_,
         lr_intercept=lr.intercept_,
         lr_classes=lr.classes_,
+        km_centers=km.cluster_centers_,
+        km_inertia=np.asarray(km.inertia_),
+        rf_pred=rf_pred,
+        rf_target=y_lin[lo:hi],
+        knn_query_ids=knn_df["query_id"].to_numpy(),
+        knn_indices=np.stack(knn_df["indices"].to_numpy()),
+        knn_distances=np.stack(knn_df["distances"].to_numpy()),
     )
 
 

@@ -195,3 +195,52 @@ def test_empty_dataset_raises():
     est = DummyEstimator(featuresCol="features")
     with pytest.raises((RuntimeError, ValueError)):
         est.fit(df)
+
+
+def test_verbose_stage_timing_logs(rng, caplog):
+    # verbose solver param produces per-stage timing lines (reference cuML
+    # verbosity plumbing, core.py:394-417 analog). The framework logger writes
+    # to its own stderr handler (propagate=False), so hook caplog's handler in.
+    import logging
+
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.utils import get_logger
+
+    x = rng.normal(size=(200, 6))
+    df = pd.DataFrame({"features": list(x)})
+    est = PCA(k=2, inputCol="features")
+    est._solver_params["verbose"] = True
+    logger = get_logger(PCA)
+    logger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.INFO):
+            est.fit(df)
+    finally:
+        logger.removeHandler(caplog.handler)
+    text = caplog.text
+    assert "stage ingest" in text
+    assert "stage device layout" in text
+    assert "stage solve" in text
+    assert "stage total fit" in text
+
+
+def test_profile_trace_dir(rng, tmp_path, monkeypatch):
+    # SRML_PROFILE_DIR produces a jax.profiler trace directory
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    prof = str(tmp_path / "trace")
+    monkeypatch.setenv("SRML_PROFILE_DIR", prof)
+    x = rng.normal(size=(100, 4))
+    df = pd.DataFrame({"features": list(x)})
+    PCA(k=2, inputCol="features").fit(df)
+    import os
+
+    assert os.path.isdir(prof)
+    found = []
+    for root, _, files in os.walk(prof):
+        found.extend(files)
+    assert found, "profiler trace produced no files"

@@ -1,0 +1,126 @@
+#
+# Feature-type x dtype sweep (the reference's per-algo parametrization:
+# vector / array / multi-col inputs x float32 / float64 — e.g.
+# test_pca.py/test_linear_regression.py run every combination). One sweep here
+# covers the shared ingest/transform plumbing for four algorithms.
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.linalg import Vectors
+from spark_rapids_ml_tpu.models.classification import LogisticRegression
+from spark_rapids_ml_tpu.models.clustering import KMeans
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.models.regression import LinearRegression
+
+
+def _make(rng, n=200, d=5):
+    x = rng.normal(size=(n, d))
+    y = x @ rng.normal(size=d) + 0.3
+    return x, y
+
+
+def _dataset(x, feature_type, extra=None):
+    if feature_type == "vector":
+        df = pd.DataFrame({"features": [Vectors.dense(row) for row in x]})
+    elif feature_type == "array":
+        df = pd.DataFrame({"features": list(x)})
+    else:  # multi_cols
+        df = pd.DataFrame({f"c{j}": x[:, j] for j in range(x.shape[1])})
+    if extra:
+        for k, v in extra.items():
+            df[k] = v
+    return df
+
+
+def _feature_setter(est, feature_type, d):
+    # Spark parity: feature.PCA uses inputCol; the predictors use featuresCol
+    setter = est.setInputCol if hasattr(est, "setInputCol") else est.setFeaturesCol
+    if feature_type == "multi_cols":
+        return setter([f"c{j}" for j in range(d)])
+    return setter("features")
+
+
+FEATURE_TYPES = ["vector", "array", "multi_cols"]
+DTYPES = [True, False]  # float32_inputs
+
+
+@pytest.mark.parametrize("feature_type", FEATURE_TYPES)
+@pytest.mark.parametrize("f32", DTYPES)
+def test_pca_feature_type_dtype(rng, feature_type, f32):
+    x, _ = _make(rng)
+    df = _dataset(x, feature_type)
+    est = _feature_setter(PCA(k=2, float32_inputs=f32), feature_type, x.shape[1])
+    model = est.fit(df)
+    comps = np.asarray(model.components_)
+    assert comps.shape == (2, 5)
+    # same subspace regardless of ingest path
+    ref = PCA(k=2, float32_inputs=False).setInputCol("features").fit(
+        _dataset(x, "array")
+    )
+    np.testing.assert_allclose(
+        np.abs(comps), np.abs(np.asarray(ref.components_)),
+        atol=1e-3 if f32 else 1e-8,
+    )
+    out = model.transform(df)
+    assert len(out) == len(df)
+
+
+@pytest.mark.parametrize("feature_type", FEATURE_TYPES)
+@pytest.mark.parametrize("f32", DTYPES)
+def test_linear_feature_type_dtype(rng, feature_type, f32):
+    x, y = _make(rng)
+    df = _dataset(x, feature_type, {"label": y})
+    est = _feature_setter(
+        LinearRegression(regParam=0.0, float32_inputs=f32), feature_type, x.shape[1]
+    )
+    model = est.fit(df)
+    ref = (
+        LinearRegression(regParam=0.0, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(_dataset(x, "array", {"label": y}))
+    )
+    np.testing.assert_allclose(
+        np.asarray(model.coef_), np.asarray(ref.coef_), atol=1e-3 if f32 else 1e-9
+    )
+    pred = model.transform(df)["prediction"].to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] > 0.99
+
+
+@pytest.mark.parametrize("feature_type", FEATURE_TYPES)
+@pytest.mark.parametrize("f32", DTYPES)
+def test_logistic_feature_type_dtype(rng, feature_type, f32):
+    x, y = _make(rng)
+    lab = (y > y.mean()).astype(np.float64)
+    df = _dataset(x, feature_type, {"label": lab})
+    est = _feature_setter(
+        LogisticRegression(maxIter=50, float32_inputs=f32), feature_type, x.shape[1]
+    )
+    model = est.fit(df)
+    out = model.transform(df)
+    acc = (np.asarray(out["prediction"]) == lab).mean()
+    assert acc > 0.9
+    # output column types: vector input -> vector probability column
+    p0 = out["probability"].iloc[0]
+    if feature_type == "vector":
+        assert hasattr(p0, "toArray")
+    else:
+        assert isinstance(np.asarray(p0), np.ndarray)
+
+
+@pytest.mark.parametrize("feature_type", FEATURE_TYPES)
+@pytest.mark.parametrize("f32", DTYPES)
+def test_kmeans_feature_type_dtype(rng, feature_type, f32):
+    from sklearn.datasets import make_blobs
+
+    x, true = make_blobs(n_samples=300, n_features=4, centers=3, random_state=2)
+    df = _dataset(x, feature_type)
+    est = _feature_setter(
+        KMeans(k=3, seed=1, maxIter=20, float32_inputs=f32), feature_type, x.shape[1]
+    )
+    model = est.fit(df)
+    labels = model.transform(df)["prediction"].to_numpy()
+    from sklearn.metrics import adjusted_rand_score
+
+    assert adjusted_rand_score(true, labels) > 0.95

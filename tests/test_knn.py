@@ -98,3 +98,49 @@ def test_ann_join_skips_padded_ids(rng):
     out = model.approxSimilarityJoin(query_df)
     assert (out["item_id"] != -1).all()
     assert np.isfinite(out["distCol"]).all()
+
+
+def test_ivfpq_recall_and_estimator(rng):
+    # IVFPQ with generous probes on clustered data: decent recall, and the
+    # estimator surface maps cuML algoParams keys {M, n_bits}
+    import pandas as pd
+
+    from sklearn.datasets import make_blobs
+
+    from spark_rapids_ml_tpu.models.knn import ApproximateNearestNeighbors
+
+    x, _ = make_blobs(n_samples=2000, n_features=32, centers=20, random_state=4)
+    x = x.astype(np.float64)
+    df = pd.DataFrame({"features": list(x)})
+    ann = (
+        ApproximateNearestNeighbors(
+            k=8, algorithm="ivfpq",
+            algoParams={"nlist": 32, "nprobe": 8, "M": 8, "n_bits": 6},
+        )
+        .setInputCol("features")
+        .fit(df)
+    )
+    assert ann._solver_params["pq_m"] == 8 and ann._solver_params["pq_n_bits"] == 6
+    _, _, knn_df = ann.kneighbors(df.iloc[:200])
+    got = np.stack(knn_df["indices"].to_numpy())
+
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+
+    exact = NearestNeighbors(k=8).setInputCol("features").fit(df)
+    _, _, exact_df = exact.kneighbors(df.iloc[:200])
+    ref = np.stack(exact_df["indices"].to_numpy())
+    recall = np.mean([len(set(got[i]) & set(ref[i])) / 8 for i in range(200)])
+    assert recall > 0.6, f"ivfpq recall {recall}"
+
+
+def test_ivfpq_rejects_bad_m(rng):
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.models.knn import ApproximateNearestNeighbors
+
+    x = rng.normal(size=(100, 10))
+    df = pd.DataFrame({"features": list(x)})
+    with pytest.raises(ValueError, match="M"):
+        ApproximateNearestNeighbors(
+            k=3, algorithm="ivfpq", algoParams={"M": 3}
+        ).setInputCol("features").fit(df)

@@ -178,3 +178,60 @@ def test_fit_multiple_reg_paths(rng):
     # more regularization shrinks coefficients
     assert np.linalg.norm(models[1].coef_) < np.linalg.norm(models[0].coef_)
     assert np.linalg.norm(models[2].coef_) < np.linalg.norm(models[0].coef_)
+
+
+def _sparse_reg_df(rng, n=300, d=20, density=0.15):
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.linalg import Vectors
+
+    x = sp.random(n, d, density=density, random_state=np.random.RandomState(11), format="csr")
+    xd = np.asarray(x.todense())
+    coef = rng.normal(size=d)
+    y = xd @ coef + 0.5 + 0.01 * rng.normal(size=n)
+    rows = [Vectors.sparse(d, x[i].indices.tolist(), x[i].data.tolist()) for i in range(n)]
+    return (
+        pd.DataFrame({"features": rows, "label": y}),
+        pd.DataFrame({"features": list(xd), "label": y}),
+    )
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(regParam=0.0),                                     # OLS
+        dict(regParam=0.01),                                    # ridge
+        dict(regParam=0.01, elasticNetParam=0.5, maxIter=2000), # CD elastic net
+        dict(regParam=0.01, standardization=False),
+        dict(regParam=0.0, fitIntercept=False),
+    ],
+)
+def test_sparse_linear_matches_dense(rng, kw):
+    # identical sufficient statistics -> identical solve: sparse == dense exactly
+    df_sp, df_dn = _sparse_reg_df(rng)
+    base = dict(float32_inputs=False, tol=1e-12)
+    m_sp = LinearRegression(**base, **kw).setFeaturesCol("features").fit(df_sp)
+    m_dn = LinearRegression(**base, **kw).setFeaturesCol("features").fit(df_dn)
+    np.testing.assert_allclose(m_sp.coef_, m_dn.coef_, rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(m_sp.intercept_, m_dn.intercept_, rtol=1e-8, atol=1e-10)
+
+
+@pytest.mark.slow
+def test_sparse_linear_large_scale(rng):
+    # the reference's headline sparse scale pattern (tests_large): 1e6 x 2000 at
+    # ~0.1% density fits without densifying
+    import scipy.sparse as sp
+
+    n, d = 1_000_000, 2000
+    x = sp.random(n, d, density=0.001, random_state=np.random.RandomState(3), format="csr", dtype=np.float32)
+    coef = np.zeros(d, dtype=np.float32)
+    coef[:50] = rng.normal(size=50)
+    y = np.asarray(x @ coef) + 0.01 * rng.normal(size=n).astype(np.float32)
+    # dict dataset with a whole CSR block: the at-scale ingest fast path
+    m = (
+        LinearRegression(regParam=0.001, maxIter=100)
+        .setFeaturesCol("features")
+        .fit({"features": x, "label": y})
+    )
+    err = np.abs(np.asarray(m.coef_[:50]) - coef[:50]).max()
+    assert err < 0.05

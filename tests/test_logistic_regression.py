@@ -275,3 +275,123 @@ def test_cv_logloss_with_rare_class(rng):
     cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=ev, numFolds=4, seed=3)
     m = cv.fit(df)
     assert np.isfinite(m.avgMetrics[0])
+
+
+def _sparse_df(rng, n=300, d=20, density=0.15, k=2):
+    # CSR data with known structure, returned both as SparseVector rows and a
+    # dense ndarray for the parity fit
+    import scipy.sparse as sp
+
+    x = sp.random(n, d, density=density, random_state=np.random.RandomState(7), format="csr")
+    xd = np.asarray(x.todense())
+    coef = rng.normal(size=d)
+    logits = xd @ coef - 0.1
+    if k == 2:
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    else:
+        y = rng.integers(0, k, size=n).astype(np.float64)
+    rows = [
+        Vectors.sparse(d, x[i].indices.tolist(), x[i].data.tolist()) for i in range(n)
+    ]
+    df_sp = pd.DataFrame({"features": rows, "label": y})
+    df_dn = pd.DataFrame({"features": list(xd), "label": y})
+    return df_sp, df_dn, xd, y
+
+
+def test_sparse_fit_matches_dense(rng):
+    # same objective, different data layout: ELL fit must equal the dense fit
+    df_sp, df_dn, _, _ = _sparse_df(rng)
+    kw = dict(regParam=0.01, standardization=False, float32_inputs=False, maxIter=300, tol=1e-12)
+    m_sp = LogisticRegression(**kw).setFeaturesCol("features").fit(df_sp)
+    m_dn = LogisticRegression(**kw).setFeaturesCol("features").fit(df_dn)
+    np.testing.assert_allclose(m_sp.coef_, m_dn.coef_, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(m_sp.intercept_, m_dn.intercept_, rtol=1e-6, atol=1e-8)
+
+
+def test_sparse_fit_multinomial_and_l1(rng):
+    df_sp, df_dn, xd, y = _sparse_df(rng, n=400, d=15, k=3)
+    kw = dict(
+        regParam=0.02, elasticNetParam=0.6, standardization=False,
+        float32_inputs=False, maxIter=300, tol=1e-12,
+    )
+    m_sp = LogisticRegression(**kw).setFeaturesCol("features").fit(df_sp)
+    m_dn = LogisticRegression(**kw).setFeaturesCol("features").fit(df_dn)
+    assert m_sp.numClasses == 3
+    np.testing.assert_allclose(m_sp.coef_, m_dn.coef_, atol=1e-6)
+    # L1 zeros agree between layouts
+    np.testing.assert_array_equal(np.abs(m_sp.coef_) < 1e-8, np.abs(m_dn.coef_) < 1e-8)
+
+
+def test_sparse_standardization_scale_only(rng):
+    # sparse standardization never centers (reference's sparsity-preserving
+    # trick): equivalent to dense fit on scale-only-standardized data
+    df_sp, df_dn, xd, y = _sparse_df(rng, n=300, d=12)
+    m_sp = (
+        LogisticRegression(regParam=0.01, standardization=True, float32_inputs=False,
+                           maxIter=300, tol=1e-12)
+        .setFeaturesCol("features")
+        .fit(df_sp)
+    )
+    # manual scale-only: divide by unbiased std, fit unstandardized, unfold
+    sigma = xd.std(axis=0, ddof=1)
+    x_scaled = xd / np.where(sigma > 0, sigma, 1.0)
+    df_scaled = pd.DataFrame({"features": list(x_scaled), "label": y})
+    m_ref = (
+        LogisticRegression(regParam=0.01, standardization=False, float32_inputs=False,
+                           maxIter=300, tol=1e-12)
+        .setFeaturesCol("features")
+        .fit(df_scaled)
+    )
+    np.testing.assert_allclose(
+        m_sp.coef_[0] * np.where(sigma > 0, sigma, 1.0), m_ref.coef_[0], rtol=1e-5, atol=1e-7
+    )
+    np.testing.assert_allclose(m_sp.intercept_, m_ref.intercept_, rtol=1e-5, atol=1e-7)
+
+
+def test_sparse_transform_and_predict(rng):
+    df_sp, df_dn, xd, y = _sparse_df(rng)
+    m = (
+        LogisticRegression(regParam=0.01, float32_inputs=False, maxIter=200)
+        .setFeaturesCol("features")
+        .fit(df_sp)
+    )
+    out_sp = m.transform(df_sp)
+    out_dn = m.transform(df_dn)
+    np.testing.assert_allclose(
+        np.asarray(out_sp["prediction"]), np.asarray(out_dn["prediction"])
+    )
+
+
+def test_sparse_optim_flag_validation(rng):
+    df_sp, df_dn, _, _ = _sparse_df(rng, n=50)
+    # True on dense input raises (reference params.py:44-65 semantics)
+    with pytest.raises(ValueError, match="sparse"):
+        LogisticRegression(enable_sparse_data_optim=True).setFeaturesCol("features").fit(df_dn)
+    # False on sparse input densifies (fit still works)
+    m = LogisticRegression(enable_sparse_data_optim=False, maxIter=50).setFeaturesCol("features").fit(df_sp)
+    assert m.numClasses == 2
+
+
+@pytest.mark.slow
+def test_sparse_logistic_large_scale(rng):
+    # the reference's headline sparse logistic scale pattern
+    # (tests_large/test_large_logistic_regression.py: 1e7x2200 sparse): here
+    # 1e6 x 2000 at ~0.1% density, fit without densifying
+    import scipy.sparse as sp
+
+    n, d = 1_000_000, 2000
+    x = sp.random(n, d, density=0.001, random_state=np.random.RandomState(5), format="csr", dtype=np.float32)
+    coef = np.zeros(d, dtype=np.float32)
+    coef[:50] = rng.normal(size=50) * 3
+    logits = np.asarray(x @ coef)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    m = (
+        LogisticRegression(regParam=1e-5, maxIter=50, tol=1e-8)
+        .setFeaturesCol("features")
+        .fit({"features": x, "label": y})
+    )
+    assert m.numClasses == 2
+    # recover sign pattern of the strong coordinates
+    strong = np.abs(coef[:50]) > 1
+    agree = (np.sign(m.coef_[0][:50]) == np.sign(coef[:50]))[strong].mean()
+    assert agree > 0.9

@@ -50,11 +50,16 @@ def _single_process_reference():
     from tests.mp_worker import make_dataset
 
     from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.clustering import KMeans
     from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
     from spark_rapids_ml_tpu.models.regression import LinearRegression
 
     X, y_log, y_lin = make_dataset()
-    df = pd.DataFrame({"features": list(X), "label": y_log, "target": y_lin})
+    df = pd.DataFrame(
+        {"features": list(X), "label": y_log, "target": y_lin,
+         "id": np.arange(len(X), dtype=np.int64)}
+    )
     pca = PCA(k=3, inputCol="features", float32_inputs=False).fit(df)
     lin = (
         LinearRegression(regParam=0.0, float32_inputs=False, labelCol="target")
@@ -66,13 +71,21 @@ def _single_process_reference():
         .setFeaturesCol("features")
         .fit(df)
     )
-    return pca, lin, lr
+    km = KMeans(k=4, maxIter=15, seed=3, float32_inputs=False).setFeaturesCol("features").fit(df)
+    gnn = (
+        NearestNeighbors(k=3, float32_inputs=False).setInputCol("features").setIdCol("id").fit(df)
+    )
+    return pca, lin, lr, km, gnn, df
 
 
 @pytest.mark.parametrize("nranks", [2, 3])
 def test_multiprocess_fit_matches_single_process(nranks, tmp_path):
     out_dir = _launch_workers(nranks, tmp_path)
-    pca, lin, lr = _single_process_reference()
+    pca, lin, lr, km, gnn, full_df = _single_process_reference()
+    from tests.mp_worker import make_dataset, split_bounds
+
+    X, _, _ = make_dataset()
+    bounds = split_bounds(len(X), nranks)
 
     for r in range(nranks):
         got = np.load(os.path.join(out_dir, f"rank{r}.npz"))
@@ -88,16 +101,40 @@ def test_multiprocess_fit_matches_single_process(nranks, tmp_path):
         np.testing.assert_array_equal(got["lr_classes"], lr.classes_)
         np.testing.assert_allclose(got["lr_coef"], lr.coef_, rtol=1e-4, atol=1e-6)
         np.testing.assert_allclose(got["lr_intercept"], lr.intercept_, rtol=1e-4, atol=1e-6)
+        # KMeans: identical rendezvous-gathered init -> same Lloyd trajectory
+        np.testing.assert_allclose(got["km_centers"], km.cluster_centers_, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(
+            float(got["km_inertia"]), km.inertia_, rtol=1e-6
+        )
+        # RF: tree growth is partition-layout-dependent (like cuRF) — require
+        # the distributed forest to actually FIT its local slice
+        # each device grows trees on its own small row shard here (~36 rows),
+        # so the bar is "clearly fitted", not "strongly converged"
+        corr = np.corrcoef(got["rf_pred"], got["rf_target"])[0, 1]
+        assert corr > 0.55, f"rank {r} RF pred/target correlation {corr}"
+        # kNN: each rank queried its first 5 local rows against the GLOBAL
+        # items; must match the single-process result for those query rows
+        lo = bounds[r]
+        q_rows = full_df.iloc[lo : lo + 5]
+        _, _, knn_ref = gnn.kneighbors(q_rows)
+        np.testing.assert_array_equal(got["knn_query_ids"], knn_ref["query_id"].to_numpy())
+        np.testing.assert_array_equal(
+            got["knn_indices"], np.stack(knn_ref["indices"].to_numpy())
+        )
+        np.testing.assert_allclose(
+            got["knn_distances"], np.stack(knn_ref["distances"].to_numpy()),
+            rtol=1e-7, atol=1e-6,  # self-distances are 0 ± sqrt-expansion noise
+        )
 
 
-def test_multiprocess_unsupported_estimator_raises(tmp_path):
+def test_multiprocess_default_is_opt_in(tmp_path):
     # estimators without rendezvous-merged host stats must refuse SPMD fits
     from spark_rapids_ml_tpu.core import _TpuCaller
     from spark_rapids_ml_tpu.models.clustering import KMeans
     from spark_rapids_ml_tpu.models.tree import _RandomForestEstimator
 
-    assert not KMeans._supports_multiprocess
-    assert not _RandomForestEstimator._supports_multiprocess
+    assert KMeans._supports_multiprocess  # rendezvous-merged init centers
+    assert _RandomForestEstimator._supports_multiprocess  # merged classes/bins
     assert not _TpuCaller._supports_multiprocess  # default is opt-in
 
 

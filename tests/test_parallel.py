@@ -110,3 +110,74 @@ def test_tpu_context_single_process():
     with TpuContext(0, 1) as ctx:
         assert ctx.mesh is not None
         assert ctx.mesh.devices.size >= 1
+
+
+def test_distributed_transform_matches_single_device(rng):
+    # >= distributed_transform_min_rows rows: the batch is row-sharded over the
+    # 8-device mesh with replicated model state; result must equal the
+    # single-device path bit-for-bit (row-parallel programs, no reductions)
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import core as core_mod
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+
+    n, d = 40000, 8
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    y = (x[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    m = LogisticRegression(maxIter=30, float32_inputs=False).setFeaturesCol("features").fit(df)
+
+    assert n >= core_mod.config["distributed_transform_min_rows"]
+    out_mesh = m.transform(df)
+    saved = core_mod.config["distributed_transform_min_rows"]
+    try:
+        core_mod.config["distributed_transform_min_rows"] = 1 << 60  # force single-device
+        out_single = m.transform(df)
+    finally:
+        core_mod.config["distributed_transform_min_rows"] = saved
+    np.testing.assert_array_equal(
+        np.asarray(out_mesh["prediction"]), np.asarray(out_single["prediction"])
+    )
+    def _mat(col):
+        return np.stack([v.toArray() if hasattr(v, "toArray") else np.asarray(v) for v in col])
+
+    pm = _mat(out_mesh["probability"])
+    ps = _mat(out_single["probability"])
+    np.testing.assert_allclose(pm, ps, rtol=1e-12, atol=1e-15)
+
+
+def test_distributed_transform_rf_and_kmeans(rng):
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import core as core_mod
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.models.regression import RandomForestRegressor
+
+    n, d = 33000, 6
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    y = x[:, 0] * 2 + rng.normal(size=n) * 0.1
+    df = pd.DataFrame({"features": list(x), "label": y})
+
+    km = KMeans(k=5, maxIter=5, seed=1).setFeaturesCol("features").fit(df)
+    rf = (
+        RandomForestRegressor(numTrees=4, maxDepth=4, seed=1)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    saved = core_mod.config["distributed_transform_min_rows"]
+    out_km_mesh = km.transform(df)
+    out_rf_mesh = rf.transform(df)
+    try:
+        core_mod.config["distributed_transform_min_rows"] = 1 << 60
+        out_km_single = km.transform(df)
+        out_rf_single = rf.transform(df)
+    finally:
+        core_mod.config["distributed_transform_min_rows"] = saved
+    np.testing.assert_array_equal(
+        np.asarray(out_km_mesh["prediction"]), np.asarray(out_km_single["prediction"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_rf_mesh["prediction"]),
+        np.asarray(out_rf_single["prediction"]),
+        rtol=1e-12,
+    )

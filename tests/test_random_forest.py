@@ -223,3 +223,73 @@ def test_rf_no_bootstrap_subsampling_diversifies(rng):
     )
     # trees trained on different half-samples must differ
     assert not np.array_equal(m.node_stats[0], m.node_stats[1])
+
+
+def test_feature_importances(rng):
+    # informative features dominate pure-noise ones; importances sum to 1
+    import pandas as pd
+
+    n, d = 600, 8
+    x = rng.normal(size=(n, d))
+    y = (x[:, 0] + 2 * x[:, 1] > 0).astype(np.float64)  # only features 0/1 matter
+    df = pd.DataFrame({"features": list(x), "label": y})
+    m = (
+        RandomForestClassifier(numTrees=10, maxDepth=5, seed=7, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    fi = np.asarray(m.featureImportances.toArray())
+    assert fi.shape == (d,)
+    np.testing.assert_allclose(fi.sum(), 1.0, rtol=1e-9)
+    assert fi[[0, 1]].sum() > 0.7, f"informative mass too low: {fi}"
+    assert fi[[0, 1]].min() > fi[2:].max()
+
+
+def test_tree_json_reproduces_predictions(rng):
+    # the portable per-tree JSON must reproduce the model's predictions exactly
+    import json
+
+    import pandas as pd
+
+    n, d = 300, 5
+    x = rng.normal(size=(n, d))
+    y = x[:, 0] * 3 + x[:, 2] + 0.05 * rng.normal(size=n)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    m = (
+        RandomForestRegressor(numTrees=5, maxDepth=4, seed=3, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    trees = [json.loads(s) for s in m.treesToJson()]
+    assert len(trees) == 5
+
+    def eval_tree(node, row):
+        while "split_feature" in node:
+            if row[node["split_feature"]] <= node["threshold"]:
+                node = node["yes"]
+            else:
+                node = node["no"]
+        return node["leaf_value"][0]
+
+    preds_json = np.array(
+        [np.mean([eval_tree(t, x[i]) for t in trees]) for i in range(50)]
+    )
+    preds_model = m.transform(df.iloc[:50])["prediction"].to_numpy()
+    np.testing.assert_allclose(preds_json, preds_model, rtol=1e-6)
+
+
+def test_to_debug_string(rng):
+    import pandas as pd
+
+    x = rng.normal(size=(100, 3))
+    y = (x[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    m = (
+        RandomForestClassifier(numTrees=2, maxDepth=3, seed=1)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    s = m.toDebugString()
+    assert "numTrees=2" in s
+    assert "Tree 0" in s and "Tree 1" in s
+    assert "If (feature" in s and "Predict:" in s
