@@ -1,13 +1,17 @@
 #
 # Round benchmark: the reference protocol's three headline fit configs
 # (BASELINE.md — PCA k=3, KMeans k=1000 maxIter=30, LogisticRegression
-# maxIter=200 reg=1e-5, all on the 1M x 3k suite shape) scaled to one chip's
-# HBM, run on the real TPU.
+# maxIter=200 reg=1e-5) at the TRUE protocol scale 1M x 3k, on the real TPU.
 #
 # Prints ONE JSON line on stdout:
 #   {"metric", "value", "unit", "vs_baseline"}
 # value = geometric mean of fit throughput (rows/sec/chip) across the three
-# algos; per-algo detail goes to stderr.
+# algos; per-algo detail goes to stderr. The full 10-config suite lives in
+# benchmark/ (python -m benchmark.benchmark_runner protocol).
+#
+# Memory: X is 1M x 3000 f32 = 11.2 GiB, generated tile-wise DIRECTLY into a
+# row-sharded HBM buffer (benchmark/gen_data.py) — peak = X + one 64k-row tile,
+# inside a single v5e chip's 16 GB.
 #
 # Baseline normalization: the reference publishes a protocol + bar chart, no
 # numbers (SURVEY.md §6). We normalize against A100-class per-algo assumptions
@@ -18,13 +22,14 @@
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-N_ROWS = 400_000  # 1M x 3k f32 is ~12 GB; 400k keeps everything + workspace in HBM
-N_COLS = 3000
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+N_COLS = int(os.environ.get("BENCH_COLS", 3000))
 BASELINES = {"pca": 50_000.0, "kmeans": 8_333.0, "logreg": 12_500.0}
 
 
@@ -62,14 +67,27 @@ def bench_kmeans(X, w, mesh) -> float:
     from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
 
     k = 1000
-    # random-row init picked on device (initMode=random in the protocol config)
-    idx = jax.random.choice(jax.random.PRNGKey(1), X.shape[0], (k,), replace=False)
-    centers0 = jax.device_put(np.asarray(X[idx]))  # replicated
-    run = lambda: kmeans_fit(  # noqa: E731
-        X, w, centers0, mesh=mesh, max_iter=30, tol=1e-20, batch_rows=16384
+    # random-row init (initMode=random protocol config). Rows are pulled one
+    # dynamic_slice at a time: a fancy-index gather program on the 11 GiB X
+    # makes XLA materialize a full copy of X (measured OOM); row slices don't.
+    rng = np.random.default_rng(1)
+    idx = np.sort(rng.choice(X.shape[0], k, replace=False))
+    slice_row = jax.jit(
+        lambda X, i: jax.lax.dynamic_slice_in_dim(X, i, 1, 0), donate_argnums=()
     )
+    centers0 = jax.device_put(
+        np.concatenate([np.asarray(slice_row(X, np.int32(i))) for i in idx], axis=0)
+    )
+
+    def run():
+        # KMeans precision policy: 3-pass bf16 MXU (parallel/mesh.py dtype_scope)
+        with jax.default_matmul_precision("BF16_BF16_F32_X3"):
+            return kmeans_fit(
+                X, w, centers0, mesh=mesh, max_iter=30, tol=1e-20, batch_rows=65536
+            )
+
     np.asarray(run()["cluster_centers_"])  # compile + warm
-    fit_s = _time_fit(lambda: run(), lambda s: s["cluster_centers_"], repeats=1)
+    fit_s = _time_fit(run, lambda s: s["cluster_centers_"], repeats=1)
     _log(f"kmeans: {fit_s:.2f}s fit (k={k}, maxIter=30)")
     return N_ROWS / fit_s
 
@@ -89,32 +107,21 @@ def bench_logreg(X, w, y_idx) -> float:
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
-    from spark_rapids_ml_tpu.parallel import get_mesh, row_sharding
+    from benchmark.gen_data import gen_classification_device
+    from spark_rapids_ml_tpu.parallel import get_mesh
 
     mesh = get_mesh()
     n_chips = int(mesh.devices.size)
     t0 = time.perf_counter()
-    _log(f"generating {N_ROWS}x{N_COLS} dataset ON DEVICE...")
-
-    # generate the low-rank + noise dataset on device (no host transfer): the
-    # reference's PCA/regression dataset shape (gen_data.py low_rank_matrix)
-    @jax.jit
-    def gen(key):
-        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
-        rank = 16
-        U = jax.random.normal(k1, (N_ROWS, rank), jnp.float32)
-        V = jax.random.normal(k2, (rank, N_COLS), jnp.float32)
-        X = U @ V + 0.1 * jax.random.normal(k3, (N_ROWS, N_COLS), jnp.float32)
-        coef = jax.random.normal(k4, (N_COLS,), jnp.float32) / np.sqrt(N_COLS)
-        margin = X @ coef
-        y = (margin + 0.5 * jax.random.normal(k5, (N_ROWS,), jnp.float32) > 0).astype(jnp.int32)
-        w = jnp.ones((N_ROWS,), jnp.float32)
-        return X, y, w
-
-    shardings = (row_sharding(mesh, 2), row_sharding(mesh, 1), row_sharding(mesh, 1))
-    X, y_idx, w = jax.jit(gen, out_shardings=shardings)(jax.random.PRNGKey(0))
+    _log(f"generating {N_ROWS}x{N_COLS} dataset tile-wise ON DEVICE...")
+    # single chip: plain (uncommitted-sharding) arrays — a committed
+    # NamedSharding makes Shardy insert a full input-resharding copy of X in
+    # downstream programs (11 GiB here), while GSPMD on a 1-device mesh needs
+    # no sharding annotations at all
+    X, y_idx, w = gen_classification_device(
+        N_ROWS, N_COLS, n_classes=2, mesh=mesh if n_chips > 1 else None
+    )
     np.asarray(w[:1])  # force materialization for honest phase timing
     _log(f"datagen: {time.perf_counter() - t0:.1f}s")
 
@@ -132,7 +139,7 @@ def main() -> None:
             {
                 "metric": "classical_ml_fit_throughput_geomean",
                 "value": round(geo, 1),
-                "unit": "rows/sec/chip (geomean of PCA k=3 / KMeans k=1000 / LogReg maxIter=200 on 3000 cols, f32)",
+                "unit": "rows/sec/chip (geomean of PCA k=3 / KMeans k=1000 / LogReg maxIter=200 on 1M x 3000, f32)",
                 "vs_baseline": round(geo_vs, 3),
             }
         )

@@ -1,0 +1,3 @@
+# Benchmark suite for spark_rapids_ml_tpu — the TPU-native re-build of the
+# reference's python/benchmark tree (runner + per-algo benches + data gen;
+# reference benchmark_runner.py:38-50, benchmark/base.py:241-270).

@@ -1,0 +1,92 @@
+#
+# Benchmark base — the reference's `benchmark/base.py` (283 LoC: argparse from
+# the estimator's supported params, fit/transform timing, csv report) rebuilt
+# for the TPU framework. No Spark cluster: datasets are generated on device
+# (gen_data) and the estimators run on the local chip/mesh.
+#
+from __future__ import annotations
+
+import argparse
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .utils import append_report, log, pretty_dict, with_benchmark
+
+
+class BenchmarkBase(ABC):
+    """One algorithm benchmark: parse args -> gen data -> time fit (+transform)
+    -> quality score -> report row."""
+
+    name: str = ""
+    # argparse spec: {flag: (type, default, help)}
+    extra_args: Dict[str, tuple] = {}
+
+    def __init__(self) -> None:
+        self.parser = argparse.ArgumentParser(prog=f"benchmark {self.name}")
+        self.parser.add_argument("--num_rows", type=int, default=100_000)
+        self.parser.add_argument("--num_cols", type=int, default=300)
+        self.parser.add_argument("--num_runs", type=int, default=1,
+                                 help="timed runs; the best is reported (3 in the reference protocol)")
+        self.parser.add_argument("--report", type=str, default="",
+                                 help="CSV file to append the result row to")
+        self.parser.add_argument("--num_workers", type=int, default=0,
+                                 help="devices in the mesh (0 = all visible)")
+        self.parser.add_argument("--seed", type=int, default=0)
+        for flag, (typ, default, help_) in self.extra_args.items():
+            self.parser.add_argument(f"--{flag}", type=typ, default=default, help=help_)
+
+    # -- subclass surface --------------------------------------------------
+    @abstractmethod
+    def gen_dataset(self, args, mesh) -> Dict[str, Any]:
+        """Generate the dataset (device-resident where possible)."""
+
+    @abstractmethod
+    def run_once(self, args, data: Dict[str, Any], mesh) -> Dict[str, float]:
+        """One timed fit(+transform); returns {'fit': sec, ...} timings."""
+
+    def quality(self, args, data: Dict[str, Any]) -> Dict[str, float]:
+        """Post-run quality scores (uses state stashed by run_once)."""
+        return {}
+
+    # -- driver ------------------------------------------------------------
+    def run(self, argv=None) -> Dict[str, Any]:
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import get_mesh
+
+        args = self.parser.parse_args(argv)
+        n_dev = args.num_workers or len(jax.devices())
+        mesh = get_mesh(min(n_dev, len(jax.devices())))
+        log(f"[{self.name}] {args.num_rows}x{args.num_cols} on {mesh.devices.size} device(s)")
+
+        data, gen_s = with_benchmark(f"{self.name} gen_dataset", lambda: self.gen_dataset(args, mesh))
+
+        timings: Dict[str, float] = {}
+        for i in range(max(1, args.num_runs)):
+            t = self.run_once(args, data, mesh)
+            for k, v in t.items():
+                timings[k] = min(timings.get(k, float("inf")), v)
+            log(f"[{self.name}] run {i}: {pretty_dict(t)}")
+
+        q = self.quality(args, data)
+        row = {
+            "num_rows": args.num_rows,
+            "num_cols": args.num_cols,
+            "num_devices": int(mesh.devices.size),
+            "gen_sec": round(gen_s, 4),
+            **{f"{k}_sec": round(v, 4) for k, v in timings.items()},
+            **{k: round(float(v), 6) for k, v in q.items()},
+        }
+        if "fit" in timings:
+            row["fit_rows_per_sec"] = round(args.num_rows / timings["fit"], 1)
+        log(f"[{self.name}] RESULT {pretty_dict(row)}")
+        append_report(args.report, self.name, row)
+        return row
+
+
+def fetch(x) -> np.ndarray:
+    """Force device->host materialization (the honest timing fence on the
+    experimental axon PJRT platform where block_until_ready is unreliable)."""
+    return np.asarray(x)

@@ -1,0 +1,95 @@
+#
+# Approximate kNN benchmark (reference bench_approximate_nearest_neighbors.py):
+# IVF index build + probe search; quality = recall vs the exact result on the
+# same queries (the reference reports the same recall curve).
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase, fetch
+from .gen_data import gen_low_rank_host
+from .utils import with_benchmark
+
+
+class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
+    name = "approximate_nearest_neighbors"
+    extra_args = {
+        "k": (int, 64, "neighbors per query"),
+        "num_queries": (int, 4096, "query rows"),
+        "nlist": (int, 256, "IVF coarse lists"),
+        "nprobe": (int, 16, "lists probed per query"),
+        "algorithm": (str, "ivfflat", "ivfflat | ivfpq"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        x = gen_low_rank_host(args.num_rows, args.num_cols, seed=args.seed)
+        q = x[: args.num_queries].copy()
+        return {"x": x, "q": q}
+
+    def run_once(self, args, data, mesh):
+        import jax
+
+        from spark_rapids_ml_tpu.ops.knn import build_ivfflat, ivfflat_search
+
+        build = lambda: build_ivfflat(data["x"], args.nlist, seed=args.seed)  # noqa: E731
+        if args.algorithm == "ivfpq":
+            from spark_rapids_ml_tpu.ops.knn import build_ivfpq, ivfpq_search
+
+            build = lambda: build_ivfpq(data["x"], args.nlist, seed=args.seed)  # noqa: E731
+
+        index, build_sec = with_benchmark(f"ann[{args.algorithm}] build", build)
+        Q = jax.device_put(data["q"])
+
+        if args.algorithm == "ivfpq":
+            from spark_rapids_ml_tpu.ops.knn import ivfpq_search
+
+            def run():
+                return ivfpq_search(
+                    Q, index, k=args.k, n_probes=args.nprobe,
+                )
+        else:
+            cent = jax.device_put(index["centroids"].astype(np.float32))
+            buck = jax.device_put(index["buckets"])
+            bids = jax.device_put(index["bucket_ids"])
+
+            def run():
+                return ivfflat_search(
+                    Q, cent, buck, bids, k=args.k, n_probes=args.nprobe,
+                )
+
+        fetch(run()[0])  # compile outside timing
+        state = {}
+
+        def timed():
+            d, i = run()
+            fetch(d)
+            state["idx"] = np.asarray(i)
+            return d
+
+        _, sec = with_benchmark(f"ann[{args.algorithm}] search", timed)
+        self._idx = state["idx"]
+        return {"build": build_sec, "search": sec, "fit": build_sec + sec}
+
+    def quality(self, args, data):
+        # recall@k vs brute-force exact on a query subsample
+        import jax
+
+        from spark_rapids_ml_tpu.ops.knn import exact_knn
+        from spark_rapids_ml_tpu.parallel import get_mesh, make_global_rows
+
+        n_check = min(512, len(data["q"]))
+        mesh1 = get_mesh(1)
+        X, w, _ = make_global_rows(mesh1, data["x"])
+        _, exact_idx = exact_knn(
+            X, w > 0, jax.device_put(data["q"][:n_check]), mesh=mesh1, k=args.k
+        )
+        exact_idx = np.asarray(exact_idx)
+        hits = 0
+        for i in range(n_check):
+            hits += len(set(exact_idx[i]) & set(self._idx[i][self._idx[i] >= 0]))
+        return {"recall": hits / (n_check * args.k), "qps": float(len(data["q"]))}
+
+
+if __name__ == "__main__":
+    BenchmarkApproximateNearestNeighbors().run()

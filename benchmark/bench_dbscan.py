@@ -1,0 +1,52 @@
+#
+# DBSCAN benchmark (reference bench_dbscan.py): replicated-data rank-sliced N²
+# clustering; quality = adjusted Rand index vs the generating blob labels.
+# The N² memory profile caps practical row counts well below the dense-solver
+# protocol scale — same in the reference (its DBSCAN bench uses smaller sets).
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .gen_data import gen_blobs_host
+from .utils import with_benchmark
+
+
+class BenchmarkDBSCAN(BenchmarkBase):
+    name = "dbscan"
+    extra_args = {
+        "eps": (float, 3.0, "neighborhood radius"),
+        "min_samples": (int, 5, "core-point threshold"),
+        "centers": (int, 20, "generating blob count"),
+        "max_mbytes_per_batch": (int, 512, "distance-tile memory budget"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        x, y = gen_blobs_host(args.num_rows, args.num_cols, centers=args.centers, seed=args.seed)
+        return {"x": x, "y": y}
+
+    def run_once(self, args, data, mesh):
+        from spark_rapids_ml_tpu.ops.dbscan import dbscan_fit
+
+        def run():
+            labels, _ = dbscan_fit(
+                data["x"].astype(np.float32), mesh=mesh, eps=args.eps,
+                min_samples=args.min_samples,
+                max_mbytes_per_batch=args.max_mbytes_per_batch,
+                calc_core_sample_indices=False,
+            )
+            return np.asarray(labels)
+
+        labels, sec = with_benchmark("dbscan fit_predict", run)
+        self._labels = labels
+        return {"fit": sec}
+
+    def quality(self, args, data):
+        from sklearn.metrics import adjusted_rand_score
+
+        return {"ari_vs_generator": float(adjusted_rand_score(data["y"], self._labels))}
+
+
+if __name__ == "__main__":
+    BenchmarkDBSCAN().run()

@@ -1,0 +1,74 @@
+#
+# KMeans benchmark — protocol config k=1000, maxIter=30, tol=1e-20,
+# initMode=random on the 1M x 3k dataset (reference
+# databricks/run_benchmark.sh:50-60; quality = inertia, bench_kmeans.py).
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase, fetch
+from .gen_data import gen_low_rank_device
+from .utils import with_benchmark
+
+
+class BenchmarkKMeans(BenchmarkBase):
+    name = "kmeans"
+    extra_args = {
+        "k": (int, 1000, "number of clusters (protocol: 1000)"),
+        "maxIter": (int, 30, "Lloyd iterations (protocol: 30)"),
+        "batch_rows": (int, 16384, "rows per assignment tile (HBM knob)"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        import jax
+
+        n_dev = int(mesh.devices.size)
+        X, w = gen_low_rank_device(
+            args.num_rows, args.num_cols, seed=args.seed,
+            mesh=mesh if n_dev > 1 else None,  # plain on 1 device (no Shardy copy)
+        )
+        # random-row init (initMode=random protocol config), pulled one
+        # dynamic_slice at a time — a fancy-index gather program on the full X
+        # materializes a second copy of it (OOM at the 1M x 3k protocol shape)
+        rng = np.random.default_rng(args.seed + 1)
+        idx = np.sort(rng.choice(args.num_rows, args.k, replace=False))
+        slice_row = jax.jit(lambda X, i: jax.lax.dynamic_slice_in_dim(X, i, 1, 0))
+        centers0 = jax.device_put(
+            np.concatenate([np.asarray(slice_row(X, np.int32(i))) for i in idx], axis=0)
+        )
+        fetch(w[:1])
+        return {"X": X, "w": w, "centers0": centers0}
+
+    def run_once(self, args, data, mesh):
+        from jax import default_matmul_precision
+
+        from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
+
+        def run():
+            # KMeans precision policy: 3-pass bf16 MXU (see parallel/mesh.py)
+            with default_matmul_precision("BF16_BF16_F32_X3"):
+                return kmeans_fit(
+                    data["X"], data["w"], data["centers0"], mesh=mesh,
+                    max_iter=args.maxIter, tol=1e-20, batch_rows=args.batch_rows,
+                )
+
+        fetch(run()["cluster_centers_"])  # compile outside timing
+        state = {}
+
+        def timed():
+            s = run()
+            fetch(s["cluster_centers_"])
+            state.update(s)
+            return s
+
+        _, sec = with_benchmark("kmeans fit", timed)
+        self._inertia = float(np.asarray(state["inertia_"]))
+        return {"fit": sec}
+
+    def quality(self, args, data):
+        return {"inertia": self._inertia}
+
+
+if __name__ == "__main__":
+    BenchmarkKMeans().run()

@@ -1,0 +1,86 @@
+#
+# LinearRegression benchmark — the protocol's THREE configs (reference
+# databricks/run_benchmark.sh:71-105): {reg=0} OLS, {reg=1e-5, EN=0.5,
+# tol=1e-30, maxIter=10} elastic net, {reg=1e-5} ridge. Quality = training
+# RMSE. `--config all` runs the three in sequence (one dataset).
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase, fetch
+from .gen_data import gen_regression_device
+from .utils import log, with_benchmark
+
+CONFIGS = {
+    "ols": dict(alpha=0.0, l1_ratio=0.0, max_iter=100, use_cd=False),
+    "elasticnet": dict(alpha=1e-5, l1_ratio=0.5, max_iter=10, use_cd=True),
+    "ridge": dict(alpha=1e-5, l1_ratio=0.0, max_iter=100, use_cd=False),
+}
+
+
+class BenchmarkLinearRegression(BenchmarkBase):
+    name = "linear_regression"
+    extra_args = {
+        "config": (str, "all", "ols | elasticnet | ridge | all (protocol: all three)"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        X, y, w, coef = gen_regression_device(
+            args.num_rows, args.num_cols, seed=args.seed, mesh=mesh
+        )
+        fetch(w[:1])
+        return {"X": X, "y": y, "w": w, "coef_true": coef}
+
+    def run_once(self, args, data, mesh):
+        from spark_rapids_ml_tpu.ops.linear import linear_fit
+
+        names = list(CONFIGS) if args.config == "all" else [args.config]
+        timings = {}
+        self._states = {}
+        for cname in names:
+            cfg = CONFIGS[cname]
+
+            def run():
+                return linear_fit(
+                    data["X"], data["y"], data["w"],
+                    alpha=cfg["alpha"], l1_ratio=cfg["l1_ratio"],
+                    fit_intercept=True, standardize=True, use_cd=cfg["use_cd"],
+                    max_iter=cfg["max_iter"], tol=1e-30,
+                )
+
+            fetch(run()["coef_"])  # compile outside timing
+            state = {}
+
+            def timed():
+                s = run()
+                fetch(s["coef_"])
+                state.update(s)
+                return s
+
+            _, sec = with_benchmark(f"linear_regression[{cname}] fit", timed)
+            timings[f"fit_{cname}"] = sec
+            self._states[cname] = {k: np.asarray(v) for k, v in state.items()}
+        timings["fit"] = sum(timings.values())
+        return timings
+
+    def quality(self, args, data):
+        import jax
+        import jax.numpy as jnp
+
+        out = {}
+        for cname, st in self._states.items():
+            coef, b = st["coef_"], st["intercept_"]
+
+            @jax.jit
+            def rmse(X, y):
+                r = X @ coef + b - y
+                return jnp.sqrt(jnp.mean(r * r))
+
+            out[f"rmse_{cname}"] = float(np.asarray(rmse(data["X"], data["y"])))
+        log(f"[linear_regression] quality {out}")
+        return out
+
+
+if __name__ == "__main__":
+    BenchmarkLinearRegression().run()

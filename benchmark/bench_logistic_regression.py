@@ -1,0 +1,81 @@
+#
+# LogisticRegression benchmark — protocol config maxIter=200, tol=1e-30,
+# regParam=1e-5 on 1M x 3k classification (reference
+# databricks/run_benchmark.sh:131-140; quality = training accuracy).
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase, fetch
+from .gen_data import gen_classification_device
+from .utils import with_benchmark
+
+
+class BenchmarkLogisticRegression(BenchmarkBase):
+    name = "logistic_regression"
+    extra_args = {
+        "maxIter": (int, 200, "L-BFGS iterations (protocol: 200)"),
+        "reg": (float, 1e-5, "regParam (protocol: 1e-5)"),
+        "elasticNetParam": (float, 0.0, "L1 ratio (OWL-QN path when > 0)"),
+        "n_classes": (int, 2, "label cardinality"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        X, y, w = gen_classification_device(
+            args.num_rows, args.num_cols, n_classes=args.n_classes, seed=args.seed, mesh=mesh
+        )
+        fetch(w[:1])
+        return {"X": X, "y": y, "w": w}
+
+    def run_once(self, args, data, mesh):
+        from spark_rapids_ml_tpu.ops.logistic import logistic_fit
+
+        l1 = args.reg * args.elasticNetParam
+
+        def run():
+            return logistic_fit(
+                data["X"], data["y"], data["w"],
+                k=args.n_classes, multinomial=args.n_classes > 2,
+                lam_l2=args.reg * (1.0 - args.elasticNetParam), lam_l1=l1,
+                use_l1=l1 > 0, fit_intercept=True, standardize=True,
+                max_iter=args.maxIter, tol=1e-30,
+            )
+
+        fetch(run()["coef_"])  # compile outside timing
+        state = {}
+
+        def timed():
+            s = run()
+            fetch(s["coef_"])
+            state.update(s)
+            return s
+
+        _, sec = with_benchmark("logistic_regression fit", timed)
+        self._state = {k: np.asarray(v) for k, v in state.items()}
+        self._data = data
+        return {"fit": sec}
+
+    def quality(self, args, data):
+        import jax
+        import jax.numpy as jnp
+
+        coef = self._state["coef_"]
+        intercept = self._state["intercept_"]
+
+        @jax.jit
+        def acc(X, y):
+            if coef.shape[0] == 1:
+                pred = (X @ coef[0] + intercept[0] > 0).astype(jnp.int32)
+            else:
+                pred = jnp.argmax(X @ coef.T + intercept[None, :], axis=1).astype(jnp.int32)
+            return jnp.mean((pred == y).astype(jnp.float32))
+
+        return {
+            "accuracy": float(np.asarray(acc(data["X"], data["y"]))),
+            "n_iter": float(self._state["n_iter_"]),
+        }
+
+
+if __name__ == "__main__":
+    BenchmarkLogisticRegression().run()

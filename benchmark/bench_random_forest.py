@@ -1,0 +1,131 @@
+#
+# RandomForest benchmark — the protocol's two configs (reference
+# databricks/run_benchmark.sh:107-129): classifier 50 trees / depth 13 /
+# 128 bins, regressor 30 trees / depth 6 / 128 bins, both on 1M x 3k.
+# Quality = training accuracy (clf) / R² (reg) on a row subsample.
+#
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase, fetch
+from .gen_data import gen_classification_device, gen_regression_device
+from .utils import with_benchmark
+
+
+class BenchmarkRandomForest(BenchmarkBase):
+    name = "random_forest"
+    extra_args = {
+        "task": (str, "classification", "classification (50xd13) | regression (30xd6)"),
+        "numTrees": (int, 0, "override protocol tree count"),
+        "maxDepth": (int, 0, "override protocol depth"),
+        "maxBins": (int, 128, "histogram bins (protocol: 128)"),
+        "node_chunk": (int, 256, "nodes processed per histogram pass (HBM knob)"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        if args.task == "classification":
+            X, y, w = gen_classification_device(
+                args.num_rows, args.num_cols, n_classes=2, seed=args.seed, mesh=mesh
+            )
+            data = {"X": X, "y": y, "w": w}
+        else:
+            X, y, w, _ = gen_regression_device(
+                args.num_rows, args.num_cols, seed=args.seed, mesh=mesh
+            )
+            data = {"X": X, "y": y, "w": w}
+        fetch(w[:1])
+        return data
+
+    def run_once(self, args, data, mesh):
+        import jax
+
+        from spark_rapids_ml_tpu.ops.trees import bin_features, forest_fit, quantile_bins
+
+        clf = args.task == "classification"
+        n_trees = args.numTrees or (50 if clf else 30)
+        depth = args.maxDepth or (13 if clf else 6)
+
+        def run():
+            # quantile sketch from a device-side row subsample (the binning is
+            # part of the fit, like cuRF's quantile computation)
+            n_sample = min(args.num_rows, 65536)
+            xs = np.asarray(data["X"][:n_sample], dtype=np.float32)
+            edges = quantile_bins(xs, args.maxBins, seed=args.seed).astype(np.float32)
+            Xb = bin_features(data["X"], edges)
+            y_host = np.asarray(data["y"])
+            if clf:
+                stats = np.zeros((len(y_host), 2), np.float32)
+                stats[np.arange(len(y_host)), y_host.astype(int)] = 1.0
+            else:
+                stats = np.stack(
+                    [np.ones_like(y_host), y_host, y_host * y_host], axis=1
+                ).astype(np.float32)
+            from spark_rapids_ml_tpu.parallel.mesh import row_sharding
+
+            stats_dev = jax.device_put(stats, row_sharding(mesh, 2))
+            w = data["w"]
+            return forest_fit(
+                Xb, stats_dev * w[:, None], w, args.seed, mesh=mesh,
+                n_trees=n_trees, max_depth=depth, max_bins=args.maxBins,
+                max_features=max(1, int(np.sqrt(args.num_cols))) if clf else max(1, args.num_cols // 3),
+                impurity="gini" if clf else "variance",
+                node_chunk=args.node_chunk, bootstrap=True, subsample_rate=1.0,
+                min_instances=1.0, min_info_gain=0.0, n_stats=2 if clf else 3,
+            )
+
+        state = {}
+
+        def timed():
+            s = run()
+            fetch(s["feature"])
+            state.update(s)
+            return s
+
+        _, sec = with_benchmark(f"random_forest[{args.task}] fit", timed)
+        self._state = {k: np.asarray(v) for k, v in state.items()}
+        self._clf = clf
+        self._depth = depth
+        return {"fit": sec}
+
+    def quality(self, args, data):
+        from spark_rapids_ml_tpu.ops.trees import forest_raw_predict, split_bins_to_thresholds
+        from spark_rapids_ml_tpu.models.tree import _fill_empty_nodes
+
+        n_eval = min(args.num_rows, 32768)
+        X = np.asarray(data["X"][:n_eval], dtype=np.float32)
+        y = np.asarray(data["y"][:n_eval])
+        feature = self._state["feature"]
+        node_stats = _fill_empty_nodes(feature, self._state["node_stats"].astype(np.float64))
+        n_sample = min(args.num_rows, 65536)
+        from spark_rapids_ml_tpu.ops.trees import quantile_bins
+
+        edges = quantile_bins(
+            np.asarray(data["X"][:n_sample], dtype=np.float32), args.maxBins, seed=args.seed
+        )
+        threshold = split_bins_to_thresholds(feature, self._state["split_bin"], edges)
+        if self._clf:
+            leaves = node_stats / np.maximum(node_stats.sum(axis=2, keepdims=True), 1e-30)
+            dist = np.asarray(
+                forest_raw_predict(
+                    X, feature, threshold.astype(np.float32), leaves.astype(np.float32),
+                    max_depth=self._depth,
+                )
+            )
+            pred = np.argmax(dist, axis=1)
+            return {"accuracy": float((pred == y).mean())}
+        w = node_stats[..., 0]
+        leaves = (node_stats[..., 1] / np.maximum(w, 1e-30))[..., None]
+        pred = np.asarray(
+            forest_raw_predict(
+                X, feature, threshold.astype(np.float32), leaves.astype(np.float32),
+                max_depth=self._depth,
+            )
+        )[:, 0]
+        ss_res = float(((pred - y) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        return {"r2": 1.0 - ss_res / max(ss_tot, 1e-30)}
+
+
+if __name__ == "__main__":
+    BenchmarkRandomForest().run()

@@ -1,0 +1,51 @@
+#
+# UMAP benchmark (reference bench_umap.py): fit + transform timing; quality =
+# trustworthiness of the embedding on a subsample (the reference's score).
+#
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from .base import BenchmarkBase
+from .gen_data import gen_blobs_host
+from .utils import with_benchmark
+
+
+class BenchmarkUMAP(BenchmarkBase):
+    name = "umap"
+    extra_args = {
+        "n_neighbors": (int, 15, "kNN graph degree"),
+        "n_epochs": (int, 200, "SGD layout epochs"),
+        "centers": (int, 10, "generating blob count"),
+    }
+
+    def gen_dataset(self, args, mesh):
+        x, y = gen_blobs_host(args.num_rows, args.num_cols, centers=args.centers, seed=args.seed)
+        return {"x": x, "df": pd.DataFrame({"features": list(x.astype(np.float64))})}
+
+    def run_once(self, args, data, mesh):
+        from spark_rapids_ml_tpu.models.umap import UMAP
+
+        est = UMAP(
+            n_neighbors=args.n_neighbors, n_epochs=args.n_epochs, random_state=42
+        ).setFeaturesCol("features")
+        model, fit_sec = with_benchmark("umap fit", lambda: est.fit(data["df"]))
+        _, tr_sec = with_benchmark("umap transform", lambda: model.transform(data["df"]))
+        self._model = model
+        return {"fit": fit_sec, "transform": tr_sec}
+
+    def quality(self, args, data):
+        from sklearn.manifold import trustworthiness
+
+        n = min(2000, len(data["x"]))
+        emb = np.asarray(self._model.embedding_)[:n]
+        return {
+            "trustworthiness": float(
+                trustworthiness(data["x"][:n], emb, n_neighbors=args.n_neighbors)
+            )
+        }
+
+
+if __name__ == "__main__":
+    BenchmarkUMAP().run()

@@ -1,0 +1,81 @@
+#
+# Benchmark runner — dispatch to the per-algorithm benchmarks (the reference's
+# benchmark_runner.py:38-50 registry shape).
+#
+#   python -m benchmark.benchmark_runner <algo> [--num_rows N --num_cols D ...]
+#   python -m benchmark.benchmark_runner protocol --report out.csv
+#
+# `protocol` runs every algorithm at its reference-protocol config (BASELINE.md)
+# scaled by --num_rows/--num_cols (defaults to the full 1M x 3k for the dense
+# solvers; DBSCAN/UMAP/kNN run their own protocol sizes).
+#
+from __future__ import annotations
+
+import sys
+
+from .bench_approximate_nearest_neighbors import BenchmarkApproximateNearestNeighbors
+from .bench_dbscan import BenchmarkDBSCAN
+from .bench_kmeans import BenchmarkKMeans
+from .bench_linear_regression import BenchmarkLinearRegression
+from .bench_logistic_regression import BenchmarkLogisticRegression
+from .bench_nearest_neighbors import BenchmarkNearestNeighbors
+from .bench_pca import BenchmarkPCA
+from .bench_random_forest import BenchmarkRandomForest
+from .bench_umap import BenchmarkUMAP
+from .utils import log
+
+ALGORITHMS = {
+    "pca": BenchmarkPCA,
+    "kmeans": BenchmarkKMeans,
+    "linear_regression": BenchmarkLinearRegression,
+    "logistic_regression": BenchmarkLogisticRegression,
+    "random_forest": BenchmarkRandomForest,
+    "random_forest_classifier": BenchmarkRandomForest,
+    "random_forest_regressor": BenchmarkRandomForest,
+    "knn": BenchmarkNearestNeighbors,
+    "nearest_neighbors": BenchmarkNearestNeighbors,
+    "approximate_nearest_neighbors": BenchmarkApproximateNearestNeighbors,
+    "dbscan": BenchmarkDBSCAN,
+    "umap": BenchmarkUMAP,
+}
+
+# The full reference protocol (BASELINE.md): (algo, extra argv). Sizes come
+# from --num_rows/--num_cols so the same list runs scaled-down smoke tests.
+PROTOCOL = [
+    ("pca", ["--k", "3"]),
+    ("kmeans", ["--k", "1000", "--maxIter", "30"]),
+    ("linear_regression", ["--config", "all"]),
+    ("logistic_regression", ["--maxIter", "200", "--reg", "1e-5"]),
+    ("random_forest", ["--task", "classification"]),
+    ("random_forest", ["--task", "regression"]),
+    ("nearest_neighbors", []),
+    ("approximate_nearest_neighbors", []),
+    ("dbscan", ["--num_rows", "40000", "--num_cols", "64"]),
+    ("umap", ["--num_rows", "20000", "--num_cols", "64"]),
+]
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(f"usage: benchmark_runner <{'|'.join(sorted(set(ALGORITHMS)))}|protocol> [args]")
+        return
+    algo, rest = argv[0], argv[1:]
+    if algo == "protocol":
+        for name, extra in PROTOCOL:
+            log(f"=== protocol: {name} {' '.join(extra)}")
+            # later flags win in argparse, so per-algo sizes in `extra` override
+            # the shared scale flags passed on the command line
+            ALGORITHMS[name]().run(rest + extra)
+        return
+    if algo not in ALGORITHMS:
+        raise SystemExit(f"unknown algorithm {algo!r}; one of {sorted(set(ALGORITHMS))}")
+    if algo == "random_forest_classifier":
+        rest = ["--task", "classification"] + rest
+    elif algo == "random_forest_regressor":
+        rest = ["--task", "regression"] + rest
+    ALGORITHMS[algo]().run(rest)
+
+
+if __name__ == "__main__":
+    main()

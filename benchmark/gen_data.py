@@ -1,0 +1,276 @@
+#
+# Dataset generation for the benchmark suite — the TPU-native rebuild of the
+# reference's `gen_data.py` (sklearn make_blobs/low_rank_matrix/regression/
+# classification -> parquet, reference gen_data.py:248-453) and the sparse
+# generator from `gen_data_distributed.py` (SparseRegressionDataGen :581).
+#
+# Two modes:
+#  * DEVICE mode (the default inside benches): the matrix is generated directly
+#    into HBM, row-sharded over the mesh, in row TILES via a fori_loop of
+#    dynamic_update_slice — peak memory = X + one tile, so the true 1M x 3k
+#    protocol shape fits one v5e chip (11.2 GiB of f32 + tile workspace).
+#    No host->device transfer happens at all.
+#  * HOST mode (gen_*_host / the CLI): numpy arrays (optionally saved .npz) for
+#    tests, small runs, and CPU-side consumers.
+#
+from __future__ import annotations
+
+import argparse
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# device-side generators
+# ---------------------------------------------------------------------------
+
+
+def _tiled_fill(n_rows: int, n_cols: int, tile: int, make_tile, key):
+    """Generate [n_rows, n_cols] on device in `tile`-row blocks.
+
+    The buffer is allocated at EXACTLY [n_rows, n_cols] (peak memory = X + one
+    tile — a padded buffer plus final slice would double the footprint at the
+    1M x 3k protocol shape). The last partial tile relies on
+    `dynamic_update_slice` start-index clipping: its start shifts back so the
+    block fits, overwriting some already-written rows with fresh random values
+    — distributionally identical for iid generators."""
+    import jax
+    import jax.numpy as jnp
+
+    tile = min(tile, n_rows)
+    n_tiles = -(-n_rows // tile)
+
+    def body(i, carry):
+        X, key = carry
+        key, sub = jax.random.split(key)
+        block = make_tile(sub, i * tile)
+        X = jax.lax.dynamic_update_slice(X, block, (i * tile, 0))
+        return X, key
+
+    X0 = jnp.zeros((n_rows, n_cols), jnp.float32)
+    X, _ = jax.lax.fori_loop(0, n_tiles, body, (X0, key))
+    return X
+
+
+def gen_low_rank_device(
+    n_rows: int, n_cols: int, *, rank: int = 16, noise: float = 0.1,
+    seed: int = 0, tile: int = 65536, mesh=None,
+):
+    """Low-rank + noise matrix (the reference's PCA/linear dataset shape,
+    gen_data.py low_rank_matrix analog), generated tile-wise into a row-sharded
+    buffer. Returns (X [n,d] f32, w ones [n])."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.mesh import row_sharding
+
+    tile = min(tile, n_rows)  # make_tile blocks must fit the buffer
+    key = jax.random.PRNGKey(seed)
+    kV, key = jax.random.split(key)
+    V = jax.random.normal(kV, (rank, n_cols), jnp.float32)
+
+    def make_tile(k, row0):
+        k1, k2 = jax.random.split(k)
+        U = jax.random.normal(k1, (tile, rank), jnp.float32)
+        return U @ V + noise * jax.random.normal(k2, (tile, n_cols), jnp.float32)
+
+    fn = lambda key: _tiled_fill(n_rows, n_cols, tile, make_tile, key)  # noqa: E731
+    if mesh is not None:
+        fn = jax.jit(fn, out_shardings=row_sharding(mesh, 2))
+    else:
+        fn = jax.jit(fn)
+    X = fn(key)
+    w = jnp.ones((n_rows,), jnp.float32)
+    if mesh is not None:
+        w = jax.device_put(w, row_sharding(mesh, 1))
+    return X, w
+
+
+def gen_classification_device(
+    n_rows: int, n_cols: int, *, n_classes: int = 2, seed: int = 0,
+    rank: int = 16, tile: int = 65536, mesh=None,
+):
+    """Low-rank features + linear-margin labels (the reference's
+    make_classification analog at protocol scale). Returns (X, y int32, w)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.mesh import row_sharding
+
+    X, w = gen_low_rank_device(
+        n_rows, n_cols, rank=rank, seed=seed, tile=tile, mesh=mesh
+    )
+    key = jax.random.PRNGKey(seed + 1)
+    k1, k2 = jax.random.split(key)
+    coef = jax.random.normal(k1, (n_cols, max(1, n_classes - 1)), jnp.float32) / np.float32(np.sqrt(n_cols))
+
+    def label_fn(X, key):
+        margins = X @ coef  # [n, n_classes-1]
+        noise = 0.5 * jax.random.normal(key, margins.shape, jnp.float32)
+        z = jnp.concatenate([jnp.zeros((X.shape[0], 1), jnp.float32), margins + noise], axis=1)
+        return jnp.argmax(z, axis=1).astype(jnp.int32)
+
+    out_sh = row_sharding(mesh, 1) if mesh is not None else None
+    y = jax.jit(label_fn, out_shardings=out_sh)(X, k2)
+    return X, y, w
+
+
+def gen_regression_device(
+    n_rows: int, n_cols: int, *, seed: int = 0, rank: int = 16,
+    noise: float = 0.1, tile: int = 65536, mesh=None,
+):
+    """Features + linear target (reference make_regression analog).
+    Returns (X, y f32, w, coef)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.mesh import row_sharding
+
+    X, w = gen_low_rank_device(
+        n_rows, n_cols, rank=rank, seed=seed, tile=tile, mesh=mesh
+    )
+    key = jax.random.PRNGKey(seed + 2)
+    k1, k2 = jax.random.split(key)
+    coef = jax.random.normal(k1, (n_cols,), jnp.float32) / np.float32(np.sqrt(n_cols))
+
+    def target_fn(X, key):
+        return X @ coef + noise * jax.random.normal(key, (X.shape[0],), jnp.float32)
+
+    out_sh = row_sharding(mesh, 1) if mesh is not None else None
+    y = jax.jit(target_fn, out_shardings=out_sh)(X, k2)
+    return X, y, w, coef
+
+
+def gen_blobs_device(
+    n_rows: int, n_cols: int, *, centers: int = 10, cluster_std: float = 1.0,
+    seed: int = 0, tile: int = 65536, mesh=None,
+):
+    """Gaussian blobs (reference make_blobs analog) generated tile-wise.
+    Returns (X, y int32 true labels, w)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.mesh import row_sharding
+
+    tile = min(tile, n_rows)  # make_tile blocks must fit the buffer
+    key = jax.random.PRNGKey(seed)
+    kc, key = jax.random.split(key)
+    C = 10.0 * jax.random.normal(kc, (centers, n_cols), jnp.float32)
+
+    def make_tile(k, row0):
+        k1, k2 = jax.random.split(k)
+        assign = jax.random.randint(k1, (tile,), 0, centers)
+        return C[assign] + cluster_std * jax.random.normal(k2, (tile, n_cols), jnp.float32)
+
+    fn = lambda key: _tiled_fill(n_rows, n_cols, tile, make_tile, key)  # noqa: E731
+    fn = jax.jit(fn, out_shardings=row_sharding(mesh, 2) if mesh is not None else None)
+    X = fn(key)
+    w = jnp.ones((n_rows,), jnp.float32)
+    if mesh is not None:
+        w = jax.device_put(w, row_sharding(mesh, 1))
+
+    def label_fn(X):
+        d2 = jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T
+        return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    y = jax.jit(label_fn)(X)
+    return X, y, w
+
+
+# ---------------------------------------------------------------------------
+# host-side generators (tests / CLI / sparse)
+# ---------------------------------------------------------------------------
+
+
+def gen_blobs_host(n_rows: int, n_cols: int, centers: int = 10, seed: int = 0):
+    from sklearn.datasets import make_blobs
+
+    x, y = make_blobs(
+        n_samples=n_rows, n_features=n_cols, centers=centers, random_state=seed
+    )
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def gen_low_rank_host(n_rows: int, n_cols: int, rank: int = 16, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_rows, rank)).astype(np.float32)
+    V = rng.normal(size=(rank, n_cols)).astype(np.float32)
+    return U @ V + 0.1 * rng.normal(size=(n_rows, n_cols)).astype(np.float32)
+
+
+def gen_regression_host(n_rows: int, n_cols: int, seed: int = 0, noise: float = 0.1):
+    rng = np.random.default_rng(seed)
+    x = gen_low_rank_host(n_rows, n_cols, seed=seed)
+    coef = (rng.normal(size=n_cols) / np.sqrt(n_cols)).astype(np.float32)
+    y = x @ coef + noise * rng.normal(size=n_rows).astype(np.float32)
+    return x, y.astype(np.float32), coef
+
+
+def gen_classification_host(n_rows: int, n_cols: int, n_classes: int = 2, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = gen_low_rank_host(n_rows, n_cols, seed=seed)
+    coef = rng.normal(size=(n_cols, max(1, n_classes - 1))) / np.sqrt(n_cols)
+    z = np.concatenate(
+        [np.zeros((n_rows, 1)), x @ coef + 0.5 * rng.normal(size=(n_rows, n_classes - 1))],
+        axis=1,
+    )
+    return x, np.argmax(z, axis=1).astype(np.int64)
+
+
+def gen_sparse_regression_host(
+    n_rows: int, n_cols: int, density: float = 0.001, seed: int = 0, noise: float = 0.01
+):
+    """Sparse CSR regression set (reference gen_data_distributed.py
+    SparseRegressionDataGen:581 analog)."""
+    import scipy.sparse as sp
+
+    rs = np.random.RandomState(seed)
+    x = sp.random(n_rows, n_cols, density=density, random_state=rs, format="csr", dtype=np.float32)
+    rng = np.random.default_rng(seed)
+    coef = np.zeros(n_cols, dtype=np.float32)
+    k = max(1, n_cols // 40)
+    coef[:k] = rng.normal(size=k)
+    y = np.asarray(x @ coef) + noise * rng.normal(size=n_rows).astype(np.float32)
+    return x, y.astype(np.float32), coef
+
+
+def main(argv=None) -> None:
+    """CLI: generate a dataset to .npz (dense) / .npz CSR triple (sparse)."""
+    p = argparse.ArgumentParser(description="benchmark dataset generator")
+    p.add_argument("kind", choices=["blobs", "low_rank", "regression", "classification", "sparse_regression"])
+    p.add_argument("--num_rows", type=int, default=100_000)
+    p.add_argument("--num_cols", type=int, default=300)
+    p.add_argument("--n_classes", type=int, default=2)
+    p.add_argument("--centers", type=int, default=10)
+    p.add_argument("--density", type=float, default=0.001)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output", required=True, help="output .npz path")
+    args = p.parse_args(argv)
+
+    if args.kind == "blobs":
+        x, y = gen_blobs_host(args.num_rows, args.num_cols, args.centers, args.seed)
+        np.savez_compressed(args.output, X=x, y=y)
+    elif args.kind == "low_rank":
+        x = gen_low_rank_host(args.num_rows, args.num_cols, seed=args.seed)
+        np.savez_compressed(args.output, X=x)
+    elif args.kind == "regression":
+        x, y, coef = gen_regression_host(args.num_rows, args.num_cols, seed=args.seed)
+        np.savez_compressed(args.output, X=x, y=y, coef=coef)
+    elif args.kind == "classification":
+        x, y = gen_classification_host(args.num_rows, args.num_cols, args.n_classes, args.seed)
+        np.savez_compressed(args.output, X=x, y=y)
+    else:
+        x, y, coef = gen_sparse_regression_host(
+            args.num_rows, args.num_cols, args.density, args.seed
+        )
+        np.savez_compressed(
+            args.output, data=x.data, indices=x.indices, indptr=x.indptr,
+            shape=np.asarray(x.shape), y=y, coef=coef,
+        )
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+#
+# Benchmark suite smoke tests (reference tests/test_benchmark.py pattern):
+# every per-algo benchmark runs end-to-end at tiny scale and reports sane
+# timings/quality; gen_data generators produce the advertised statistics.
+#
+import os
+
+import numpy as np
+import pytest
+
+from benchmark.benchmark_runner import ALGORITHMS, PROTOCOL
+
+
+SMOKE = {
+    "pca": ["--num_rows", "2000", "--num_cols", "32"],
+    "kmeans": ["--num_rows", "2000", "--num_cols", "16", "--k", "8", "--maxIter", "3"],
+    "linear_regression": ["--num_rows", "2000", "--num_cols", "16"],
+    "logistic_regression": ["--num_rows", "2000", "--num_cols", "16", "--maxIter", "10"],
+    "random_forest": ["--num_rows", "1000", "--num_cols", "8", "--numTrees", "4",
+                      "--maxDepth", "3", "--maxBins", "16"],
+    "nearest_neighbors": ["--num_rows", "1000", "--num_cols", "8", "--k", "4",
+                          "--num_queries", "64"],
+    "approximate_nearest_neighbors": ["--num_rows", "1000", "--num_cols", "16", "--k", "4",
+                                      "--num_queries", "64", "--nlist", "16", "--nprobe", "4"],
+    "dbscan": ["--num_rows", "500", "--num_cols", "8", "--eps", "3.0"],
+    "umap": ["--num_rows", "400", "--num_cols", "8", "--n_epochs", "30"],
+}
+
+
+@pytest.mark.parametrize("algo", sorted(SMOKE))
+def test_benchmark_smoke(algo, tmp_path):
+    report = str(tmp_path / "report.csv")
+    row = ALGORITHMS[algo]().run(SMOKE[algo] + ["--report", report])
+    assert row.get("fit_sec", row.get("kneighbors_sec", 0)) > 0
+    assert os.path.exists(report)
+    with open(report) as f:
+        assert algo in f.read()
+
+
+def test_benchmark_smoke_quality_scores(tmp_path):
+    row = ALGORITHMS["pca"]().run(SMOKE["pca"])
+    assert row["orthonormality_err"] < 1e-3
+    row = ALGORITHMS["logistic_regression"]().run(SMOKE["logistic_regression"])
+    assert row["accuracy"] > 0.8
+    row = ALGORITHMS["linear_regression"]().run(SMOKE["linear_regression"])
+    assert row["rmse_ols"] < 0.5
+
+
+def test_benchmark_ivfpq_smoke(tmp_path):
+    row = ALGORITHMS["approximate_nearest_neighbors"]().run(
+        SMOKE["approximate_nearest_neighbors"] + ["--algorithm", "ivfpq"]
+    )
+    assert row["recall"] > 0.3
+
+
+def test_protocol_covers_all_reference_configs():
+    # the protocol list must carry every BASELINE.md config: both RF tasks,
+    # all three linear configs, the kNN/ANN/DBSCAN/UMAP rows
+    names = [n for n, _ in PROTOCOL]
+    assert names.count("random_forest") == 2
+    for required in ("pca", "kmeans", "linear_regression", "logistic_regression",
+                     "nearest_neighbors", "approximate_nearest_neighbors", "dbscan", "umap"):
+        assert required in names
+
+
+def test_gen_data_cli(tmp_path):
+    from benchmark.gen_data import main as gen_main
+
+    out = str(tmp_path / "d.npz")
+    gen_main(["regression", "--num_rows", "200", "--num_cols", "8", "--output", out])
+    with np.load(out) as z:
+        assert z["X"].shape == (200, 8)
+        assert z["y"].shape == (200,)
+
+    out2 = str(tmp_path / "s.npz")
+    gen_main(["sparse_regression", "--num_rows", "300", "--num_cols", "50",
+              "--density", "0.1", "--output", out2])
+    with np.load(out2) as z:
+        import scipy.sparse as sp
+
+        x = sp.csr_matrix((z["data"], z["indices"], z["indptr"]), shape=tuple(z["shape"]))
+        assert x.shape == (300, 50)
+        assert 0.05 < x.nnz / (300 * 50) < 0.2
+
+
+def test_gen_device_matches_spec(mesh8):
+    from benchmark.gen_data import gen_classification_device, gen_low_rank_device
+
+    X, w = gen_low_rank_device(1000, 24, mesh=mesh8, tile=256)
+    assert X.shape == (1000, 24)
+    xs = np.asarray(X)
+    assert np.isfinite(xs).all()
+    # low-rank + small noise: top singular values dominate
+    s = np.linalg.svd(xs, compute_uv=False)
+    assert s[15] > 5 * s[17]
+
+    X2, y, _ = gen_classification_device(800, 16, n_classes=3, mesh=mesh8, tile=256)
+    assert set(np.unique(np.asarray(y))) <= {0, 1, 2}
+    assert len(np.unique(np.asarray(y))) == 3
