@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/srml_native.dir/src/srml_native.cpp.o"
+  "CMakeFiles/srml_native.dir/src/srml_native.cpp.o.d"
+  "libsrml_native.pdb"
+  "libsrml_native.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/srml_native.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

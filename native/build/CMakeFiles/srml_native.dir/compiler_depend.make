@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for srml_native.
+# This may be replaced when dependencies are built.

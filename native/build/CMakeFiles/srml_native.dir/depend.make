@@ -1,0 +1,2 @@
+# Empty dependencies file for srml_native.
+# This may be replaced when dependencies are built.

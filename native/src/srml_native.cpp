@@ -1,0 +1,159 @@
+//
+// Native compute kernels for spark_rapids_ml_tpu — the in-tree C++ equivalent
+// of the reference's JNI CUDA library (reference jvm/native/src/
+// rapidsml_jni.cu:35-269: dgemmCov covariance gemm, calSVD = eigDC + reverse +
+// signFlip). CPU/C++ here (the TPU compute path is JAX/XLA; this component
+// exists for the reference's native-stack parity: host-side covariance
+// accumulation, a dependency-free symmetric eigensolver, and eigenvector sign
+// canonicalization), surfaced to Python over a plain C ABI via ctypes.
+//
+// Exported C ABI:
+//   srml_cov_accumulate : C += X^T X  (blocked, cache-friendly)
+//   srml_weighted_mean  : m = sum_i w_i x_i / sum_i w_i
+//   srml_eigh_jacobi    : cyclic Jacobi symmetric eigendecomposition
+//                         (ascending eigenvalues, column eigenvectors)
+//   srml_signflip       : per-row max-|.| element made positive
+//                         (rapidsml_jni.cu:35-61 semantics)
+//
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// C += X^T X for row-major X [n, d]; C row-major [d, d].
+// Blocked over rows for cache locality; mirrors dgemmCov accumulation
+// (rapidsml_jni.cu:109-127).
+void srml_cov_accumulate(const double* x, int64_t n, int64_t d, double* c) {
+  const int64_t RB = 256;  // row block
+  for (int64_t r0 = 0; r0 < n; r0 += RB) {
+    const int64_t r1 = (r0 + RB < n) ? r0 + RB : n;
+    for (int64_t i = 0; i < d; ++i) {
+      const double* xi = x + i;
+      for (int64_t j = i; j < d; ++j) {
+        const double* xj = x + j;
+        double acc = 0.0;
+        for (int64_t r = r0; r < r1; ++r) {
+          acc += xi[r * d] * xj[r * d];
+        }
+        c[i * d + j] += acc;
+      }
+    }
+  }
+  // mirror the upper triangle
+  for (int64_t i = 0; i < d; ++i)
+    for (int64_t j = 0; j < i; ++j) c[i * d + j] = c[j * d + i];
+}
+
+void srml_weighted_mean(const double* x, const double* w, int64_t n, int64_t d,
+                        double* mean) {
+  std::vector<double> acc(d, 0.0);
+  double sw = 0.0;
+  for (int64_t r = 0; r < n; ++r) {
+    const double wr = w ? w[r] : 1.0;
+    sw += wr;
+    const double* row = x + r * d;
+    for (int64_t j = 0; j < d; ++j) acc[j] += wr * row[j];
+  }
+  const double inv = sw > 0 ? 1.0 / sw : 0.0;
+  for (int64_t j = 0; j < d; ++j) mean[j] = acc[j] * inv;
+}
+
+// Cyclic Jacobi eigensolver for a symmetric row-major A [d, d].
+// Outputs: eigenvalues ascending in `evals` [d]; eigenvectors as COLUMNS of
+// row-major `evecs` [d, d] (evecs[:, k] pairs with evals[k]).
+// Returns the number of sweeps used, or -1 if not converged.
+int srml_eigh_jacobi(const double* a_in, int64_t d, double* evals,
+                     double* evecs, int max_sweeps, double tol) {
+  std::vector<double> A(a_in, a_in + d * d);
+  // V = I
+  for (int64_t i = 0; i < d; ++i)
+    for (int64_t j = 0; j < d; ++j) evecs[i * d + j] = (i == j) ? 1.0 : 0.0;
+
+  auto off = [&]() {
+    double s = 0.0;
+    for (int64_t i = 0; i < d; ++i)
+      for (int64_t j = i + 1; j < d; ++j) s += A[i * d + j] * A[i * d + j];
+    return std::sqrt(2.0 * s);
+  };
+
+  int sweep = 0;
+  const double scale = off();
+  const double stop = tol * (scale > 0 ? scale : 1.0);
+  for (; sweep < max_sweeps; ++sweep) {
+    if (off() <= stop) break;
+    for (int64_t p = 0; p < d - 1; ++p) {
+      for (int64_t q = p + 1; q < d; ++q) {
+        const double apq = A[p * d + q];
+        if (std::fabs(apq) < 1e-300) continue;
+        const double app = A[p * d + p], aqq = A[q * d + q];
+        const double theta = (aqq - app) / (2.0 * apq);
+        const double t = (theta >= 0 ? 1.0 : -1.0) /
+                         (std::fabs(theta) + std::sqrt(theta * theta + 1.0));
+        const double c = 1.0 / std::sqrt(t * t + 1.0);
+        const double s = t * c;
+        for (int64_t k = 0; k < d; ++k) {
+          const double akp = A[k * d + p], akq = A[k * d + q];
+          A[k * d + p] = c * akp - s * akq;
+          A[k * d + q] = s * akp + c * akq;
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          const double apk = A[p * d + k], aqk = A[q * d + k];
+          A[p * d + k] = c * apk - s * aqk;
+          A[q * d + k] = s * apk + c * aqk;
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          const double vkp = evecs[k * d + p], vkq = evecs[k * d + q];
+          evecs[k * d + p] = c * vkp - s * vkq;
+          evecs[k * d + q] = s * vkp + c * vkq;
+        }
+      }
+    }
+  }
+  const bool converged = off() <= stop;
+  // extract + sort ascending (insertion order map)
+  std::vector<int64_t> order(d);
+  for (int64_t i = 0; i < d; ++i) order[i] = i;
+  std::vector<double> diag(d);
+  for (int64_t i = 0; i < d; ++i) diag[i] = A[i * d + i];
+  for (int64_t i = 1; i < d; ++i) {  // insertion sort: d is small here
+    int64_t oi = order[i];
+    double key = diag[oi];
+    int64_t j = i - 1;
+    while (j >= 0 && diag[order[j]] > key) {
+      order[j + 1] = order[j];
+      --j;
+    }
+    order[j + 1] = oi;
+  }
+  std::vector<double> vtmp(d * d);
+  for (int64_t kcol = 0; kcol < d; ++kcol) {
+    evals[kcol] = diag[order[kcol]];
+    for (int64_t i = 0; i < d; ++i) vtmp[i * d + kcol] = evecs[i * d + order[kcol]];
+  }
+  std::memcpy(evecs, vtmp.data(), sizeof(double) * d * d);
+  return converged ? sweep : -1;
+}
+
+// For each ROW of row-major comps [k, d]: if the max-|.| element is negative,
+// negate the whole row (rapidsml_jni.cu:35-61 signFlip semantics — makes
+// eigenvector signs deterministic).
+void srml_signflip(double* comps, int64_t k, int64_t d) {
+  for (int64_t r = 0; r < k; ++r) {
+    double* row = comps + r * d;
+    double best = 0.0;
+    double val = 0.0;
+    for (int64_t j = 0; j < d; ++j) {
+      const double a = std::fabs(row[j]);
+      if (a > best) {
+        best = a;
+        val = row[j];
+      }
+    }
+    if (val < 0.0)
+      for (int64_t j = 0; j < d; ++j) row[j] = -row[j];
+  }
+}
+
+}  // extern "C"
