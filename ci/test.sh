@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+#
+# CI entry point (the reference's ci/test.sh analog: pre-merge fast suite vs
+# nightly --runslow, ci/test.sh:20-57). Usage:
+#   ci/test.sh            # pre-merge: lint + fast tests
+#   ci/test.sh --nightly  # adds the large-scale --runslow tests
+#
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint (compile + import checks)"
+python ci/lint.py
+
+echo "== unit/parity tests (virtual 8-device CPU mesh)"
+python -m pytest tests/ -q
+
+if [[ "${1:-}" == "--nightly" ]]; then
+  echo "== nightly: large-scale slow tests"
+  python -m pytest tests/ -q --runslow
+  echo "== nightly: multichip dryrun"
+  python __graft_entry__.py
+fi
+echo "CI OK"

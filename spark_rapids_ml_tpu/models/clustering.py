@@ -429,32 +429,41 @@ class DBSCANModel(_DBSCANParams, _TpuModel):
         from ..data import as_pandas
         from ..ops.dbscan import dbscan_fit
         from ..parallel import TpuContext, get_mesh
-        from ..parallel.mesh import default_devices, default_local_device, dtype_scope
+        from ..parallel.context import allgather_concat
+        from ..parallel.mesh import default_devices, dtype_scope
 
         active = TpuContext.current()
-        if active is not None and active.is_spmd:
-            # the compute lives in transform for DBSCAN, so the SPMD guard the
-            # other estimators apply at fit time applies here
-            raise NotImplementedError(
-                "DBSCANModel.transform does not support multi-process SPMD yet; "
-                "run it single-controller (one process driving all devices)"
-            )
+        spmd = active is not None and active.is_spmd
         pdf = as_pandas(dataset)
         extracted = self._pre_process_data(dataset, for_fit=False)
         feats = extracted.features
         if hasattr(feats, "todense"):
             feats = np.asarray(feats.todense())
-        n_dev = min(self.num_workers, len(default_devices()))
+        feats = np.asarray(feats, dtype=np.float32)
+        row_offset, n_local = 0, feats.shape[0]
+        if spmd:
+            # replicated-data strategy (reference clustering.py:1013-1091): the
+            # whole dataset is rendezvous-gathered to every rank (chunked by
+            # config["broadcast_chunk_bytes"]), the N² passes run cooperatively
+            # over the GLOBAL mesh, and each rank keeps its own rows' labels
+            feats, row_offset = allgather_concat(active.rendezvous, feats)
+            mesh = active.mesh
+        else:
+            mesh = get_mesh(min(self.num_workers, len(default_devices())))
         with dtype_scope(np.float32):
             labels, core_idx = dbscan_fit(
-                np.asarray(feats, dtype=np.float32),
-                mesh=get_mesh(n_dev),
+                feats,
+                mesh=mesh,
                 eps=float(self.getOrDefault("eps")),
                 min_samples=int(self.getOrDefault("min_samples")),
                 metric=self.getOrDefault("metric"),
                 max_mbytes_per_batch=self.getOrDefault("max_mbytes_per_batch"),
                 calc_core_sample_indices=bool(self.getOrDefault("calc_core_sample_indices")),
             )
+        if spmd:
+            # labels are GLOBAL; keep this rank's slice (core_sample_indices_
+            # stay global row positions, like the reference's idCol join space)
+            labels = labels[row_offset : row_offset + n_local]
         # labels attach positionally: _pre_process_data must not drop/reorder rows
         assert len(labels) == len(pdf), (
             f"row count mismatch: {len(labels)} labels vs {len(pdf)} input rows"

@@ -215,9 +215,25 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
         return item_out, query_out, knn_df
 
     def exactNearestNeighborsJoin(self, query_df: Any, distCol: str = "distCol") -> Any:
-        """Exploded (item, query, distance) join (reference knn.py:421-468)."""
+        """Exploded (item, query, distance) join (reference knn.py:421-468).
+
+        Single-controller only: under multi-process SPMD the neighbor ids
+        returned by ``kneighbors`` routinely live on OTHER ranks, and the item
+        attribute join is a data-plane operation (the reference performs it as
+        a Spark dataframe join over the distributed item set, knn.py:421-468) —
+        join the per-rank ``knn_df`` outputs against the full item table in the
+        caller's data layer instead."""
         import pandas as pd
 
+        from ..parallel import TpuContext
+
+        active = TpuContext.current()
+        if active is not None and active.is_spmd:
+            raise NotImplementedError(
+                "exactNearestNeighborsJoin/approxSimilarityJoin need the full item "
+                "table on one node; under multi-process SPMD use kneighbors() and "
+                "join the returned ids against your distributed item dataframe"
+            )
         item_out, query_out, knn_df = self.kneighbors(query_df)
         id_col = self.getOrDefault("idCol") if self.isDefined("idCol") else alias.row_number
         item_by_id = item_out.set_index(id_col)
@@ -381,9 +397,17 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
         return _ANNParams._get_solver_params_default(self)
 
     def kneighbors(self, query_df: Any) -> Tuple[Any, Any, Any]:
+        """Under multi-process SPMD this is the reference's local-index +
+        broadcast-query + global top-k merge (knn.py:1189-1261): each rank
+        built an index over ITS item partition at fit time; query blocks are
+        rendezvous-replicated, every rank searches its local index for ALL
+        queries, the per-rank top-k candidate sets are allgathered and merged
+        by distance, and each rank keeps its own queries' rows."""
         import jax
         import pandas as pd
 
+        from ..parallel import TpuContext
+        from ..parallel.context import allgather_concat, allgather_ndarray
         from ..ops.knn import ivfflat_search, ivfpq_search
         from ..parallel.mesh import dtype_scope
 
@@ -395,10 +419,29 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
         item_ids = self._ensure_id(self._item_pdf, item_ex)
         query_ids = self._ensure_id(query_pdf, query_ex)
 
+        active = TpuContext.current()
+        spmd = active is not None and active.is_spmd
+        q_offset, nq_local = 0, len(query_pdf)
+        if spmd:
+            rdv = active.rendezvous
+            # default row-number ids must be GLOBAL: offset by the rows held
+            # on lower ranks (an explicit idCol is used as-is) — item AND
+            # query ids, so per-rank result frames concatenate unambiguously
+            if item_ex.row_id is None:
+                counts = [int(c) for c in rdv.allgather(str(len(item_ids)))]
+                item_ids = item_ids + sum(counts[: active.rank])
+            if query_ex.row_id is None:
+                qcounts = [int(c) for c in rdv.allgather(str(len(query_ids)))]
+                query_ids = query_ids + sum(qcounts[: active.rank])
+
         with dtype_scope(np.float32):
             queries = query_ex.features
             if hasattr(queries, "todense"):
                 queries = np.asarray(queries.todense())
+            if spmd:
+                queries, q_offset = allgather_concat(
+                    active.rendezvous, np.asarray(queries, dtype=np.float32)
+                )
             if self._algorithm == "ivfpq":
                 refine = max(1, int(self._solver_params.get("refine_ratio", 4)))
                 k_adc = min(k * refine, item_ex.n_rows)
@@ -424,6 +467,21 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
         dist = np.asarray(dist, dtype=np.float64)
         idx = np.asarray(idx)
         indices = np.where(idx >= 0, item_ids[np.maximum(idx, 0)], -1)
+        if spmd:
+            # global top-k merge of the per-rank candidate sets (the
+            # reference's _agg_topk groupBy, knn.py:1221-1261), then keep this
+            # rank's own queries
+            d_all = np.concatenate(
+                allgather_ndarray(active.rendezvous, dist), axis=1
+            )  # [nq_global, R*k]
+            i_all = np.concatenate(
+                allgather_ndarray(active.rendezvous, indices.astype(np.int64)), axis=1
+            )
+            order = np.argsort(d_all, axis=1, kind="stable")[:, :k]
+            dist = np.take_along_axis(d_all, order, axis=1)
+            indices = np.take_along_axis(i_all, order, axis=1)
+            dist = dist[q_offset : q_offset + nq_local]
+            indices = indices[q_offset : q_offset + nq_local]
         knn_df = pd.DataFrame(
             {"query_id": query_ids, "indices": list(indices), "distances": list(dist)}
         )

@@ -190,11 +190,7 @@ class UMAP(_UMAPParams, _TpuEstimator):
         if paramMaps:
             raise NotImplementedError("UMAP does not support fitMultiple param maps")
         active = TpuContext.current()
-        if active is not None and active.is_spmd:
-            raise NotImplementedError(
-                "UMAP fit is single-controller (the reference fits on one node too, "
-                "umap.py:830-842); run it outside the SPMD context"
-            )
+        spmd = active is not None and active.is_spmd
 
         extracted = self._pre_process_data(dataset, for_fit=True)
         feats = extracted.features
@@ -206,18 +202,40 @@ class UMAP(_UMAPParams, _TpuEstimator):
         frac = float(self.getSampleFraction())
         if frac < 1.0:
             seed = self.getRandomState()
-            rng = np.random.default_rng(int(seed) if seed is not None else 0)
+            # rank-distinct subsample stream; the union is gathered below
+            rank_salt = active.rank if spmd else 0
+            rng = np.random.default_rng((int(seed) if seed is not None else 0) + rank_salt)
             keep = rng.random(feats.shape[0]) < frac
             feats = feats[keep]
             labels = labels[keep] if labels is not None else None
 
+        if spmd:
+            # the reference fits UMAP on ONE node and broadcasts the model
+            # (umap.py:830-909). SPMD analog: rendezvous-gather the (sampled)
+            # blocks, then every rank runs the IDENTICAL seeded fit on its
+            # LOCAL devices — same data + same seed = the same model
+            # everywhere, no broadcast needed.
+            import jax
+
+            from ..parallel.context import allgather_concat
+
+            feats, _ = allgather_concat(active.rendezvous, feats)
+            if labels is not None:
+                labels, _ = allgather_concat(active.rendezvous, np.asarray(labels))
+            local_devs = jax.local_devices()
+        else:
+            local_devs = None
+
         sp = self._solver_params
-        n_dev = min(self.num_workers, len(default_devices()))
+        n_dev = (
+            len(local_devs) if local_devs is not None
+            else min(self.num_workers, len(default_devices()))
+        )
         with dtype_scope(np.float32):
             state = umap_fit(
                 feats,
                 labels,
-                mesh=get_mesh(n_dev),
+                mesh=get_mesh(n_dev, devices=local_devs),
                 n_neighbors=int(float(sp["n_neighbors"])),
                 n_components=int(sp["n_components"]),
                 n_epochs=sp["n_epochs"],
@@ -299,7 +317,7 @@ class UMAPModel(_UMAPParams, _TpuModel):
         import pandas as pd
 
         from ..ops.umap import umap_transform
-        from ..parallel import get_mesh
+        from ..parallel import TpuContext, get_mesh
         from ..parallel.mesh import default_devices, dtype_scope
 
         extracted = self._pre_process_data(dataset, for_fit=False)
@@ -308,13 +326,22 @@ class UMAPModel(_UMAPParams, _TpuModel):
             feats = np.asarray(feats.todense())
         feats = np.asarray(feats, dtype=np.float32)
         sp = self._solver_params
-        n_dev = min(self.num_workers, len(default_devices()))
+        active = TpuContext.current()
+        if active is not None and active.is_spmd:
+            # distributed transform (reference umap.py:1161-1230): each rank
+            # embeds its LOCAL rows against the frozen model on its own devices
+            import jax
+
+            local_devs = jax.local_devices()
+            mesh = get_mesh(len(local_devs), devices=local_devs)
+        else:
+            mesh = get_mesh(min(self.num_workers, len(default_devices())))
         with dtype_scope(np.float32):
             emb = umap_transform(
                 feats,
                 self.raw_data_,
                 self.embedding_,
-                mesh=get_mesh(n_dev),
+                mesh=mesh,
                 n_neighbors=int(float(sp["n_neighbors"])),
                 n_epochs=sp["n_epochs"],
                 learning_rate=float(sp["learning_rate"]),

@@ -46,6 +46,14 @@ def _tile_rows_for_budget(n: int, max_mbytes: Optional[int], default: int = 8192
     return max(64, min(rows, max(n, 64)))
 
 
+def _replicate_out(mesh, x):
+    """Outputs of the rank-sliced passes come back rows-sharded; replicate the
+    (small, [n]-sized) result so every SPMD process can fetch it whole."""
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+
 def _pairwise_d2(q: jax.Array, x: jax.Array, metric: str) -> jax.Array:
     """Distance tile [tq, n]: squared euclidean, or cosine distance.
 
@@ -106,7 +114,7 @@ def core_mask(
         in_specs=(P(ROWS_AXIS, None), P(None, None), P(None)),
         out_specs=P(ROWS_AXIS),
     )(X, X, valid)
-    return (counts >= min_samples) & valid
+    return _replicate_out(mesh, (counts >= min_samples) & valid)
 
 
 @partial(jax.jit, static_argnames=("mesh", "metric", "tile_rows"))
@@ -141,11 +149,11 @@ def core_components(
 
             return _map_row_tiles(one_tile, Xl, tile_rows, extra=idx_l)
 
-        return shard_map(
+        return _replicate_out(mesh, shard_map(
             local, mesh=mesh,
             in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS), P(None, None), P(None), P(None)),
             out_specs=P(ROWS_AXIS),
-        )(Xc, idx, Xc, valid, labels)
+        )(Xc, idx, Xc, valid, labels))
 
     labels0 = jnp.where(valid, idx, jnp.int32(nc))
 
@@ -202,7 +210,7 @@ def border_assign(
         in_specs=(P(ROWS_AXIS, None), P(None, None), P(None), P(None)),
         out_specs=P(ROWS_AXIS),
     )(X, Xc, core_valid, core_labels)
-    return jnp.where((m < big) & valid, m, -1)
+    return _replicate_out(mesh, jnp.where((m < big) & valid, m, -1))
 
 
 def dbscan_fit(
@@ -238,10 +246,20 @@ def dbscan_fit(
         return a
 
     tile = _tile_rows_for_budget(n, max_mbytes_per_batch)
+    # replicated placement: under multi-process SPMD every rank passes the SAME
+    # host array and the explicit replicated NamedSharding makes it one global
+    # array over the full mesh (single-process device_put suffices otherwise)
+    if jax.process_count() > 1:
+        from ..parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        put = lambda a: jax.device_put(a, rep)  # noqa: E731
+    else:
+        put = jax.device_put
     xp = pad_repl(x, n_dev)
     validp = np.arange(xp.shape[0]) < n
-    X = jax.device_put(xp)  # replicated
-    valid = jax.device_put(validp)
+    X = put(xp)  # replicated
+    valid = put(validp)
 
     core = np.asarray(core_mask(X, valid, eps2, min_samples, mesh=mesh, metric=metric, tile_rows=tile))
     core = core[:n]
@@ -253,8 +271,8 @@ def dbscan_fit(
 
     xc = pad_repl(x[core_idx], n_dev)
     cvalidp = np.arange(xc.shape[0]) < nc
-    Xc = jax.device_put(xc)
-    cvalid = jax.device_put(cvalidp)
+    Xc = put(xc)
+    cvalid = put(cvalidp)
     tile_c = _tile_rows_for_budget(xc.shape[0], max_mbytes_per_batch)
 
     roots = np.asarray(
@@ -269,7 +287,7 @@ def dbscan_fit(
     core_labels_p[:nc] = core_cluster
     labels = np.asarray(
         border_assign(
-            X, valid, Xc, cvalid, jax.device_put(core_labels_p), eps2,
+            X, valid, Xc, cvalid, put(core_labels_p), eps2,
             mesh=mesh, metric=metric, tile_rows=tile,
         )
     )[:n].astype(np.int32)

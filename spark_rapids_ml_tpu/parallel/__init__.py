@@ -21,4 +21,11 @@ from .mesh import (  # noqa: F401
     set_devices,
 )
 from .partition import PartitionDescriptor  # noqa: F401
-from .context import FileRendezvous, LocalRendezvous, Rendezvous, TpuContext  # noqa: F401
+from .context import (  # noqa: F401
+    BarrierRendezvous,
+    FileRendezvous,
+    LocalRendezvous,
+    Rendezvous,
+    TpuContext,
+    allgather_ndarray,
+)

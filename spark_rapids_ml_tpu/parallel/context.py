@@ -30,22 +30,77 @@ __all__ = [
 ]
 
 
-def allgather_ndarray(rendezvous: "Rendezvous", arr) -> List:
+def allgather_ndarray(rendezvous: "Rendezvous", arr, chunk_bytes: Optional[int] = None) -> List:
     """Allgather a host numpy array through the string control plane (base64 of
     the .npy encoding); returns the per-rank arrays in rank order. The analog of
     the reference's base64-over-BarrierTaskContext.allGather payloads
-    (reference tree.py:343, knn.py:689-700)."""
+    (reference tree.py:343, knn.py:689-700).
+
+    Large arrays are split into row chunks of at most `chunk_bytes` (default:
+    the framework's ``config["broadcast_chunk_bytes"]`` — the reference's 8 GB
+    broadcast-chunking knob, clustering.py:1013-1091) so no single control-plane
+    round carries an unbounded payload."""
     import base64
     import io
 
     import numpy as np
 
-    buf = io.BytesIO()
-    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
-    payloads = rendezvous.allgather(base64.b64encode(buf.getvalue()).decode("ascii"))
-    return [
-        np.load(io.BytesIO(base64.b64decode(p)), allow_pickle=False) for p in payloads
-    ]
+    if chunk_bytes is None:
+        from ..core import config
+
+        chunk_bytes = int(config.get("broadcast_chunk_bytes", 8 << 30))
+
+    arr = np.ascontiguousarray(arr)
+    if arr.ndim == 0:  # scalars can't be row-chunked; one round carries them
+        import base64
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        payloads = rendezvous.allgather(base64.b64encode(buf.getvalue()).decode("ascii"))
+        return [
+            np.load(io.BytesIO(base64.b64decode(p)), allow_pickle=False)
+            for p in payloads
+        ]
+    row_bytes = max(1, arr[:1].nbytes if arr.ndim else arr.nbytes)
+    rows_per_chunk = max(1, chunk_bytes // row_bytes)
+    n = arr.shape[0] if arr.ndim else 1
+    n_chunks = max(1, -(-n // rows_per_chunk))
+    # every rank must agree on the ROUND COUNT, not just its own chunking
+    n_chunks = max(
+        int(p) for p in rendezvous.allgather(str(n_chunks))
+    )
+    rows_per_chunk = max(1, -(-n // n_chunks))
+
+    def ser(a):
+        buf = io.BytesIO()
+        np.save(buf, a, allow_pickle=False)
+        return base64.b64encode(buf.getvalue()).decode("ascii")
+
+    def de(p):
+        return np.load(io.BytesIO(base64.b64decode(p)), allow_pickle=False)
+
+    gathered_chunks: List[List] = []
+    for c in range(n_chunks):
+        part = arr[c * rows_per_chunk : (c + 1) * rows_per_chunk]
+        gathered_chunks.append([de(p) for p in rendezvous.allgather(ser(part))])
+    out = []
+    for r in range(rendezvous.nranks):
+        parts = [gathered_chunks[c][r] for c in range(n_chunks)]
+        out.append(np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0])
+    return out
+
+
+def allgather_concat(rendezvous: "Rendezvous", arr):
+    """Gather every rank's row block and concatenate in rank order; returns
+    ``(global_array, this_rank_row_offset)`` — the shared idiom behind the
+    replicated-data strategies (DBSCAN full-set gather, ANN/kNN query
+    replication, UMAP fit-sample union)."""
+    import numpy as np
+
+    blocks = allgather_ndarray(rendezvous, arr)
+    offset = sum(len(b) for b in blocks[: rendezvous.rank])
+    return np.concatenate(blocks, axis=0), offset
 
 
 class Rendezvous:
@@ -96,6 +151,28 @@ class LocalRendezvous(Rendezvous):
         out = list(self._shared.slots)  # type: ignore[arg-type]
         self._shared.barrier.wait()  # don't let a fast rank overwrite slots early
         return out  # type: ignore[return-value]
+
+
+class BarrierRendezvous(Rendezvous):
+    """Adapter over a Spark `BarrierTaskContext`-shaped object — anything with
+    ``allGather(str) -> list[str]`` plus a task-info surface. This is the
+    control plane the reference uses directly (cuml_context.py:80-103,
+    utils.py:205-207): running the framework inside a Spark barrier stage means
+    constructing ``TpuContext(rank, nranks, BarrierRendezvous(ctx))`` in the
+    task body, exactly where the reference builds its CumlContext."""
+
+    def __init__(self, barrier_ctx, rank: Optional[int] = None, nranks: Optional[int] = None):
+        self._ctx = barrier_ctx
+        if rank is None:
+            rank = int(barrier_ctx.partitionId())
+        if nranks is None:
+            infos = barrier_ctx.getTaskInfos()
+            nranks = len(infos)
+        self.rank = rank
+        self.nranks = nranks
+
+    def allgather(self, payload: str) -> List[str]:
+        return list(self._ctx.allGather(payload))
 
 
 class FileRendezvous(Rendezvous):

@@ -72,6 +72,35 @@ def main() -> None:
         )
         query_df = df.iloc[:5]
         _, _, knn_df = gnn.kneighbors(query_df)
+        # DBSCAN: replicated-data SPMD — every rank gathers the full set and
+        # the N² passes run cooperatively over the global mesh
+        from spark_rapids_ml_tpu.models.clustering import DBSCAN
+
+        db_model = DBSCAN(eps=1.5, min_samples=3).setFeaturesCol("features").fit(df)
+        db_labels = db_model.transform(df)["prediction"].to_numpy()
+        # UMAP: gathered-data deterministic per-rank fit on local devices
+        from spark_rapids_ml_tpu.models.umap import UMAP
+
+        um = (
+            UMAP(n_components=2, n_neighbors=5.0, n_epochs=30, random_state=3, init="random")
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+        um_emb = np.asarray(um.embedding_)
+        # ANN: per-rank local index, broadcast queries, global top-k merge;
+        # nprobe == nlist makes each local search exhaustive, so the merged
+        # result is exact
+        from spark_rapids_ml_tpu.models.knn import ApproximateNearestNeighbors
+
+        ann = (
+            ApproximateNearestNeighbors(
+                k=3, algorithm="ivfflat", algoParams={"nlist": 4, "nprobe": 4}
+            )
+            .setInputCol("features")
+            .setIdCol("id")
+            .fit(df)
+        )
+        _, _, ann_df = ann.kneighbors(query_df)
     np.savez(
         os.path.join(out_dir, f"rank{rank}.npz"),
         pca_components=pca.components_,
@@ -89,6 +118,10 @@ def main() -> None:
         knn_query_ids=knn_df["query_id"].to_numpy(),
         knn_indices=np.stack(knn_df["indices"].to_numpy()),
         knn_distances=np.stack(knn_df["distances"].to_numpy()),
+        db_labels=db_labels,
+        um_emb=um_emb,
+        ann_indices=np.stack(ann_df["indices"].to_numpy()),
+        ann_distances=np.stack(ann_df["distances"].to_numpy()),
     )
 
 

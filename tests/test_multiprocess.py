@@ -125,6 +125,32 @@ def test_multiprocess_fit_matches_single_process(nranks, tmp_path):
             got["knn_distances"], np.stack(knn_ref["distances"].to_numpy()),
             rtol=1e-7, atol=1e-6,  # self-distances are 0 ± sqrt-expansion noise
         )
+        # DBSCAN: replicated-data SPMD labels equal the single-process labels
+        # for this rank's rows (deterministic: same full data, same program)
+        from spark_rapids_ml_tpu.models.clustering import DBSCAN
+
+        db_ref = (
+            DBSCAN(eps=1.5, min_samples=3).setFeaturesCol("features").fit(full_df)
+            .transform(full_df)["prediction"].to_numpy()
+        )
+        np.testing.assert_array_equal(got["db_labels"], db_ref[bounds[r] : bounds[r + 1]])
+        # UMAP: every rank fit the same gathered data with the same seed ->
+        # identical embeddings across ranks; finite and right-shaped
+        emb = got["um_emb"]
+        assert emb.shape == (len(X), 2) and np.isfinite(emb).all()
+        if r > 0:
+            ref0 = np.load(os.path.join(out_dir, "rank0.npz"))["um_emb"]
+            np.testing.assert_allclose(emb, ref0, rtol=1e-6, atol=1e-7)
+        # ANN with nprobe == nlist: local searches are exhaustive, so the
+        # merged global top-k equals brute force (compare neighbor id sets —
+        # equidistant neighbors may order differently)
+        q = X[bounds[r] : bounds[r] + 5]
+        d2 = ((q[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        brute = np.argsort(d2, axis=1, kind="stable")[:, :3]
+        for qi in range(5):
+            assert set(got["ann_indices"][qi]) == set(brute[qi]), (
+                f"rank {r} q{qi}: {got['ann_indices'][qi]} vs {brute[qi]}"
+            )
 
 
 def test_multiprocess_default_is_opt_in(tmp_path):
