@@ -2,7 +2,7 @@
 # Minimal lint gate (the reference runs mypy+black+isort via ci/lint_python.py;
 # none of those are baked into this image, so the gate checks what the
 # toolchain supports everywhere: every source file compiles, has no tabs, no
-# trailing whitespace, and the package + benchmark suite import cleanly).
+# trailing whitespace, and the package + benchmark roots import cleanly).
 #
 from __future__ import annotations
 
@@ -28,8 +28,17 @@ for target in TARGETS:
             if line != line.rstrip():
                 failures.append(f"{path}:{lineno}: trailing whitespace")
 
+import importlib
+
+sys.path.insert(0, str(ROOT))  # the script lives in ci/, imports resolve from the repo root
+for mod in ("spark_rapids_ml_tpu", "benchmark.benchmark_runner"):
+    try:
+        importlib.import_module(mod)
+    except Exception as e:  # import-time breakage must fail the gate
+        failures.append(f"import {mod}: {e!r}")
+
 if failures:
     print("\n".join(failures))
     print(f"lint: {len(failures)} issue(s)")
     sys.exit(1)
-print(f"lint: OK ({len(TARGETS)} trees)")
+print(f"lint: OK ({len(TARGETS)} trees + imports)")

@@ -11,13 +11,13 @@ cd "$(dirname "$0")/.."
 echo "== lint (compile + import checks)"
 python ci/lint.py
 
-echo "== unit/parity tests (virtual 8-device CPU mesh)"
-python -m pytest tests/ -q
-
 if [[ "${1:-}" == "--nightly" ]]; then
-  echo "== nightly: large-scale slow tests"
+  echo "== nightly: full suite incl. large-scale slow tests"
   python -m pytest tests/ -q --runslow
   echo "== nightly: multichip dryrun"
   python __graft_entry__.py
+else
+  echo "== unit/parity tests (virtual 8-device CPU mesh)"
+  python -m pytest tests/ -q
 fi
 echo "CI OK"

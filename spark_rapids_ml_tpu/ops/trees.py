@@ -6,7 +6,7 @@
 # TPU-native design (no CUDA-style per-node kernels):
 #  * features are QUANTILE-BINNED once (maxBins edges from a host sample — the
 #    same sketch-then-bin scheme Spark ML uses), so tree growth only touches
-#    int32 bin ids;
+#    compact bin ids (uint8 at <=256 bins);
 #  * trees grow LEVEL-WISE in a full binary-array layout: one
 #    `jax.ops.segment_sum` scatter per level builds the (node, feature, bin,
 #    stat) histogram for every active row at once, prefix sums over bins give
@@ -57,10 +57,15 @@ def quantile_bins(x_host: np.ndarray, max_bins: int, sample_cap: int = 100_000, 
 
 @jax.jit
 def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
-    """X [n, d] -> int32 bin ids [n, d] via per-feature searchsorted."""
+    """X [n, d] -> bin ids [n, d] via per-feature searchsorted.
+
+    Stored uint8 when max_bins <= 256 (the protocol's 128-bin config halves the
+    persistent binned-matrix footprint vs int32 — 3 GiB instead of 12 GiB at
+    1M x 3k); consumers upcast at the arithmetic sites."""
+    out_dtype = jnp.uint8 if edges.shape[1] + 1 <= 256 else jnp.int32
 
     def one_feature(col, e):
-        return jnp.searchsorted(e, col, side="left").astype(jnp.int32)
+        return jnp.searchsorted(e, col, side="left").astype(out_dtype)
 
     return jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(X, edges)
 
@@ -71,36 +76,39 @@ def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
 
 
 def _split_gains(hist: jax.Array, impurity: str, min_instances: float):
-    """hist: [C, d, B, S] per-node histograms. Returns (gain [C, d, B],
-    total [C, S]) where gain[c, f, b] is the impurity decrease of splitting
-    node c on feature f at bin <= b."""
-    left = jnp.cumsum(hist, axis=2)  # [C, d, B, S]
-    total = left[:, 0, -1, :]  # [C, S] (any feature's full sum)
-    right = total[:, None, None, :] - left
+    """hist: [S, C, d, B] per-node histograms (STAT-MAJOR layout: the bin axis
+    B sits in the 128-lane tile dimension — a stat-minor [C, d, B, S] layout
+    pads S=2 up to 128 lanes, a 64x memory blowup that crashes the TPU worker
+    at benchmark scale). Returns (gain [C, d, B], total [C, S]) where
+    gain[c, f, b] is the impurity decrease of splitting node c on feature f at
+    bin <= b."""
+    left = jnp.cumsum(hist, axis=3)  # [S, C, d, B]
+    total_s = left[:, :, 0, -1]  # [S, C] (any feature's full sum)
+    right = total_s[:, :, None, None] - left
 
     if impurity in ("gini", "entropy"):
-        def node_impurity(stats):  # stats [..., S] class counts
-            cnt = jnp.sum(stats, axis=-1)
-            p = stats / jnp.maximum(cnt, 1e-30)[..., None]
+        def node_impurity(stats):  # stats [S, ...] class counts
+            cnt = jnp.sum(stats, axis=0)
+            p = stats / jnp.maximum(cnt, 1e-30)[None]
             if impurity == "gini":
-                return 1.0 - jnp.sum(p * p, axis=-1), cnt
-            return -jnp.sum(jnp.where(p > 0, p * jnp.log2(p), 0.0), axis=-1), cnt
+                return 1.0 - jnp.sum(p * p, axis=0), cnt
+            return -jnp.sum(jnp.where(p > 0, p * jnp.log2(p), 0.0), axis=0), cnt
 
         imp_l, cnt_l = node_impurity(left)
         imp_r, cnt_r = node_impurity(right)
-        imp_p, cnt_p = node_impurity(total)
+        imp_p, cnt_p = node_impurity(total_s)  # [C], [C]
         cnt_p_b = cnt_p[:, None, None]
         weighted_child = (cnt_l * imp_l + cnt_r * imp_r) / jnp.maximum(cnt_p_b, 1e-30)
         gain = imp_p[:, None, None] - weighted_child
     else:  # variance (regression): S = (w, wy, wyy)
-        w_l, wy_l, wyy_l = left[..., 0], left[..., 1], left[..., 2]
-        w_r, wy_r, wyy_r = right[..., 0], right[..., 1], right[..., 2]
-        w_p = total[:, 0][:, None, None]
+        w_l, wy_l, wyy_l = left[0], left[1], left[2]
+        w_r, wy_r, wyy_r = right[0], right[1], right[2]
+        w_p = total_s[0][:, None, None]
 
         def var_sum(w_, wy_, wyy_):  # Σw·(y-μ)² = Σwy² − (Σwy)²/Σw
             return wyy_ - wy_ * wy_ / jnp.maximum(w_, 1e-30)
 
-        ss_p = var_sum(total[:, 0], total[:, 1], total[:, 2])[:, None, None]
+        ss_p = var_sum(total_s[0], total_s[1], total_s[2])[:, None, None]
         ss_child = var_sum(w_l, wy_l, wyy_l) + var_sum(w_r, wy_r, wyy_r)
         gain = (ss_p - ss_child) / jnp.maximum(w_p, 1e-30)
         cnt_l, cnt_r = w_l, w_r
@@ -108,8 +116,8 @@ def _split_gains(hist: jax.Array, impurity: str, min_instances: float):
 
     valid = (cnt_l >= min_instances) & (cnt_r >= min_instances)
     # the last bin means "everything left" — never a real split
-    valid = valid & (jnp.arange(hist.shape[2])[None, None, :] < hist.shape[2] - 1)
-    return jnp.where(valid, gain, -jnp.inf), total
+    valid = valid & (jnp.arange(hist.shape[3])[None, None, :] < hist.shape[3] - 1)
+    return jnp.where(valid, gain, -jnp.inf), total_s.T
 
 
 def _feature_subset_mask(key, n_nodes: int, d: int, m: int):
@@ -128,7 +136,7 @@ def _feature_subset_mask(key, n_nodes: int, d: int, m: int):
 
 def _grow_tree(
     key,
-    Xb: jax.Array,  # [n, d] int32 bins
+    Xb: jax.Array,  # [n, d] bin ids (uint8 at <=256 bins; upcast at arithmetic sites)
     stats_row: jax.Array,  # [n, S] per-row stat contributions (already w-weighted)
     params: Dict,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -154,19 +162,59 @@ def _grow_tree(
         key, kf = jax.random.split(key)
         fmask_level = _feature_subset_mask(kf, level_size, d, params["max_features"])
 
-        for ci in range(n_chunks):
+        # histogram accumulation is tiled over ROWS: the scatter operand is
+        # bounded to ~4M elements per pass. One [n*d]-sized scatter both
+        # crashes the TPU worker at moderate scale (observed: kernel fault at
+        # 50k x 500) and would materialize a 12 GB seg intermediate at the
+        # 1M x 3k protocol shape.
+        tile_rows = min(n, max(256, 4_000_000 // max(d, 1)))
+        n_row_tiles = -(-n // tile_rows)
+        n_seg = chunk * d * B
+
+        def chunk_body(ci, carry):
+            feature, split_bin, node_stats = carry
             c0 = offset + ci * chunk
-            local = node_id - c0  # node index within chunk
-            in_chunk = active & (local >= 0) & (local < chunk)
-            # flat segment id: (node_local * d + f) * B + bin
-            seg = (local[:, None] * d + jnp.arange(d)[None, :]) * B + Xb  # [n, d]
-            seg = jnp.where(in_chunk[:, None], seg, chunk * d * B)  # dump masked rows
-            hist_flat = jax.ops.segment_sum(
-                jnp.broadcast_to(stats_row[:, None, :], (n, d, S)).reshape(-1, S),
-                seg.reshape(-1),
-                num_segments=chunk * d * B + 1,
-            )[:-1]
-            hist = hist_flat.reshape(chunk, d, B, S)
+
+            def row_tile_body(ti, hist_cols):
+                # clamp the last tile back and mask rows already covered
+                r0 = jnp.minimum(ti * tile_rows, n - tile_rows)
+                fresh = (r0 + jnp.arange(tile_rows)) >= ti * tile_rows
+                xb_t = jax.lax.dynamic_slice(Xb, (r0, 0), (tile_rows, d))
+                nid_t = jax.lax.dynamic_slice(node_id, (r0,), (tile_rows,))
+                act_t = jax.lax.dynamic_slice(active, (r0,), (tile_rows,))
+                st_t = jax.lax.dynamic_slice(stats_row, (r0, 0), (tile_rows, S))
+                local = nid_t - c0
+                ok = act_t & (local >= 0) & (local < chunk) & fresh
+                # flat segment id: (node_local * d + f) * B + bin
+                seg = (local[:, None] * d + jnp.arange(d)[None, :]) * B + xb_t.astype(jnp.int32)
+                seg = jnp.where(ok[:, None], seg, n_seg)  # dump masked rows
+                seg_flat = seg.reshape(-1)
+                # one 1-D scatter PER STAT column: a [rows, S] scatter operand
+                # gets its minor dim padded to the 128-lane tile on TPU (64x
+                # memory blowup at S=2); 1-D operands tile without padding
+                return tuple(
+                    hist_cols[s_i]
+                    + jax.ops.segment_sum(
+                        jnp.broadcast_to(st_t[:, s_i : s_i + 1], (tile_rows, d)).reshape(-1),
+                        seg_flat,
+                        num_segments=n_seg + 1,
+                    )[:-1]
+                    for s_i in range(S)
+                )
+
+            from ..parallel.mesh import ROWS_AXIS
+
+            # the carry accumulates per-shard values: type it as varying over
+            # the mesh axis (shard_map vma typing, like the KMeans carry)
+            hist_cols0 = tuple(
+                jax.lax.pcast(jnp.zeros((n_seg,), stats_row.dtype), ROWS_AXIS, to="varying")
+                for _ in range(S)
+            )
+            if n_row_tiles == 1:
+                hist_cols = row_tile_body(0, hist_cols0)
+            else:
+                hist_cols = jax.lax.fori_loop(0, n_row_tiles, row_tile_body, hist_cols0)
+            hist = jnp.stack(hist_cols, axis=0).reshape(S, chunk, d, B)
 
             gain, total = _split_gains(hist, params["impurity"], params["min_instances"])
             fmask = jax.lax.dynamic_slice_in_dim(fmask_level, ci * chunk, chunk, 0)
@@ -184,12 +232,24 @@ def _grow_tree(
                 split_bin, jnp.where(is_split, best_b, 0), c0, 0
             )
             node_stats = jax.lax.dynamic_update_slice(node_stats, total, (c0, 0))
+            return feature, split_bin, node_stats
+
+        # deep levels iterate chunks in a fori_loop: unrolling them in Python
+        # (63 chunk bodies at depth 13) produced an HLO big enough to break the
+        # remote TPU compiler; one rolled body per level keeps it linear in
+        # depth
+        if n_chunks == 1:
+            feature, split_bin, node_stats = chunk_body(0, (feature, split_bin, node_stats))
+        else:
+            feature, split_bin, node_stats = jax.lax.fori_loop(
+                0, n_chunks, chunk_body, (feature, split_bin, node_stats)
+            )
 
         # advance rows: split nodes send rows to children; leaf rows deactivate
         node_f = feature[node_id]
         went_split = active & (node_f >= 0)
         row_bin = jnp.take_along_axis(Xb, jnp.maximum(node_f, 0)[:, None], axis=1)[:, 0]
-        go_left = row_bin <= split_bin[node_id]
+        go_left = row_bin.astype(jnp.int32) <= split_bin[node_id]
         child = 2 * node_id + jnp.where(go_left, 1, 2)
         node_id = jnp.where(went_split, child, node_id)
         active = went_split
@@ -200,7 +260,13 @@ def _grow_tree(
     local = node_id - offset
     in_level = active & (local >= 0)
     seg = jnp.where(in_level, local, level_size)
-    last_stats = jax.ops.segment_sum(stats_row, seg, num_segments=level_size + 1)[:-1]
+    last_stats = jnp.stack(
+        [
+            jax.ops.segment_sum(stats_row[:, s_i], seg, num_segments=level_size + 1)[:-1]
+            for s_i in range(S)
+        ],
+        axis=1,
+    )
     node_stats = jax.lax.dynamic_update_slice(node_stats, last_stats, (offset, 0))
     return feature, split_bin, node_stats
 
@@ -218,7 +284,7 @@ def _grow_tree(
     ),
 )
 def forest_fit(
-    Xb: jax.Array,  # [n_pad, d] int32 (row-sharded)
+    Xb: jax.Array,  # [n_pad, d] bin ids (row-sharded; uint8 at <=256 bins)
     stats_row: jax.Array,  # [n_pad, S] per-row stats, zero on padding
     w: jax.Array,  # [n_pad] weights (bootstrap sampling distribution)
     seed: int,

@@ -52,9 +52,6 @@ def allgather_ndarray(rendezvous: "Rendezvous", arr, chunk_bytes: Optional[int] 
 
     arr = np.ascontiguousarray(arr)
     if arr.ndim == 0:  # scalars can't be row-chunked; one round carries them
-        import base64
-        import io
-
         buf = io.BytesIO()
         np.save(buf, arr, allow_pickle=False)
         payloads = rendezvous.allgather(base64.b64encode(buf.getvalue()).decode("ascii"))
@@ -62,9 +59,9 @@ def allgather_ndarray(rendezvous: "Rendezvous", arr, chunk_bytes: Optional[int] 
             np.load(io.BytesIO(base64.b64decode(p)), allow_pickle=False)
             for p in payloads
         ]
-    row_bytes = max(1, arr[:1].nbytes if arr.ndim else arr.nbytes)
+    row_bytes = max(1, arr[:1].nbytes)
     rows_per_chunk = max(1, chunk_bytes // row_bytes)
-    n = arr.shape[0] if arr.ndim else 1
+    n = arr.shape[0]
     n_chunks = max(1, -(-n // rows_per_chunk))
     # every rank must agree on the ROUND COUNT, not just its own chunking
     n_chunks = max(
