@@ -16,13 +16,18 @@ from .utils import with_benchmark
 class BenchmarkDBSCAN(BenchmarkBase):
     name = "dbscan"
     extra_args = {
-        "eps": (float, 3.0, "neighborhood radius"),
+        "eps": (float, 0.0, "neighborhood radius (0 = auto 1.5*sqrt(num_cols), "
+                            "matching the blob generator's unit-variance noise)"),
         "min_samples": (int, 5, "core-point threshold"),
         "centers": (int, 20, "generating blob count"),
         "max_mbytes_per_batch": (int, 512, "distance-tile memory budget"),
     }
 
     def gen_dataset(self, args, mesh):
+        if not args.eps:
+            # in d dims the typical in-cluster pair distance is ~sqrt(2d)·std;
+            # a fixed low-dim default marks everything noise at d=64
+            args.eps = 1.5 * float(np.sqrt(args.num_cols))
         x, y = gen_blobs_host(args.num_rows, args.num_cols, centers=args.centers, seed=args.seed)
         return {"x": x, "y": y}
 

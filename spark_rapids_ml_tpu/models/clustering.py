@@ -131,7 +131,12 @@ class KMeans(_KMeansParams, _TpuEstimator):
         return self._set_params(weightCol=value)
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
-        from ..ops.kmeans import kmeans_fit, kmeans_plus_plus_init, random_init
+        from ..ops.kmeans import (
+            kmeans_fit,
+            kmeans_plus_plus_init,
+            random_init,
+            scalable_kmeans_init,
+        )
 
         x_host = extracted.features
         w_host = extracted.weight
@@ -164,7 +169,11 @@ class KMeans(_KMeansParams, _TpuEstimator):
                 w_init = None if ws is None else inputs.allgather_array(ws)
             if init_mode == "random":
                 centers0 = random_init(x_init, k, seed)
-            else:  # 'k-means||' / 'scalable-k-means++'
+            elif k >= 64:
+                # true k-means|| for large k: O(rounds) device passes instead
+                # of k sequential host passes (minutes at the protocol k=1000)
+                centers0 = scalable_kmeans_init(x_init, k, seed, w_init)
+            else:  # small k: classic k-means++ (exactness-friendly for tests)
                 centers0 = kmeans_plus_plus_init(x_init, k, seed, w_init)
             centers0 = centers0.astype(inputs.dtype)
             state = kmeans_fit(

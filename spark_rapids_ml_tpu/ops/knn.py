@@ -61,7 +61,45 @@ def _tile_topk(items, queries, valid, k, batch_queries=4096):
     return dists.reshape(-1, k)[:nq], idxs.reshape(-1, k)[:nq]
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "batch_queries"))
+@partial(jax.jit, static_argnames=("kk",))
+def _topk_tile_1dev(items, valid, item_sq, q, *, kk):
+    d2 = item_sq[None, :] - 2.0 * (q @ items.T)
+    d2 = jnp.where(valid[None, :], d2, jnp.inf)
+    neg_d, idx = jax.lax.top_k(-d2, kk)
+    return -neg_d + jnp.sum(q * q, axis=1)[:, None], idx
+
+
+def _exact_knn_1dev(items, valid, queries, k, batch_queries):
+    """Single-device exact kNN with a HOST loop over query tiles: each tile is
+    one top-level program (matmul + top_k). The shard_map/in-program tiling
+    form costs a full copy of the item matrix at benchmark scale (measured
+    +11 GiB at 1M x 3k -> OOM), same XLA behavior as the KMeans tile loop."""
+    import numpy as np
+
+    nq = queries.shape[0]
+    kk = min(k, items.shape[0])
+    batch_queries = min(batch_queries, nq)
+    item_sq = jax.jit(lambda it: jnp.sum(it * it, axis=1))(items)
+    d_parts, i_parts = [], []
+    for start in range(0, nq, batch_queries):
+        # keep every tile the SAME shape (clamp back + drop the overlap) so the
+        # tile program compiles exactly once
+        s0 = min(start, nq - batch_queries)
+        q = queries[s0 : s0 + batch_queries]
+        d2, idx = _topk_tile_1dev(items, valid, item_sq, q, kk=kk)
+        fresh = start - s0
+        d_parts.append(np.asarray(d2)[fresh:])
+        i_parts.append(np.asarray(idx)[fresh:])
+    # results stay HOST numpy: every caller fetches to numpy immediately, so a
+    # device round-trip here would be pure waste
+    d2 = np.concatenate(d_parts, axis=0)
+    idx = np.concatenate(i_parts, axis=0)
+    if kk < k:
+        d2 = np.pad(d2, ((0, 0), (0, k - kk)), constant_values=np.inf)
+        idx = np.pad(idx, ((0, 0), (0, k - kk)))
+    return np.sqrt(np.maximum(d2, 0.0)), idx
+
+
 def exact_knn(
     items: jax.Array,  # [n_pad, d] row-sharded
     valid: jax.Array,  # [n_pad] bool (False on padding)
@@ -74,6 +112,23 @@ def exact_knn(
     """Global exact kNN: returns (distances [nq, k], GLOBAL item indices [nq, k])
     sorted ascending by distance. Distances are euclidean (not squared), Spark/
     cuML convention."""
+    if mesh.devices.size == 1:
+        return _exact_knn_1dev(items, valid, queries, k, batch_queries)
+    return _exact_knn_sharded(
+        items, valid, queries, mesh=mesh, k=k, batch_queries=batch_queries
+    )
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "batch_queries"))
+def _exact_knn_sharded(
+    items: jax.Array,
+    valid: jax.Array,
+    queries: jax.Array,
+    *,
+    mesh,
+    k: int,
+    batch_queries: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
     n_dev = mesh.devices.size
     n_loc = items.shape[0] // n_dev
 
@@ -139,13 +194,14 @@ def _coarse_quantizer(x, n_lists: int, seed: int, kmeans_iters: int = 10):
     sorted-fill layout (order, offsets, counts, L)."""
     import numpy as np
 
-    from .kmeans import kmeans_fit, kmeans_plus_plus_init
+    from .kmeans import kmeans_fit, kmeans_plus_plus_init, scalable_kmeans_init
     from ..parallel.mesh import get_mesh
 
     x = np.asarray(x, dtype=np.float32)
     n, d = x.shape
     n_lists = min(n_lists, n)
-    centers0 = kmeans_plus_plus_init(x, n_lists, seed).astype(np.float32)
+    init = scalable_kmeans_init if n_lists >= 64 else kmeans_plus_plus_init
+    centers0 = init(x, n_lists, seed).astype(np.float32)
     state = kmeans_fit(
         jax.device_put(x), jnp.ones((n,), jnp.float32), jax.device_put(centers0),
         mesh=get_mesh(1), max_iter=kmeans_iters, tol=1e-6,
@@ -311,32 +367,56 @@ def ivfflat_search(
     batch_queries: int = 1024,
 ) -> Tuple[jax.Array, jax.Array]:
     """Probe the n_probes nearest lists per query; returns (sqrt distances,
-    item ids) [nq, k] (id −1 where fewer than k candidates)."""
+    item ids) [nq, k] (id −1 where fewer than k candidates).
+
+    Lists are scanned ONE PROBE AT A TIME with a running top-k: gathering all
+    probed buckets at once is [B, P, L, d] — hundreds of GB at benchmark
+    scale. The query-tile width additionally adapts so the per-probe gather
+    [B, L, d] stays under ~1 GB."""
     nq, d = queries.shape
     C, L, _ = buckets.shape
     n_probes = min(n_probes, C)
+    # bound the per-probe gather to ~1 GB of f32
+    b_mem = max(16, int(1e9 / max(1, 4 * L * d)))
+    batch_queries = max(16, min(batch_queries, b_mem))
     n_tiles = max(1, -(-nq // batch_queries))
     pad = n_tiles * batch_queries - nq
     qp = jnp.pad(queries, ((0, pad), (0, 0)))
+    kk = min(k, n_probes * L)
 
     def one_tile(q):  # [B, d]
         B = q.shape[0]
         cd = jnp.sum(centroids * centroids, 1)[None, :] - 2.0 * q @ centroids.T
         _, probe = jax.lax.top_k(-cd, n_probes)  # [B, n_probes]
-        cand = buckets[probe]  # [B, n_probes, L, d]
-        cand_ids = bucket_ids[probe]  # [B, n_probes, L]
-        cand = cand.reshape(B, n_probes * L, d)
-        cand_ids = cand_ids.reshape(B, n_probes * L)
-        d2 = jnp.sum((cand - q[:, None, :]) ** 2, axis=2)
-        d2 = jnp.where(cand_ids >= 0, d2, jnp.inf)
-        neg_d, pos = jax.lax.top_k(-d2, min(k, n_probes * L))
-        ids = jnp.take_along_axis(cand_ids, pos, axis=1)
-        dist = jnp.maximum(-neg_d, 0.0)
-        if dist.shape[1] < k:  # fewer candidates than k: pad
-            padk = k - dist.shape[1]
-            dist = jnp.pad(dist, ((0, 0), (0, padk)), constant_values=jnp.inf)
-            ids = jnp.pad(ids, ((0, 0), (0, padk)), constant_values=-1)
-        return jnp.sqrt(dist), ids
+        q_sq = jnp.sum(q * q, axis=1)  # [B]
+
+        def probe_body(p_i, carry):
+            best_d, best_i = carry  # [B, kk]
+            pb = probe[:, p_i]  # [B]
+            bucket = buckets[pb]  # [B, L, d] — the bounded gather
+            ids = bucket_ids[pb]  # [B, L]
+            # ||q − x||² = ||q||² − 2 q·x + ||x||²; q·x via batched matmul
+            d2 = (
+                q_sq[:, None]
+                - 2.0 * jnp.einsum("bld,bd->bl", bucket, q)
+                + jnp.sum(bucket * bucket, axis=2)
+            )
+            d2 = jnp.where(ids >= 0, d2, jnp.inf)
+            cat_d = jnp.concatenate([best_d, d2], axis=1)
+            cat_i = jnp.concatenate([best_i, ids], axis=1)
+            neg_d, pos = jax.lax.top_k(-cat_d, kk)
+            return -neg_d, jnp.take_along_axis(cat_i, pos, axis=1)
+
+        init = (
+            jnp.full((B, kk), jnp.inf, queries.dtype),
+            jnp.full((B, kk), -1, bucket_ids.dtype),
+        )
+        best_d, best_i = jax.lax.fori_loop(0, n_probes, probe_body, init)
+        dist = jnp.maximum(best_d, 0.0)
+        if kk < k:  # fewer candidates than k: pad
+            dist = jnp.pad(dist, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
+            best_i = jnp.pad(best_i, ((0, 0), (0, k - kk)), constant_values=-1)
+        return jnp.sqrt(dist), best_i
 
     qt = qp.reshape(n_tiles, batch_queries, d)
     dists, idxs = jax.lax.map(one_tile, qt)

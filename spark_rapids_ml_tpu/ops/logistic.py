@@ -47,58 +47,55 @@ def _make_scaling(X, w, standardize: bool, fit_intercept: bool):
 
 
 def _glm_qn_minimize(
-    z_of, rowloss, rowloss_alphas, penalty_terms, n_flat: int, dtype,
-    max_iter: int, tol: float, memory: int = 10, n_alphas: int = 12, c1: float = 1e-4,
+    z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat: int, dtype,
+    penalty_terms, max_iter: int, tol: float, memory: int = 10,
+    n_alphas: int = 12, c1: float = 1e-4,
 ):
     """L-BFGS specialized to GLM objectives: loss(p) = rowloss(z_of(p)) +
     penalty(p) with z LINEAR in p.
 
-    The line search exploits the linearity: along direction D the logits are
-    z(p + a·D) = z_p + a·z_D, so ALL candidate step sizes are scored from two
-    matmul results with elementwise math — no inner while_loop ever touches the
-    data matrix. That structure matters twice on TPU: (a) cuML's qn does the
-    same trick, one fused pass per iteration instead of sequential zoom probes;
-    (b) XLA duplicates any array whose consumer sits inside a NESTED while loop
-    (measured: +1 full X copy with the optax zoom linesearch or a backtracking
-    inner loop — an 11 GiB overhead at the 1M x 3k benchmark shape, OOM on one
-    chip). This solver has a single flat while_loop, so X stays single-buffered.
+    Two structural exploits of linearity keep every iteration at TWO passes
+    over the data matrix (the HBM-bandwidth floor for a logit model):
+      1. Line search: along direction D the logits are z(p + a·D) = z_p + a·z_D,
+         so ALL candidate step sizes are scored elementwise from one new matmul
+         result (z_D) — no inner while_loop touches X. cuML's qn does the same;
+         it also avoids the XLA pattern where a loss evaluated inside a NESTED
+         while loop costs a full copy of X (11 GiB at 1M x 3k, measured).
+      2. Gradients: z at the accepted point is z_p + a·z_D (free), and the
+         gradient is computed ANALYTICALLY from it as Xᵀ·(∂loss/∂z) via the
+         caller's `grad_from_z` — autodiff re-evaluating the forward would
+         re-read X twice more per iteration.
 
     Interfaces (all jax-traceable):
-      z_of(flat_params [F]) -> z [n, k_out]          (linear)
-      rowloss(z) -> scalar                            (data term)
-      rowloss_alphas(z_p, z_d, alphas [S]) -> [S]     (data term at p + a·d)
-      penalty_terms(flat_p, flat_d) -> (p0, p1, p2)   (penalty(p + a·d) =
-                                                       p0 + a·p1 + a²·p2)
+      z_of(flat_params [F]) -> z [n, k_out]             (linear)
+      rowloss(z) -> scalar                               (data term)
+      rowloss_alphas(z_p, z_d, alphas [S]) -> [S]        (data term at p + a·d)
+      grad_from_z(flat_p, z) -> flat grad [F]            (incl. penalty grad)
+      penalty_terms(flat_p, flat_d) -> (p0, p1, p2)      (penalty(p + a·d) =
+                                                          p0 + a·p1 + a²·p2)
     Returns (flat_params, objective, n_iter).
     """
     m = memory
     # step candidates: one growth step, unit step, then geometric backtracking
     alphas = jnp.asarray([2.0] + [0.5 ** i for i in range(n_alphas - 1)], jnp.float32)
 
-    def total_loss(xf):
-        p0, _, _ = penalty_terms(xf, jnp.zeros_like(xf))
-        return rowloss(z_of(xf)) + p0
-
-    grad_f = jax.grad(total_loss)
-
     from .owlqn import lbfgs_two_loop
 
     def cond(state):
-        _, _, _, _, _, _, f_prev, f_cur, it, stalled = state
+        _, _, _, _, _, _, _, f_prev, f_cur, it, stalled = state
         rel = jnp.abs(f_prev - f_cur) / jnp.maximum(jnp.abs(f_cur), 1.0)
         return jnp.logical_and(jnp.logical_and(it < max_iter, rel > tol), ~stalled)
 
     def body(state):
-        x, g, S, Y, rho, meta, f_prev, f_cur, it, _ = state
+        x, z_p, g, S, Y, rho, meta, f_prev, f_cur, it, _ = state
         count, pos = meta
         d = lbfgs_two_loop(g, S, Y, rho, count, pos, m)
         # fall back to steepest descent if the direction isn't a descent one
         gd = jnp.dot(g, d)
         d = jnp.where(gd < 0, d, -g)
         gd = jnp.minimum(gd, -jnp.dot(g, g))
-        # batched Armijo over all candidates from TWO logit evaluations
-        z_p = z_of(x)
-        z_d = z_of(d)  # linear => z(x + a d) = z_p + a z_d
+        # batched Armijo over all candidates from ONE new logit evaluation
+        z_d = z_of(d)  # linear => z(x + a d) = z_p + a z_d     [X read 1]
         p0, p1, p2 = penalty_terms(x, d)
         a = alphas.astype(x.dtype)
         f_cand = rowloss_alphas(z_p, z_d, a) + p0 + a * p1 + a * a * p2
@@ -109,7 +106,8 @@ def _glm_qn_minimize(
         a_sel = a[first_ok]
         f_new = f_cand[first_ok]
         xn = x + a_sel * d
-        gn = grad_f(xn)
+        z_n = z_p + a_sel * z_d  # logits at the accepted point, no X pass
+        gn = grad_from_z(xn, z_n)  # analytic Xᵀ·residual          [X read 2]
         s = xn - x
         yv = gn - g
         sy = jnp.dot(s, yv)
@@ -120,21 +118,24 @@ def _glm_qn_minimize(
         count = jnp.where(do_update, jnp.minimum(count + 1, m), count)
         pos = jnp.where(do_update, (pos + 1) % m, pos)
         x = jnp.where(ok, xn, x)
+        z_p = jnp.where(ok, z_n, z_p)
         g = jnp.where(ok, gn, g)
         f_out = jnp.where(ok, f_new, f_cur)
-        return x, g, S, Y, rho, (count, pos), f_cur, f_out, it + 1, ~ok
+        return x, z_p, g, S, Y, rho, (count, pos), f_cur, f_out, it + 1, ~ok
 
     x0 = jnp.zeros((n_flat,), dtype)
-    g0 = grad_f(x0)
-    f0 = total_loss(x0)
+    z0 = jnp.zeros(z_shape, dtype)  # z_of(0) == 0: z is linear with no constant
+    g0 = grad_from_z(x0, z0)
+    p00, _, _ = penalty_terms(x0, x0)
+    f0 = rowloss(z0) + p00
     state0 = (
-        x0, g0,
+        x0, z0, g0,
         jnp.zeros((m, n_flat), x0.dtype), jnp.zeros((m, n_flat), x0.dtype),
         jnp.zeros((m,), x0.dtype),
         (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
         jnp.asarray(jnp.inf, x0.dtype), f0, jnp.asarray(0, jnp.int32), jnp.asarray(False),
     )
-    x, _, _, _, _, _, _, obj, n_iter, _ = jax.lax.while_loop(cond, body, state0)
+    x, _, _, _, _, _, _, _, obj, n_iter, _ = jax.lax.while_loop(cond, body, state0)
     return x, obj, n_iter
 
 
@@ -199,7 +200,8 @@ def logistic_fit(
     d = X.shape[1]
     mu, d_scale, total_w = _make_scaling(X, w, standardize, fit_intercept)
     return _fit_common(
-        lambda Beff: X @ Beff, X.dtype, d, y_idx, w, mu, d_scale, total_w,
+        lambda Beff: X @ Beff, lambda r: X.T @ r, X.shape[0],
+        X.dtype, d, y_idx, w, mu, d_scale, total_w,
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol, lbfgs_memory=lbfgs_memory,
     )
@@ -245,16 +247,25 @@ def logistic_fit_ell(
         total_w = jnp.sum(w)
         d_scale = jnp.ones((d,), values.dtype)
     mu = jnp.zeros((d,), values.dtype)  # scale-only: never centered
+
+    def rmat(r):  # Xᵀ r via per-column ELL scatter
+        from .sparse import ell_rmatvec
+
+        return jnp.stack(
+            [ell_rmatvec(values, indices, r[:, j], d) for j in range(r.shape[1])],
+            axis=1,
+        )
+
     return _fit_common(
-        lambda Beff: ell_matmul(values, indices, Beff), values.dtype, d, y_idx, w,
-        mu, d_scale, total_w,
+        lambda Beff: ell_matmul(values, indices, Beff), rmat, values.shape[0],
+        values.dtype, d, y_idx, w, mu, d_scale, total_w,
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol, lbfgs_memory=lbfgs_memory,
     )
 
 
 def _fit_common(
-    matvec, dtype, d, y_idx, w, mu, d_scale, total_w,
+    matvec, rmat, n_rows, dtype, d, y_idx, w, mu, d_scale, total_w,
     *, k, multinomial, lam_l2, lam_l1, use_l1, fit_intercept, max_iter, tol, lbfgs_memory,
 ) -> Dict[str, jax.Array]:
     k_out = k if multinomial else 1
@@ -300,6 +311,22 @@ def _fit_common(
             0.5 * lam_l2 * jnp.sum(Bd * Bd),
         )
 
+    def grad_from_z(xf, z):
+        """Analytic gradient from the logits: ∂loss/∂z is the GLM residual,
+        the chain through z = matvec(B·d_scale) + (b0 − mu·Beff) is one
+        transposed data pass (rmat) plus tiny vector algebra."""
+        B, _ = unflatten(xf)
+        if multinomial:
+            p = jax.nn.softmax(z, axis=1)
+            r = w[:, None] * (p - jax.nn.one_hot(y_idx, k, dtype=dtype)) / total_w
+        else:
+            p = jax.nn.sigmoid(z[:, 0])
+            r = ((w * (p - y)) / total_w)[:, None]  # [n, 1]
+        g_beff = rmat(r) - mu[:, None] * jnp.sum(r, axis=0)[None, :]  # [d, k_out]
+        dB = g_beff * d_scale[:, None] + lam_l2 * B
+        db0 = jnp.sum(r, axis=0) if fit_intercept else jnp.zeros((k_out,), dtype)
+        return jnp.concatenate([dB.ravel(), db0])
+
     if use_l1:
         # L1/ElasticNet: OWL-QN over the flattened (B, b0) with the L1 mask
         # covering coefficients only (intercepts are never penalized — Spark
@@ -320,8 +347,8 @@ def _fit_common(
         )
     else:
         xf, obj, n_iter = _glm_qn_minimize(
-            z_of, rowloss, rowloss_alphas, penalty_terms, n_flat, dtype,
-            max_iter=max_iter, tol=tol, memory=lbfgs_memory,
+            z_of, rowloss, rowloss_alphas, grad_from_z, (n_rows, k_out), n_flat,
+            dtype, penalty_terms, max_iter=max_iter, tol=tol, memory=lbfgs_memory,
         )
     B, b0 = unflatten(xf)
 

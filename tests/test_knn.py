@@ -144,3 +144,22 @@ def test_ivfpq_rejects_bad_m(rng):
         ApproximateNearestNeighbors(
             k=3, algorithm="ivfpq", algoParams={"M": 3}
         ).setInputCol("features").fit(df)
+
+
+def test_exact_knn_1dev_matches_sharded(rng):
+    # the single-device host-tiled path must equal the sharded path exactly
+    import jax
+
+    from spark_rapids_ml_tpu.ops.knn import exact_knn
+    from spark_rapids_ml_tpu.parallel import get_mesh, make_global_rows
+
+    items = rng.normal(size=(500, 16)).astype(np.float32)
+    queries = rng.normal(size=(73, 16)).astype(np.float32)
+    mesh8 = get_mesh(8)
+    X8, w8, _ = make_global_rows(mesh8, items)
+    d8, i8 = exact_knn(X8, w8 > 0, jax.device_put(queries), mesh=mesh8, k=7, batch_queries=32)
+    mesh1 = get_mesh(1)
+    X1, w1, _ = make_global_rows(mesh1, items)
+    d1, i1 = exact_knn(X1, w1 > 0, jax.device_put(queries), mesh=mesh1, k=7, batch_queries=32)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i8))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d8), rtol=1e-6, atol=1e-6)
