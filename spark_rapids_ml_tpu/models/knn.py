@@ -53,7 +53,11 @@ class NearestNeighbors(_KNNParams, _TpuEstimator):
     per-shard MXU distance tiles + top-k, then an all-gather of the [k·nq]
     candidates and one final top-k — replacing the reference's UCX all-to-all
     item/query shuffle (knn.py:712-723) with one small ICI collective.
+    CSR item sets search via tile-densify with a running top-k (never fully
+    densified — the reference's cupyx-CSR kNN capability).
     """
+
+    _supports_sparse_input = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -98,6 +102,8 @@ class NearestNeighbors(_KNNParams, _TpuEstimator):
 
 
 class NearestNeighborsModel(_KNNParams, _TpuModel):
+    _supports_sparse_input = True
+
     def __init__(self, n_cols: int = 0, dtype: str = "float32", **kwargs: Any) -> None:
         super().__init__(n_cols=n_cols, dtype=dtype)
         self.n_cols = int(n_cols)
@@ -144,59 +150,106 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
             import jax
 
             items = item_ex.features
-            if hasattr(items, "todense"):
-                items = np.asarray(items.todense())
             queries = query_ex.features
             if hasattr(queries, "todense"):
                 queries = np.asarray(queries.todense())
+            queries = np.asarray(queries, dtype=np_dtype)
 
-            if spmd:
-                mesh = active.mesh
-                # agree on the global item layout (ragged local blocks ->
-                # common padded per-process size), like _build_fit_inputs
-                desc = PartitionDescriptor.build(
-                    [items.shape[0]], item_ex.n_cols,
-                    rank=active.rank, rendezvous=active.rendezvous,
-                )
-                if k > desc.m:
-                    raise ValueError(f"k={k} exceeds the number of item rows {desc.m}")
-                n_local_dev = jax.local_device_count()
-                max_rows = max(r for _, r in desc.parts_rank_size)
-                local_rows_target = -(-max_rows // n_local_dev) * n_local_dev
-                X, w, _ = make_global_rows(
-                    mesh, items.astype(np_dtype), local_rows_target=local_rows_target
-                )
-                # global padded-position -> user item id map (pad with -1)
-                ids_padded = np.full(local_rows_target, -1, np.int64)
-                ids_padded[: len(item_ids)] = item_ids
-                global_item_ids = np.concatenate(
-                    allgather_ndarray(active.rendezvous, ids_padded)
-                )
-                # replicate the query blocks; remember this rank's slice
-                q_blocks = allgather_ndarray(active.rendezvous, queries.astype(np_dtype))
-                q_offset = sum(len(b) for b in q_blocks[: active.rank])
-                nq_local = queries.shape[0]
-                queries_global = np.concatenate(q_blocks, axis=0)
-                Q = jax.device_put(queries_global)
-            else:
+            if item_ex.is_sparse and not spmd:
+                # CSR item set: tile-densify with a running top-k (never fully
+                # densified — the reference's sparse kNN capability)
+                from ..ops.knn import exact_knn_sparse
+
                 if k > item_ex.n_rows:
                     raise ValueError(
                         f"k={k} exceeds the number of item rows {item_ex.n_rows}"
                     )
-                n_dev = min(self.num_workers, len(default_devices()))
-                mesh = get_mesh(n_dev)
-                X, w, _ = make_global_rows(mesh, items.astype(np_dtype))
-                global_item_ids = item_ids
-                Q = jax.device_put(queries.astype(np_dtype))
-                q_offset, nq_local = 0, queries.shape[0]
+                d_np, gidx_np = exact_knn_sparse(items, queries, k)
+                dist = np.asarray(d_np, dtype=np.float64)
+                indices = item_ids[np.maximum(np.asarray(gidx_np), 0)]
+            elif item_ex.is_sparse and spmd:
+                # SPMD sparse: each rank runs the exact tile-densify search on
+                # its LOCAL CSR block for ALL queries, then the per-rank exact
+                # top-k sets are merged on the control plane — the union of
+                # exact local results IS the exact global result
+                from ..ops.knn import exact_knn_sparse
+                from ..parallel.context import allgather_concat
 
-            dist, gidx = exact_knn(
-                X, w > 0, Q, mesh=mesh, k=k,
-                batch_queries=int(self._solver_params["batch_queries"]),
-            )
-        dist = np.asarray(dist, dtype=np.float64)[q_offset : q_offset + nq_local]
-        gidx = np.asarray(gidx)[q_offset : q_offset + nq_local]
-        indices = global_item_ids[gidx]  # map global row position -> user item id
+                rdv = active.rendezvous
+                counts = [int(c) for c in rdv.allgather(str(item_ex.n_rows))]
+                if k > sum(counts):
+                    raise ValueError(f"k={k} exceeds the number of item rows {sum(counts)}")
+                if item_ex.row_id is None:
+                    item_ids = item_ids + sum(counts[: active.rank])
+                if query_ex.row_id is None:
+                    qcounts = [int(c) for c in rdv.allgather(str(len(query_ids)))]
+                    query_ids = query_ids + sum(qcounts[: active.rank])
+                queries_global, q_offset = allgather_concat(rdv, queries)
+                nq_local = len(query_pdf)
+                d_np, lidx = exact_knn_sparse(items, queries_global, k)
+                local_user_ids = np.where(
+                    np.asarray(lidx) >= 0, item_ids[np.maximum(np.asarray(lidx), 0)], -1
+                )
+                d_all = np.concatenate(
+                    allgather_ndarray(rdv, np.asarray(d_np, dtype=np.float64)), axis=1
+                )
+                i_all = np.concatenate(
+                    allgather_ndarray(rdv, local_user_ids.astype(np.int64)), axis=1
+                )
+                order = np.argsort(d_all, axis=1, kind="stable")[:, :k]
+                dist = np.take_along_axis(d_all, order, axis=1)[q_offset : q_offset + nq_local]
+                indices = np.take_along_axis(i_all, order, axis=1)[q_offset : q_offset + nq_local]
+            else:
+                if hasattr(items, "todense"):
+                    items = np.asarray(items.todense())
+
+                if spmd:
+                    mesh = active.mesh
+                    # agree on the global item layout (ragged local blocks ->
+                    # common padded per-process size), like _build_fit_inputs
+                    desc = PartitionDescriptor.build(
+                        [items.shape[0]], item_ex.n_cols,
+                        rank=active.rank, rendezvous=active.rendezvous,
+                    )
+                    if k > desc.m:
+                        raise ValueError(f"k={k} exceeds the number of item rows {desc.m}")
+                    n_local_dev = jax.local_device_count()
+                    max_rows = max(r for _, r in desc.parts_rank_size)
+                    local_rows_target = -(-max_rows // n_local_dev) * n_local_dev
+                    X, w, _ = make_global_rows(
+                        mesh, items.astype(np_dtype), local_rows_target=local_rows_target
+                    )
+                    # global padded-position -> user item id map (pad with -1)
+                    ids_padded = np.full(local_rows_target, -1, np.int64)
+                    ids_padded[: len(item_ids)] = item_ids
+                    global_item_ids = np.concatenate(
+                        allgather_ndarray(active.rendezvous, ids_padded)
+                    )
+                    # replicate the query blocks; remember this rank's slice
+                    q_blocks = allgather_ndarray(active.rendezvous, queries)
+                    q_offset = sum(len(b) for b in q_blocks[: active.rank])
+                    nq_local = queries.shape[0]
+                    queries_global = np.concatenate(q_blocks, axis=0)
+                    Q = jax.device_put(queries_global)
+                else:
+                    if k > item_ex.n_rows:
+                        raise ValueError(
+                            f"k={k} exceeds the number of item rows {item_ex.n_rows}"
+                        )
+                    n_dev = min(self.num_workers, len(default_devices()))
+                    mesh = get_mesh(n_dev)
+                    X, w, _ = make_global_rows(mesh, items.astype(np_dtype))
+                    global_item_ids = item_ids
+                    Q = jax.device_put(queries)
+                    q_offset, nq_local = 0, queries.shape[0]
+
+                d_dev, gidx_dev = exact_knn(
+                    X, w > 0, Q, mesh=mesh, k=k,
+                    batch_queries=int(self._solver_params["batch_queries"]),
+                )
+                dist = np.asarray(d_dev, dtype=np.float64)[q_offset : q_offset + nq_local]
+                gidx = np.asarray(gidx_dev)[q_offset : q_offset + nq_local]
+                indices = global_item_ids[gidx]  # global row position -> user item id
 
         knn_df = pd.DataFrame(
             {

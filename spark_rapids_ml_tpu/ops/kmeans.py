@@ -229,6 +229,11 @@ def _init_subsample(x_host, sample_weight, rng):
     return x, sw
 
 
+@jax.jit
+def _assign_nearest(X, C):
+    return jnp.argmin(jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1)
+
+
 @partial(jax.jit, static_argnames=())
 def _min_d2_update(x, cand, min_d2):
     """min(min_d2, min distance² to the NEW candidate block) — one matmul."""
@@ -274,11 +279,7 @@ def scalable_kmeans_init(x_host, k: int, seed: int, sample_weight=None, rounds: 
         min_d2 = np.asarray(_min_d2_update(xd, jax.device_put(new), jnp.asarray(min_d2)))
     cand = np.concatenate(cand_list, axis=0)
     # weight candidates by how many points they own (one assignment pass)
-    assign = np.asarray(
-        jax.jit(lambda X, C: jnp.argmin(
-            jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1
-        ))(xd, jax.device_put(cand))
-    )
+    assign = np.asarray(_assign_nearest(xd, jax.device_put(cand)))
     weights = np.bincount(assign, weights=sw, minlength=len(cand)).astype(np.float64)
     # reduce the small weighted candidate set to k with classic k-means++
     return kmeans_plus_plus_init(cand.astype(np.float64), k, seed + 1, weights)

@@ -61,6 +61,11 @@ def _tile_topk(items, queries, valid, k, batch_queries=4096):
     return dists.reshape(-1, k)[:nq], idxs.reshape(-1, k)[:nq]
 
 
+@jax.jit
+def _row_sq(x):
+    return jnp.sum(x * x, axis=1)
+
+
 @partial(jax.jit, static_argnames=("kk",))
 def _topk_tile_1dev(items, valid, item_sq, q, *, kk):
     d2 = item_sq[None, :] - 2.0 * (q @ items.T)
@@ -77,9 +82,14 @@ def _exact_knn_1dev(items, valid, queries, k, batch_queries):
     import numpy as np
 
     nq = queries.shape[0]
+    if nq == 0:
+        return (
+            np.zeros((0, k), dtype=np.asarray(queries).dtype),
+            np.zeros((0, k), dtype=np.int32),
+        )
     kk = min(k, items.shape[0])
     batch_queries = min(batch_queries, nq)
-    item_sq = jax.jit(lambda it: jnp.sum(it * it, axis=1))(items)
+    item_sq = _row_sq(items)
     d_parts, i_parts = [], []
     for start in range(0, nq, batch_queries):
         # keep every tile the SAME shape (clamp back + drop the overlap) so the
@@ -98,6 +108,63 @@ def _exact_knn_1dev(items, valid, queries, k, batch_queries):
         d2 = np.pad(d2, ((0, 0), (0, k - kk)), constant_values=np.inf)
         idx = np.pad(idx, ((0, 0), (0, k - kk)))
     return np.sqrt(np.maximum(d2, 0.0)), idx
+
+
+@partial(jax.jit, static_argnames=())
+def _sparse_tile_merge(xt, q, q_sq, best_d2, best_i, tile_ids, fresh):
+    """Merge one densified item tile into the running top-k: d² tile vs all
+    queries (one MXU matmul), concat with the carried best, re-top-k.
+    `fresh` masks rows already merged by a previous tile (the clamped last
+    tile overlaps — a duplicate candidate would otherwise occupy two slots)."""
+    d2 = (
+        q_sq[:, None]
+        - 2.0 * q @ xt.T
+        + jnp.sum(xt * xt, axis=1)[None, :]
+    )  # [nq, bt]
+    d2 = jnp.where(fresh[None, :], d2, jnp.inf)
+    cat_d = jnp.concatenate([best_d2, d2], axis=1)
+    cat_i = jnp.concatenate([best_i, jnp.broadcast_to(tile_ids[None, :], d2.shape)], axis=1)
+    neg_d, pos = jax.lax.top_k(-cat_d, best_d2.shape[1])
+    return -neg_d, jnp.take_along_axis(cat_i, pos, axis=1)
+
+
+def exact_knn_sparse(items_csr, queries, k: int, batch_items: int = 65536):
+    """Exact kNN with SPARSE (scipy CSR) items: item tiles are densified one at
+    a time on device and merged into a running top-k — CSR never fully
+    densifies in memory (the reference's sparse kNN capability,
+    cuML NearestNeighborsMG on cupyx CSR). Queries are dense [nq, d].
+
+    Returns host (distances [nq, k] euclidean, item row indices [nq, k])."""
+    import numpy as np
+
+    n, d = items_csr.shape
+    nq = queries.shape[0]
+    kk = min(k, n)
+    batch_items = min(batch_items, n)
+    dtype = queries.dtype if queries.dtype in (np.float32, np.float64) else np.float32
+    if nq == 0:
+        return np.zeros((0, k), dtype=dtype), np.zeros((0, k), dtype=np.int32)
+    q_dev = jax.device_put(np.ascontiguousarray(queries, dtype=dtype))
+    q_sq = _row_sq(q_dev)
+    best_d2 = jnp.full((nq, kk), jnp.inf, dtype)
+    best_i = jnp.full((nq, kk), -1, jnp.int32)
+    for start in range(0, n, batch_items):
+        # clamp the last tile back so every tile has the same shape (single
+        # compile); `fresh` masks the re-visited overlap rows
+        s0 = min(start, max(0, n - batch_items))
+        stop = s0 + batch_items
+        xt = np.asarray(items_csr[s0:stop].todense(), dtype=dtype)
+        tile_ids = jnp.arange(s0, stop, dtype=jnp.int32)
+        fresh = tile_ids >= start
+        best_d2, best_i = _sparse_tile_merge(
+            xt, q_dev, q_sq, best_d2, best_i, tile_ids, fresh
+        )
+    dist = np.sqrt(np.maximum(np.asarray(best_d2), 0.0))
+    idx = np.asarray(best_i)
+    if kk < k:
+        dist = np.pad(dist, ((0, 0), (0, k - kk)), constant_values=np.inf)
+        idx = np.pad(idx, ((0, 0), (0, k - kk)), constant_values=-1)
+    return dist, idx
 
 
 def exact_knn(

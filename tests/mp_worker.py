@@ -72,6 +72,25 @@ def main() -> None:
         )
         query_df = df.iloc[:5]
         _, _, knn_df = gnn.kneighbors(query_df)
+        # sparse kNN SPMD: same rows as CSR — local exact search + merged
+        # top-k must equal the dense global result
+        import scipy.sparse as sp
+
+        from spark_rapids_ml_tpu.linalg import Vectors
+
+        xs = sp.csr_matrix(X[lo:hi])
+        df_sp = df.copy()
+        df_sp["sfeat"] = [
+            Vectors.sparse(X.shape[1], xs[i].indices.tolist(), xs[i].data.tolist())
+            for i in range(hi - lo)
+        ]
+        gnn_sp = (
+            NearestNeighbors(k=3, float32_inputs=False)
+            .setInputCol("sfeat")
+            .setIdCol("id")
+            .fit(df_sp)
+        )
+        _, _, knn_sp_df = gnn_sp.kneighbors(df_sp.iloc[:5])
         # DBSCAN: replicated-data SPMD — every rank gathers the full set and
         # the N² passes run cooperatively over the global mesh
         from spark_rapids_ml_tpu.models.clustering import DBSCAN
@@ -118,6 +137,8 @@ def main() -> None:
         knn_query_ids=knn_df["query_id"].to_numpy(),
         knn_indices=np.stack(knn_df["indices"].to_numpy()),
         knn_distances=np.stack(knn_df["distances"].to_numpy()),
+        knn_sp_indices=np.stack(knn_sp_df["indices"].to_numpy()),
+        knn_sp_distances=np.stack(knn_sp_df["distances"].to_numpy()),
         db_labels=db_labels,
         um_emb=um_emb,
         ann_indices=np.stack(ann_df["indices"].to_numpy()),

@@ -163,3 +163,46 @@ def test_exact_knn_1dev_matches_sharded(rng):
     d1, i1 = exact_knn(X1, w1 > 0, jax.device_put(queries), mesh=mesh1, k=7, batch_queries=32)
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i8))
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d8), rtol=1e-6, atol=1e-6)
+
+
+def test_sparse_knn_matches_dense(rng):
+    # CSR item set searched via tile-densify must equal the dense result
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+
+    x = sp.random(400, 24, density=0.2, random_state=np.random.RandomState(5), format="csr")
+    # sp.random leaves ~0.8^24 of rows all-zero -> exactly equidistant ties
+    # with order ambiguity; a distinct last column makes every distance unique
+    x = sp.hstack([x[:, :-1], sp.csr_matrix(np.arange(400)[:, None] * 1e-3)]).tocsr()
+    xd = np.asarray(x.todense())
+    rows = [
+        __import__("spark_rapids_ml_tpu.linalg", fromlist=["Vectors"]).Vectors.sparse(
+            24, x[i].indices.tolist(), x[i].data.tolist()
+        )
+        for i in range(400)
+    ]
+    import pandas as pd
+
+    df_sp = pd.DataFrame({"features": rows})
+    df_dn = pd.DataFrame({"features": list(xd)})
+    q = df_dn.iloc[:37]
+
+    m_sp = NearestNeighbors(k=5, float32_inputs=False).setInputCol("features").fit(df_sp)
+    m_dn = NearestNeighbors(k=5, float32_inputs=False).setInputCol("features").fit(df_dn)
+    _, _, knn_sp = m_sp.kneighbors(q)
+    _, _, knn_dn = m_dn.kneighbors(q)
+    np.testing.assert_array_equal(
+        np.stack(knn_sp["indices"].to_numpy()), np.stack(knn_dn["indices"].to_numpy())
+    )
+    np.testing.assert_allclose(
+        np.stack(knn_sp["distances"].to_numpy()),
+        np.stack(knn_dn["distances"].to_numpy()),
+        rtol=1e-5, atol=1e-6,
+    )
+    # tiling invariance: tiny tiles give the same answer (f64 like the models
+    # above — f32 rounding can flip near-tie orderings)
+    from spark_rapids_ml_tpu.ops.knn import exact_knn_sparse
+
+    d_t, i_t = exact_knn_sparse(x.astype(np.float64), xd[:37].astype(np.float64), 5, batch_items=64)
+    np.testing.assert_array_equal(i_t, np.stack(knn_dn["indices"].to_numpy()))

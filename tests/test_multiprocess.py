@@ -125,6 +125,14 @@ def test_multiprocess_fit_matches_single_process(nranks, tmp_path):
             got["knn_distances"], np.stack(knn_ref["distances"].to_numpy()),
             rtol=1e-7, atol=1e-6,  # self-distances are 0 ± sqrt-expansion noise
         )
+        # sparse SPMD kNN (local exact + merged top-k) equals the dense result
+        np.testing.assert_array_equal(
+            got["knn_sp_indices"], np.stack(knn_ref["indices"].to_numpy())
+        )
+        np.testing.assert_allclose(
+            got["knn_sp_distances"], np.stack(knn_ref["distances"].to_numpy()),
+            rtol=1e-7, atol=1e-6,
+        )
         # DBSCAN: replicated-data SPMD labels equal the single-process labels
         # for this rank's rows (deterministic: same full data, same program)
         from spark_rapids_ml_tpu.models.clustering import DBSCAN
