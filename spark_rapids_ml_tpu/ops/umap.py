@@ -406,12 +406,13 @@ def umap_transform(
     Y0 = (wgt[:, :, None] * embedding[idx]).sum(axis=1) / wsum
 
     # umap-learn's transform schedule: explicit n_epochs runs a third of it
-    # (int(n_epochs // 3.0), no floor); defaulted n_epochs runs a fixed
-    # 100 epochs for n <= 10000 training rows, 30 otherwise
-    if n_epochs:
-        total_epochs = max(int(n_epochs) // 3, 1)
+    # (int(n_epochs // 3.0), no floor — 0 epochs returns the weighted-mean
+    # init); defaulted n_epochs runs a fixed 100 epochs when the TRANSFORMED
+    # set has <= 10000 rows, 30 otherwise
+    if n_epochs is not None:
+        total_epochs = int(n_epochs) // 3
     else:
-        total_epochs = 100 if raw_data.shape[0] <= 10000 else 30
+        total_epochs = 100 if n_new <= 10000 else 30
     head = np.repeat(np.arange(n_new, dtype=np.int32), k)
     tail = idx.reshape(-1).astype(np.int32)
     Y = optimize_embedding(
