@@ -206,3 +206,25 @@ def test_sparse_knn_matches_dense(rng):
 
     d_t, i_t = exact_knn_sparse(x.astype(np.float64), xd[:37].astype(np.float64), 5, batch_items=64)
     np.testing.assert_array_equal(i_t, np.stack(knn_dn["indices"].to_numpy()))
+
+
+def test_knn_empty_query_frames(rng):
+    # 0-row query frames return empty results on both backends (the 1-device
+    # host-tiled path used to raise range(..., 0))
+    import jax
+
+    from spark_rapids_ml_tpu.ops.knn import exact_knn, exact_knn_sparse
+    from spark_rapids_ml_tpu.parallel import get_mesh, make_global_rows
+
+    items = rng.normal(size=(100, 8)).astype(np.float32)
+    empty_q = np.zeros((0, 8), np.float32)
+    mesh1 = get_mesh(1)
+    X, w, _ = make_global_rows(mesh1, items)
+    d, i = exact_knn(X, w > 0, jax.device_put(empty_q), mesh=mesh1, k=3)
+    assert np.asarray(d).shape == (0, 3) and np.asarray(i).shape == (0, 3)
+
+    import scipy.sparse as sp
+
+    xs = sp.csr_matrix(items)
+    d, i = exact_knn_sparse(xs, empty_q, 3)
+    assert d.shape == (0, 3) and i.shape == (0, 3)
