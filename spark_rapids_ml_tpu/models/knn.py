@@ -138,6 +138,23 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
         k = int(self._solver_params["n_neighbors"])
         item_ex = self._item_extracted
         query_pdf = as_pandas(query_df)
+        active0 = TpuContext.current()
+        if len(query_pdf) == 0 and (active0 is None or not active0.is_spmd):
+            # 0-row query frame: nothing to search (ingest can't infer a width
+            # from an empty column). SPMD ranks still run the full path — an
+            # empty LOCAL block must participate in the collective gathers.
+            item_ids = self._ensure_id(self._item_pdf, item_ex)
+            id_col = self.getOrDefault("idCol") if self.isDefined("idCol") else alias.row_number
+            item_out = self._item_pdf.copy(deep=False)
+            if id_col not in item_out.columns:
+                item_out[id_col] = item_ids
+            query_out = query_pdf.copy(deep=False)
+            if id_col not in query_out.columns:
+                query_out[id_col] = np.zeros(0, dtype=np.int64)
+            knn_df = pd.DataFrame(
+                {"query_id": np.zeros(0, dtype=np.int64), "indices": [], "distances": []}
+            )
+            return item_out, query_out, knn_df
         query_ex = self._pre_process_data(query_df, for_fit=False)
         item_ids = self._ensure_id(self._item_pdf, item_ex)
         query_ids = self._ensure_id(query_pdf, query_ex)
@@ -213,6 +230,11 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
                     )
                     if k > desc.m:
                         raise ValueError(f"k={k} exceeds the number of item rows {desc.m}")
+                    # default row-number ids are rank-local — offset by the
+                    # lower-rank row counts so they're globally unique (same
+                    # rule as the sparse-SPMD and ANN-SPMD branches)
+                    if item_ex.row_id is None:
+                        item_ids = item_ids + desc.row_offset_of(active.rank)
                     n_local_dev = jax.local_device_count()
                     max_rows = max(r for _, r in desc.parts_rank_size)
                     local_rows_target = -(-max_rows // n_local_dev) * n_local_dev
@@ -228,6 +250,8 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
                     # replicate the query blocks; remember this rank's slice
                     q_blocks = allgather_ndarray(active.rendezvous, queries)
                     q_offset = sum(len(b) for b in q_blocks[: active.rank])
+                    if query_ex.row_id is None:
+                        query_ids = query_ids + q_offset
                     nq_local = queries.shape[0]
                     queries_global = np.concatenate(q_blocks, axis=0)
                     Q = jax.device_put(queries_global)
@@ -294,8 +318,12 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
         # vectorized explode of the [nq, k] neighbor lists; ANN search pads
         # under-filled probe results with +inf distance — those aren't real
         # neighbors, drop them (a real hit always has finite distance)
-        indices = np.stack(knn_df["indices"].to_numpy())
-        dists = np.stack(knn_df["distances"].to_numpy())
+        if len(knn_df):
+            indices = np.stack(knn_df["indices"].to_numpy())
+            dists = np.stack(knn_df["distances"].to_numpy())
+        else:  # 0-row query frame: np.stack rejects an empty list
+            indices = np.zeros((0, 1), dtype=np.int64)
+            dists = np.zeros((0, 1), dtype=np.float64)
         k = indices.shape[1]
         flat_q = np.repeat(knn_df["query_id"].to_numpy(), k)
         flat_i = indices.ravel()

@@ -93,7 +93,9 @@ def _glm_qn_minimize(
         # fall back to steepest descent if the direction isn't a descent one
         gd = jnp.dot(g, d)
         d = jnp.where(gd < 0, d, -g)
-        gd = jnp.minimum(gd, -jnp.dot(g, g))
+        # true directional derivative: g·d when the L-BFGS direction is kept,
+        # -g·g only in the steepest-descent fallback branch
+        gd = jnp.where(gd < 0, gd, -jnp.dot(g, g))
         # batched Armijo over all candidates from ONE new logit evaluation
         z_d = z_of(d)  # linear => z(x + a d) = z_p + a z_d     [X read 1]
         p0, p1, p2 = penalty_terms(x, d)

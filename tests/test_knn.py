@@ -228,3 +228,25 @@ def test_knn_empty_query_frames(rng):
     xs = sp.csr_matrix(items)
     d, i = exact_knn_sparse(xs, empty_q, 3)
     assert d.shape == (0, 3) and i.shape == (0, 3)
+
+
+def test_knn_empty_query_model_join(rng):
+    # model-level: a 0-row query frame flows through kneighbors AND the
+    # exploded join with the same schema as the non-empty path
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+
+    items = rng.normal(size=(60, 8)).astype(np.float32)
+    df = pd.DataFrame({"features": list(items)})
+    nn = NearestNeighbors(k=3).setInputCol("features").fit(df)
+    empty_q = pd.DataFrame({"features": list(items[:0])})
+
+    _, query_out, knn_df = nn.kneighbors(empty_q)
+    assert len(query_out) == 0 and len(knn_df) == 0
+    assert list(knn_df.columns) == ["query_id", "indices", "distances"]
+
+    joined = nn.exactNearestNeighborsJoin(pd.DataFrame({"features": list(items[:5])}))
+    joined0 = nn.exactNearestNeighborsJoin(empty_q)
+    assert len(joined0) == 0
+    assert list(joined0.columns) == list(joined.columns)
