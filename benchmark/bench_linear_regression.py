@@ -26,11 +26,63 @@ class BenchmarkLinearRegression(BenchmarkBase):
     }
 
     def gen_dataset(self, args, mesh):
+        if args.cpu_comparison:
+            from .gen_data import gen_regression_host
+
+            Xh, yh, coef = gen_regression_host(args.num_rows, args.num_cols, seed=args.seed)
+            data = self.dataset_from_arrays(Xh, yh, args, mesh)
+            data["coef_true"] = coef
+            return data
         X, y, w, coef = gen_regression_device(
             args.num_rows, args.num_cols, seed=args.seed, mesh=mesh
         )
         fetch(w[:1])
         return {"X": X, "y": y, "w": w, "coef_true": coef}
+
+    def dataset_from_arrays(self, X, y, args, mesh):
+        from spark_rapids_ml_tpu.parallel import make_global_rows
+
+        if y is None:
+            raise ValueError("linear_regression dataset needs a label column")
+        Xh = np.asarray(X, dtype=np.float32)
+        yh = np.asarray(y, dtype=np.float32)
+        Xd, w, _ = make_global_rows(mesh, Xh)  # pad + row-shard like the gens
+        yd, _, _ = make_global_rows(mesh, yh)
+        return {
+            "X": Xd,
+            "y": yd,
+            "w": w,
+            "coef_true": None,
+            "X_host": Xh,
+            "y_host": yh,
+        }
+
+    def run_cpu(self, args, data):
+        import time
+
+        from sklearn.linear_model import ElasticNet, LinearRegression, Ridge
+
+        names = list(CONFIGS) if args.config == "all" else [args.config]
+        out = {}
+        total = 0.0
+        for cname in names:
+            cfg = CONFIGS[cname]
+            if cfg["alpha"] == 0.0:
+                est = LinearRegression()
+            elif cfg["l1_ratio"] > 0.0:
+                est = ElasticNet(
+                    alpha=cfg["alpha"], l1_ratio=cfg["l1_ratio"],
+                    max_iter=cfg["max_iter"],
+                )
+            else:
+                est = Ridge(alpha=cfg["alpha"] * len(data["X_host"]))
+            t0 = time.perf_counter()
+            est.fit(data["X_host"], data["y_host"])
+            dt = time.perf_counter() - t0
+            out[f"cpu_fit_{cname}"] = dt
+            total += dt
+        out["cpu_fit"] = total
+        return out
 
     def run_once(self, args, data, mesh):
         from spark_rapids_ml_tpu.ops.linear import linear_fit

@@ -22,11 +22,48 @@ class BenchmarkLogisticRegression(BenchmarkBase):
     }
 
     def gen_dataset(self, args, mesh):
+        if args.cpu_comparison:
+            from .gen_data import gen_classification_host
+
+            Xh, yh = gen_classification_host(
+                args.num_rows, args.num_cols, args.n_classes, args.seed
+            )
+            return self.dataset_from_arrays(Xh, yh, args, mesh)
         X, y, w = gen_classification_device(
             args.num_rows, args.num_cols, n_classes=args.n_classes, seed=args.seed, mesh=mesh
         )
         fetch(w[:1])
         return {"X": X, "y": y, "w": w}
+
+    def dataset_from_arrays(self, X, y, args, mesh):
+        from spark_rapids_ml_tpu.parallel import make_global_rows
+
+        if y is None:
+            raise ValueError("logistic_regression dataset needs a label column")
+        Xh = np.asarray(X, dtype=np.float32)
+        yh = np.asarray(y, dtype=np.float32)
+        Xd, w, _ = make_global_rows(mesh, Xh)  # pad + row-shard like the gens
+        yd, _, _ = make_global_rows(mesh, yh.astype(np.int32))
+        return {
+            "X": Xd,
+            "y": yd,
+            "w": w,
+            "X_host": Xh,
+            "y_host": yh,
+        }
+
+    def run_cpu(self, args, data):
+        import time
+
+        from sklearn.linear_model import LogisticRegression as SkLR
+
+        # Spark regParam -> sklearn C = 1 / (n * regParam)
+        C = 1.0 / max(len(data["X_host"]) * args.reg, 1e-30)
+        t0 = time.perf_counter()
+        SkLR(C=C, max_iter=args.maxIter, tol=1e-30, solver="lbfgs").fit(
+            data["X_host"], data["y_host"]
+        )
+        return {"cpu_fit": time.perf_counter() - t0}
 
     def run_once(self, args, data, mesh):
         from spark_rapids_ml_tpu.ops.logistic import logistic_fit

@@ -23,10 +23,39 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
     def gen_dataset(self, args, mesh):
         import jax
 
+        if args.cpu_comparison:
+            from .gen_data import gen_low_rank_host
+
+            Xh = gen_low_rank_host(args.num_rows, args.num_cols, seed=args.seed)
+            return self.dataset_from_arrays(Xh, None, args, mesh)
         X, w = gen_low_rank_device(args.num_rows, args.num_cols, seed=args.seed, mesh=mesh)
         Q = jax.device_put(np.asarray(X[: args.num_queries], dtype=np.float32))
         fetch(w[:1])
         return {"X": X, "w": w, "Q": Q}
+
+    def dataset_from_arrays(self, X, y, args, mesh):
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import make_global_rows
+
+        Xh = np.asarray(X, dtype=np.float32)
+        Xd, w, _ = make_global_rows(mesh, Xh)  # pad + row-shard like the gens
+        return {
+            "X": Xd,
+            "w": w,
+            "Q": jax.device_put(Xh[: args.num_queries]),
+            "X_host": Xh,
+        }
+
+    def run_cpu(self, args, data):
+        import time
+
+        from sklearn.neighbors import NearestNeighbors as SkNN
+
+        t0 = time.perf_counter()
+        nn = SkNN(n_neighbors=args.k, algorithm="brute").fit(data["X_host"])
+        nn.kneighbors(data["X_host"][: args.num_queries])
+        return {"cpu_fit": time.perf_counter() - t0}
 
     def run_once(self, args, data, mesh):
         from spark_rapids_ml_tpu.ops.knn import exact_knn

@@ -19,9 +19,35 @@ class BenchmarkPCA(BenchmarkBase):
     }
 
     def gen_dataset(self, args, mesh):
+        if args.cpu_comparison:
+            # host-generated so the sklearn arm sees the same rows (fetching a
+            # device-generated matrix back is off the table: ~4 MB/s tunnel)
+            from .gen_data import gen_low_rank_host
+
+            Xh = gen_low_rank_host(args.num_rows, args.num_cols, seed=args.seed)
+            return self.dataset_from_arrays(Xh, None, args, mesh)
         X, w = gen_low_rank_device(args.num_rows, args.num_cols, seed=args.seed, mesh=mesh)
         fetch(w[:1])
         return {"X": X, "w": w}
+
+    def dataset_from_arrays(self, X, y, args, mesh):
+        from spark_rapids_ml_tpu.parallel import make_global_rows
+
+        Xh = np.asarray(X, dtype=np.float32)
+        # mesh-aware layout (pad + row-shard), exactly like the generator path
+        Xd, w, _ = make_global_rows(mesh, Xh)
+        return {"X": Xd, "w": w, "X_host": Xh}
+
+    def run_cpu(self, args, data):
+        import time
+
+        from sklearn.decomposition import PCA as SkPCA
+
+        t0 = time.perf_counter()
+        SkPCA(n_components=args.k, svd_solver="randomized", random_state=0).fit(
+            data["X_host"]
+        )
+        return {"cpu_fit": time.perf_counter() - t0}
 
     def run_once(self, args, data, mesh):
         import jax

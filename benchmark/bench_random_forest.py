@@ -24,6 +24,18 @@ class BenchmarkRandomForest(BenchmarkBase):
     }
 
     def gen_dataset(self, args, mesh):
+        if args.cpu_comparison:
+            from .gen_data import gen_classification_host, gen_regression_host
+
+            if args.task == "classification":
+                Xh, yh = gen_classification_host(
+                    args.num_rows, args.num_cols, 2, args.seed
+                )
+            else:
+                Xh, yh, _ = gen_regression_host(
+                    args.num_rows, args.num_cols, seed=args.seed
+                )
+            return self.dataset_from_arrays(Xh, yh, args, mesh)
         if args.task == "classification":
             X, y, w = gen_classification_device(
                 args.num_rows, args.num_cols, n_classes=2, seed=args.seed, mesh=mesh
@@ -36,6 +48,39 @@ class BenchmarkRandomForest(BenchmarkBase):
             data = {"X": X, "y": y, "w": w}
         fetch(w[:1])
         return data
+
+    def dataset_from_arrays(self, X, y, args, mesh):
+        from spark_rapids_ml_tpu.parallel import make_global_rows
+
+        if y is None:
+            raise ValueError("random_forest dataset needs a label column")
+        Xh = np.asarray(X, dtype=np.float32)
+        yh = np.asarray(y, dtype=np.float32)
+        Xd, w, _ = make_global_rows(mesh, Xh)  # pad + row-shard like the gens
+        yd, _, _ = make_global_rows(mesh, yh)
+        return {
+            "X": Xd,
+            "y": yd,
+            "w": w,
+            "X_host": Xh,
+            "y_host": yh,
+        }
+
+    def run_cpu(self, args, data):
+        import time
+
+        from sklearn.ensemble import RandomForestClassifier as SkRFC
+        from sklearn.ensemble import RandomForestRegressor as SkRFR
+
+        clf = args.task == "classification"
+        n_trees = args.numTrees or (50 if clf else 30)
+        depth = args.maxDepth or (13 if clf else 6)
+        est = (SkRFC if clf else SkRFR)(
+            n_estimators=n_trees, max_depth=depth, n_jobs=-1, random_state=0
+        )
+        t0 = time.perf_counter()
+        est.fit(data["X_host"], data["y_host"])
+        return {"cpu_fit": time.perf_counter() - t0}
 
     def run_once(self, args, data, mesh):
         import jax

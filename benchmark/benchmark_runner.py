@@ -50,8 +50,10 @@ PROTOCOL = [
     ("random_forest", ["--task", "regression"]),
     ("nearest_neighbors", []),
     ("approximate_nearest_neighbors", []),
+    ("approximate_nearest_neighbors", ["--algorithm", "cagra"]),
     ("dbscan", ["--num_rows", "40000", "--num_cols", "64"]),
     ("umap", ["--num_rows", "20000", "--num_cols", "64"]),
+    ("umap", ["--num_rows", "100000", "--num_cols", "64"]),
 ]
 
 

@@ -1,0 +1,85 @@
+#
+# Shared parquet dataset layout (the reference's benchmark datasets are
+# multi-file parquet directories written by gen_data.py:248-453 /
+# gen_data_distributed.py and read by every benchmark through
+# spark.read.parquet; databricks/README.md documents the shared-bucket
+# layout). TPU analog: a directory of part-*.parquet files with a "features"
+# list<float> column (+ optional "label"), written/read with pyarrow — no
+# Spark needed, but the layout matches what a Spark reader/writer produces so
+# datasets can be exchanged with the reference pipeline.
+#
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def write_parquet_dataset(
+    path: str,
+    X: np.ndarray,
+    y: Optional[np.ndarray] = None,
+    *,
+    n_files: int = 50,
+    features_col: str = "features",
+    label_col: str = "label",
+) -> int:
+    """Write [n, d] features (+ labels) as `n_files` part-*.parquet files under
+    `path` (the reference protocol's 50-file layout). Returns files written."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    n = len(X)
+    n_files = max(1, min(n_files, n))
+    bounds = np.linspace(0, n, n_files + 1).astype(np.int64)
+    for f in range(n_files):
+        lo, hi = int(bounds[f]), int(bounds[f + 1])
+        cols = {features_col: pa.array(list(X[lo:hi].astype(np.float32)))}
+        if y is not None:
+            cols[label_col] = pa.array(np.asarray(y[lo:hi]).astype(np.float64))
+        table = pa.table(cols)
+        pq.write_table(table, os.path.join(path, f"part-{f:05d}.parquet"))
+    return n_files
+
+
+def read_parquet_dataset(
+    path: str,
+    *,
+    features_col: str = "features",
+    label_col: str = "label",
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Read a parquet dataset directory (or single file) into (X [n, d] f32,
+    y or None). Accepts both the list<float> "features" column this module
+    writes and a multi-column numeric layout (feature_0..feature_k, the
+    reference's alternative schema)."""
+    import pyarrow.parquet as pq
+
+    files = (
+        sorted(glob.glob(os.path.join(path, "*.parquet")))
+        if os.path.isdir(path)
+        else [path]
+    )
+    if not files:
+        raise FileNotFoundError(f"no parquet files under {path}")
+    xs, ys = [], []
+    for fp in files:
+        t = pq.read_table(fp)
+        names = t.column_names
+        if features_col in names:
+            feats = t.column(features_col).to_pylist()
+            xs.append(np.asarray(feats, dtype=np.float32))
+        else:
+            fcols = [c for c in names if c != label_col]
+            xs.append(
+                np.column_stack(
+                    [np.asarray(t.column(c), dtype=np.float32) for c in fcols]
+                )
+            )
+        if label_col in names:
+            ys.append(np.asarray(t.column(label_col), dtype=np.float64))
+    X = np.concatenate(xs, axis=0)
+    y = np.concatenate(ys, axis=0) if ys else None
+    return X, y

@@ -237,7 +237,10 @@ def gen_sparse_regression_host(
 
 
 def main(argv=None) -> None:
-    """CLI: generate a dataset to .npz (dense) / .npz CSR triple (sparse)."""
+    """CLI: generate a dataset to .npz (dense) / .npz CSR triple (sparse), or
+    to the reference protocol's multi-file parquet layout
+    (`--fmt parquet --n_files 50`, ref gen_data.py:248-453 +
+    databricks/README.md shared-bucket datasets)."""
     p = argparse.ArgumentParser(description="benchmark dataset generator")
     p.add_argument("kind", choices=["blobs", "low_rank", "regression", "classification", "sparse_regression"])
     p.add_argument("--num_rows", type=int, default=100_000)
@@ -246,30 +249,51 @@ def main(argv=None) -> None:
     p.add_argument("--centers", type=int, default=10)
     p.add_argument("--density", type=float, default=0.001)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--output", required=True, help="output .npz path")
+    p.add_argument("--output", required=True, help="output .npz path / parquet dir")
+    p.add_argument("--fmt", choices=["npz", "parquet"], default="npz")
+    p.add_argument("--n_files", type=int, default=50,
+                   help="parquet part files (reference protocol: 50)")
     args = p.parse_args(argv)
 
+    y = coef = None
     if args.kind == "blobs":
         x, y = gen_blobs_host(args.num_rows, args.num_cols, args.centers, args.seed)
-        np.savez_compressed(args.output, X=x, y=y)
     elif args.kind == "low_rank":
         x = gen_low_rank_host(args.num_rows, args.num_cols, seed=args.seed)
-        np.savez_compressed(args.output, X=x)
     elif args.kind == "regression":
         x, y, coef = gen_regression_host(args.num_rows, args.num_cols, seed=args.seed)
-        np.savez_compressed(args.output, X=x, y=y, coef=coef)
     elif args.kind == "classification":
         x, y = gen_classification_host(args.num_rows, args.num_cols, args.n_classes, args.seed)
-        np.savez_compressed(args.output, X=x, y=y)
     else:
+        if args.fmt == "parquet":
+            raise SystemExit(
+                "sparse_regression writes an npz CSR triple; --fmt parquet is"
+                " only for dense datasets"
+            )
         x, y, coef = gen_sparse_regression_host(
             args.num_rows, args.num_cols, args.density, args.seed
         )
+        # sparse stays npz (CSR triple); parquet layout is for dense protocol sets
         np.savez_compressed(
             args.output, data=x.data, indices=x.indices, indptr=x.indptr,
             shape=np.asarray(x.shape), y=y, coef=coef,
         )
-    print(f"wrote {args.output}")
+        print(f"wrote {args.output}")
+        return
+
+    if args.fmt == "parquet":
+        from .dataset_io import write_parquet_dataset
+
+        n_files = write_parquet_dataset(args.output, x, y, n_files=args.n_files)
+        print(f"wrote {n_files} parquet part files under {args.output}")
+    else:
+        arrays = {"X": x}
+        if y is not None:
+            arrays["y"] = y
+        if coef is not None:
+            arrays["coef"] = coef
+        np.savez_compressed(args.output, **arrays)
+        print(f"wrote {args.output}")
 
 
 if __name__ == "__main__":

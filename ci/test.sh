@@ -4,6 +4,8 @@
 # nightly --runslow, ci/test.sh:20-57). Usage:
 #   ci/test.sh            # pre-merge: lint + fast tests
 #   ci/test.sh --nightly  # adds the large-scale --runslow tests
+#   ci/test.sh --spark    # Spark barrier-stage integration lane (needs a
+#                         # pyspark install; tests self-skip without one)
 #
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -16,6 +18,11 @@ if [[ "${1:-}" == "--nightly" ]]; then
   python -m pytest tests/ -q --runslow
   echo "== nightly: multichip dryrun"
   python __graft_entry__.py
+elif [[ "${1:-}" == "--spark" ]]; then
+  echo "== spark integration lane (real local[N] barrier stage)"
+  python -c "import pyspark" 2>/dev/null || {
+    echo "pyspark not installed - the pyspark lane will self-skip"; }
+  python -m pytest tests/test_spark.py -q
 else
   echo "== unit/parity tests (virtual 8-device CPU mesh)"
   python -m pytest tests/ -q

@@ -1,0 +1,48 @@
+//
+// JNI loader for the srml native kernels — the counterpart of the
+// reference's JNI entry class (jvm/src/main/java/com/nvidia/spark/ml/linalg/
+// JniRAPIDSML.java:64-77 declares dgemm/calSVD natives over rapidsml_jni.cu).
+// Implementations live in native/src/srml_jni.cpp over the same C kernels
+// the Python ctypes path uses (native/src/srml_native.cpp).
+//
+package com.srmltpu.linalg;
+
+public final class SrmlNative {
+  private static volatile boolean loaded = false;
+
+  private SrmlNative() {}
+
+  /**
+   * Load libsrml_jni.so. Resolution order: the `srml.native.path` system
+   * property, then java.library.path. Call once before any native method.
+   */
+  public static synchronized void ensureLoaded() {
+    if (loaded) {
+      return;
+    }
+    String explicit = System.getProperty("srml.native.path");
+    if (explicit != null) {
+      System.load(explicit);
+    } else {
+      System.loadLibrary("srml_jni");
+    }
+    loaded = true;
+  }
+
+  /** c += x^T x for row-major x [n, d]; c row-major [d, d], accumulated. */
+  public static native void covAccumulate(double[] x, long n, long d, double[] c);
+
+  /** mean = sum_i w_i x_i / sum_i w_i (w may be null for unit weights). */
+  public static native void weightedMean(double[] x, double[] w, long n, long d, double[] mean);
+
+  /**
+   * Cyclic-Jacobi symmetric eigendecomposition of row-major a [d, d]:
+   * eigenvalues ascending into evals [d], eigenvectors as columns of
+   * row-major evecs [d, d]. Returns sweeps used, or -1 if not converged.
+   */
+  public static native int eighJacobi(
+      double[] a, long d, double[] evals, double[] evecs, int maxSweeps, double tol);
+
+  /** Per row of comps [k, d]: negate the row if its max-|.| element is negative. */
+  public static native void signFlip(double[] comps, long k, long d);
+}

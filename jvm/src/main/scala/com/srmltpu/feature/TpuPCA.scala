@@ -1,0 +1,109 @@
+//
+// Scala PCA estimator over the srml native kernels — the JVM API analog of
+// the reference's accelerated Spark-ML PCA (reference jvm/src/main/scala/org/
+// apache/spark/ml/feature/RapidsPCA.scala:72-166, which replaces the
+// covariance gemm + SVD with its JNI CUDA library). Design here: executors
+// reduce the covariance sufficient statistics with `treeAggregate` (each
+// partition accumulates X^T X and the weighted sum through SrmlNative), the
+// driver runs the native Jacobi eigensolver + sign canonicalization, and the
+// result is exposed with the same (pc, explainedVariance) model surface.
+//
+package com.srmltpu.feature
+
+import com.srmltpu.linalg.SrmlNative
+
+import org.apache.spark.rdd.RDD
+
+/** Fitted PCA model: `pc` is row-major [k, d] (rows = components, descending
+  * eigenvalue order, sign-canonicalized), `explainedVariance` the matching
+  * variance ratios, `mean` the column means removed before projection. */
+case class TpuPCAModel(
+    k: Int,
+    mean: Array[Double],
+    pc: Array[Array[Double]],
+    explainedVariance: Array[Double]
+) {
+  /** Project one row: (x - mean) dot pc_r for each component r. */
+  def transform(x: Array[Double]): Array[Double] = {
+    val out = new Array[Double](k)
+    var r = 0
+    while (r < k) {
+      var acc = 0.0
+      var j = 0
+      val row = pc(r)
+      while (j < row.length) { acc += (x(j) - mean(j)) * row(j); j += 1 }
+      out(r) = acc
+      r += 1
+    }
+    out
+  }
+}
+
+class TpuPCA(val k: Int) extends Serializable {
+  require(k > 0, s"k must be positive, got $k")
+
+  /** Fit over an RDD of dense feature rows (all the same length d). */
+  def fit(rows: RDD[Array[Double]]): TpuPCAModel = {
+    val d = rows.first().length
+    val n = rows.count()
+    require(k <= d, s"k ($k) must be <= feature dimension ($d)")
+
+    // sufficient statistics per partition: (sum x, X^T X flattened, count)
+    val zero = (new Array[Double](d), new Array[Double](d * d), 0L)
+    val (sumX, xtx, total) = rows.treeAggregate(zero)(
+      seqOp = { case ((s, c, cnt), row) =>
+        SrmlNative.ensureLoaded()
+        // accumulate one row into the gram through the blocked native kernel
+        SrmlNative.covAccumulate(row, 1L, d.toLong, c)
+        var j = 0
+        while (j < d) { s(j) += row(j); j += 1 }
+        (s, c, cnt + 1L)
+      },
+      combOp = { case ((s1, c1, n1), (s2, c2, n2)) =>
+        var j = 0
+        while (j < d) { s1(j) += s2(j); j += 1 }
+        j = 0
+        while (j < d * d) { c1(j) += c2(j); j += 1 }
+        (s1, c1, n1 + n2)
+      }
+    )
+    require(total == n && total > 1, s"degenerate dataset: $total rows")
+
+    // covariance = (X^T X - n * mean mean^T) / (n - 1)
+    val mean = sumX.map(_ / total)
+    val cov = new Array[Double](d * d)
+    var i = 0
+    while (i < d) {
+      var j = 0
+      while (j < d) {
+        cov(i * d + j) = (xtx(i * d + j) - total * mean(i) * mean(j)) / (total - 1.0)
+        j += 1
+      }
+      i += 1
+    }
+
+    SrmlNative.ensureLoaded()
+    val evals = new Array[Double](d)
+    val evecs = new Array[Double](d * d)
+    val sweeps = SrmlNative.eighJacobi(cov, d.toLong, evals, evecs, 100, 1e-12)
+    require(sweeps >= 0, "eigensolver did not converge")
+
+    // top-k columns, descending eigenvalue; rows of `pc` are components
+    val pcFlat = new Array[Double](k * d)
+    val ev = new Array[Double](k)
+    var r = 0
+    while (r < k) {
+      val col = d - 1 - r // ascending -> take from the back
+      ev(r) = math.max(evals(col), 0.0)
+      var row = 0
+      while (row < d) { pcFlat(r * d + row) = evecs(row * d + col); row += 1 }
+      r += 1
+    }
+    SrmlNative.signFlip(pcFlat, k.toLong, d.toLong)
+
+    val totVar = evals.map(math.max(_, 0.0)).sum
+    val ratio = ev.map(v => if (totVar > 0) v / totVar else 0.0)
+    val pc = Array.tabulate(k)(r => pcFlat.slice(r * d, (r + 1) * d))
+    TpuPCAModel(k, mean, pc, ratio)
+  }
+}

@@ -1,0 +1,46 @@
+//
+// TpuPCA test (the reference's PCASuite analog, jvm/src/test/scala/.../
+// PCASuite.scala pattern: fit on a small local dataset, check component
+// orthonormality and variance ordering).
+//
+package com.srmltpu.feature
+
+import org.apache.spark.sql.SparkSession
+import org.scalatest.funsuite.AnyFunSuite
+
+class TpuPCASuite extends AnyFunSuite {
+
+  test("fit recovers an orthonormal top-k basis with descending variance") {
+    val spark = SparkSession.builder().master("local[2]").appName("TpuPCASuite").getOrCreate()
+    try {
+      val rng = new scala.util.Random(7)
+      val d = 6
+      // anisotropic Gaussian: leading direction has much larger variance
+      val rows = Seq.fill(500) {
+        val base = Array.fill(d)(rng.nextGaussian())
+        base(0) *= 10.0; base(1) *= 3.0
+        base
+      }
+      val rdd = spark.sparkContext.parallelize(rows, 3)
+      val model = new TpuPCA(3).fit(rdd)
+
+      assert(model.pc.length == 3 && model.pc.head.length == d)
+      // descending explained variance
+      assert(model.explainedVariance.sliding(2).forall(p => p(0) >= p(1) - 1e-12))
+      // orthonormal components
+      for (a <- 0 until 3; b <- 0 until 3) {
+        val dot = (0 until d).map(j => model.pc(a)(j) * model.pc(b)(j)).sum
+        val expect = if (a == b) 1.0 else 0.0
+        assert(math.abs(dot - expect) < 1e-8, s"pc($a) . pc($b) = $dot")
+      }
+      // the leading component aligns with axis 0 (variance 100 vs <= 9)
+      assert(math.abs(model.pc(0)(0)) > 0.99)
+      // sign canonicalization: max-|.| element of every component positive
+      model.pc.foreach { row =>
+        assert(row(row.map(math.abs).zipWithIndex.maxBy(_._1)._2) >= 0.0)
+      }
+    } finally {
+      spark.stop()
+    }
+  }
+}

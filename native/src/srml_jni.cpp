@@ -1,0 +1,71 @@
+//
+// JNI bridge over the srml_native C kernels — the counterpart of the
+// reference's JNI surface (reference jvm/src/main/java/.../JniRAPIDSML.java:
+// 64-77 declares native dgemm/calSVD entry points implemented by
+// rapidsml_jni.cu). Here the same pattern binds the in-tree C++ kernels
+// (srml_native.cpp) to the Scala/Java API in /jvm.
+//
+// Build: only compiled when CMake finds a JNI installation (see
+// native/CMakeLists.txt) — the CI image ships no JVM, so this file is
+// exercised by the Maven build documented in jvm/README.md.
+//
+#include <jni.h>
+
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+void srml_cov_accumulate(const double* x, int64_t n, int64_t d, double* c);
+void srml_weighted_mean(const double* x, const double* w, int64_t n, int64_t d,
+                        double* mean);
+int srml_eigh_jacobi(const double* a_in, int64_t d, double* evals,
+                     double* evecs, int max_sweeps, double tol);
+void srml_signflip(double* comps, int64_t k, int64_t d);
+}
+
+extern "C" {
+
+// class com.srmltpu.linalg.SrmlNative — names must match the Java decls.
+
+JNIEXPORT void JNICALL Java_com_srmltpu_linalg_SrmlNative_covAccumulate(
+    JNIEnv* env, jclass, jdoubleArray jx, jlong n, jlong d, jdoubleArray jc) {
+  jdouble* x = env->GetDoubleArrayElements(jx, nullptr);
+  jdouble* c = env->GetDoubleArrayElements(jc, nullptr);
+  srml_cov_accumulate(x, n, d, c);
+  env->ReleaseDoubleArrayElements(jx, x, JNI_ABORT);  // input: no copy-back
+  env->ReleaseDoubleArrayElements(jc, c, 0);
+}
+
+JNIEXPORT void JNICALL Java_com_srmltpu_linalg_SrmlNative_weightedMean(
+    JNIEnv* env, jclass, jdoubleArray jx, jdoubleArray jw, jlong n, jlong d,
+    jdoubleArray jmean) {
+  jdouble* x = env->GetDoubleArrayElements(jx, nullptr);
+  jdouble* w = jw ? env->GetDoubleArrayElements(jw, nullptr) : nullptr;
+  jdouble* m = env->GetDoubleArrayElements(jmean, nullptr);
+  srml_weighted_mean(x, w, n, d, m);
+  env->ReleaseDoubleArrayElements(jx, x, JNI_ABORT);
+  if (jw) env->ReleaseDoubleArrayElements(jw, w, JNI_ABORT);
+  env->ReleaseDoubleArrayElements(jmean, m, 0);
+}
+
+JNIEXPORT jint JNICALL Java_com_srmltpu_linalg_SrmlNative_eighJacobi(
+    JNIEnv* env, jclass, jdoubleArray ja, jlong d, jdoubleArray jevals,
+    jdoubleArray jevecs, jint maxSweeps, jdouble tol) {
+  jdouble* a = env->GetDoubleArrayElements(ja, nullptr);
+  jdouble* evals = env->GetDoubleArrayElements(jevals, nullptr);
+  jdouble* evecs = env->GetDoubleArrayElements(jevecs, nullptr);
+  const int sweeps = srml_eigh_jacobi(a, d, evals, evecs, maxSweeps, tol);
+  env->ReleaseDoubleArrayElements(ja, a, JNI_ABORT);
+  env->ReleaseDoubleArrayElements(jevals, evals, 0);
+  env->ReleaseDoubleArrayElements(jevecs, evecs, 0);
+  return sweeps;
+}
+
+JNIEXPORT void JNICALL Java_com_srmltpu_linalg_SrmlNative_signFlip(
+    JNIEnv* env, jclass, jdoubleArray jcomps, jlong k, jlong d) {
+  jdouble* comps = env->GetDoubleArrayElements(jcomps, nullptr);
+  srml_signflip(comps, k, d);
+  env->ReleaseDoubleArrayElements(jcomps, comps, 0);
+}
+
+}  // extern "C"

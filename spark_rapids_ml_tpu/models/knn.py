@@ -350,7 +350,7 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
 
 
 class _ANNParams(_KNNParams):
-    algorithm = Param("algorithm", "ANN algorithm: 'ivfflat' or 'ivfpq'", TypeConverters.toString)
+    algorithm = Param("algorithm", "ANN algorithm: 'ivfflat', 'ivfpq' or 'cagra'", TypeConverters.toString)
     algoParams = Param("algoParams", "algorithm-specific parameters dict", TypeConverters.identity)
 
     def _get_solver_params_default(self) -> Dict[str, Any]:
@@ -365,18 +365,33 @@ class _ANNParams(_KNNParams):
             # with exact distances (the cuVS refine step) — raw ADC ordering
             # alone caps recall well below the probe ceiling
             "refine_ratio": 4,
+            # cagra index params (reference knn.py:927-931 IndexParams)
+            "build_algo": "ivf_pq",
+            "graph_degree": 64,
+            "intermediate_graph_degree": 128,
+            # cagra search params (reference knn.py:933-938 SearchParams)
+            "itopk_size": 64,
+            "search_width": 1,
+            "max_iterations": 0,
+            "min_iterations": 0,
+            "num_random_samplings": 1,
             "verbose": False,
         }
 
 
 class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
-    """Approximate kNN via IVFFlat or IVFPQ (reference knn.py:787-1544,
-    ivfflat/ivfpq algorithms knn.py:1393-1404).
+    """Approximate kNN via IVFFlat, IVFPQ or CAGRA (reference
+    knn.py:787-1544; algorithm set knn.py:1089-1094).
 
     Local-index strategy like the reference: a coarse KMeans quantizer with
     padded inverted lists; queries probe `n_probes` lists. IVFPQ additionally
     product-quantizes the residuals and searches via ADC lookup tables.
-    `algoParams` accepts the cuML-style keys {"nlist", "nprobe", "M", "n_bits"}.
+    CAGRA builds a fixed-degree kNN graph by tiled NN-descent and answers
+    queries with a batched greedy graph search (ops/cagra.py).
+    `algoParams` accepts the cuML/cuVS-style keys {"nlist", "nprobe", "M",
+    "n_bits"} and the cagra keys {"build_algo", "graph_degree",
+    "intermediate_graph_degree", "itopk_size", "search_width",
+    "max_iterations", "min_iterations", "num_random_samplings"}.
     """
 
     def __init__(self, **kwargs: Any) -> None:
@@ -385,12 +400,19 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
         self._set_params(**kwargs)
 
     def _set_params(self, **kwargs):
-        if "algorithm" in kwargs and kwargs["algorithm"] not in ("ivfflat", "ivfpq"):
+        if "algorithm" in kwargs and kwargs["algorithm"] not in (
+            "ivfflat", "ivfpq", "cagra",
+        ):
             raise ValueError(
-                f"algorithm {kwargs['algorithm']!r} not supported (ivfflat | ivfpq)"
+                f"algorithm {kwargs['algorithm']!r} not supported"
+                " (ivfflat | ivfpq | cagra)"
             )
         if "algoParams" in kwargs:
             ap = kwargs.pop("algoParams") or {}
+            if "compression" in ap:
+                raise ValueError(
+                    "cagra 'compression' is not supported by the TPU backend"
+                )
             mapped = {
                 "nlist": "n_lists", "nprobe": "n_probes", "M": "pq_m",
                 "n_bits": "pq_n_bits", "refine_ratio": "refine_ratio",
@@ -430,6 +452,28 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
                     feats, int(self._solver_params["n_lists"]),
                     M=int(self._solver_params["pq_m"]),
                     n_bits=int(self._solver_params["pq_n_bits"]),
+                    seed=0,
+                )
+            elif algo == "cagra":
+                from ..ops.cagra import build_cagra
+
+                # cuVS validates itopk_size >= k up front (knn.py:1286-1297);
+                # fail at fit like the reference does at first use
+                itopk = int(self._solver_params.get("itopk_size", 64))
+                internal = -(-itopk // 32) * 32
+                if internal < int(self._solver_params["n_neighbors"]):
+                    raise ValueError(
+                        f"cagra rounds itopk_size up to a multiple of 32"
+                        f" ({internal}) and requires it >= k"
+                        f" ({int(self._solver_params['n_neighbors'])})"
+                    )
+                index = build_cagra(
+                    feats,
+                    graph_degree=int(self._solver_params["graph_degree"]),
+                    intermediate_graph_degree=int(
+                        self._solver_params["intermediate_graph_degree"]
+                    ),
+                    build_algo=str(self._solver_params["build_algo"]),
                     seed=0,
                 )
             else:
@@ -536,6 +580,33 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
                 )
                 if k_adc > k:
                     dist, idx = self._refine_exact(np.asarray(queries), np.asarray(idx), k)
+            elif self._algorithm == "cagra":
+                from ..ops.cagra import cagra_search
+
+                sp = self._solver_params
+                idx, d2 = cagra_search(
+                    np.asarray(queries, dtype=np.float32),
+                    self._index,
+                    k=min(k, item_ex.n_rows),
+                    itopk_size=int(sp["itopk_size"]),
+                    search_width=int(sp["search_width"]),
+                    max_iterations=int(sp["max_iterations"]),
+                    min_iterations=int(sp["min_iterations"]),
+                    num_random_samplings=int(sp["num_random_samplings"]),
+                    batch_queries=int(sp["batch_queries"]),
+                )
+                # framework-wide convention: euclidean distances (the
+                # reference returns squared L2 for its ANN algorithms —
+                # documented deviation, docs/compatibility.md)
+                dist = np.sqrt(np.maximum(d2, 0.0))
+                if k > item_ex.n_rows:  # pad like the ivf paths
+                    padw = k - item_ex.n_rows
+                    idx = np.concatenate(
+                        [idx, np.full((len(idx), padw), -1, idx.dtype)], axis=1
+                    )
+                    dist = np.concatenate(
+                        [dist, np.full((len(dist), padw), np.inf, dist.dtype)], axis=1
+                    )
             else:
                 dist, idx = ivfflat_search(
                     jax.device_put(queries.astype(np.float32)),

@@ -1,0 +1,435 @@
+"""CAGRA-class graph ANN, TPU-native.
+
+The reference exposes cuVS CAGRA through ApproximateNearestNeighbors
+(algorithm="cagra", reference knn.py:902-935, 1264-1298, 1452-1481): a
+fixed-degree kNN graph is built over the item vectors (build_algo
+"ivf_pq" | "nn_descent") and queried with a greedy best-first search
+(itopk_size, search_width, max_iterations, num_random_samplings).
+
+This module re-designs both phases for the TPU instead of wrapping a CUDA
+graph library:
+
+* **Build = clustered brute-force seeding + NN-descent refinement, all as a
+  handful of big device programs.** Seeding (`build_algo="ivf_pq"`, the TPU
+  analog of cuVS's IVF-based seeding): several repetitions partition the rows
+  by nearest random anchor (one assignment matmul), lay every partition out
+  as a padded bucket, and run EXACT kNN inside each bucket — a [C, L, L]
+  batched distance matmul that lands squarely on the MXU; each row appears in
+  exactly one bucket per repetition, so the per-rep results merge into the
+  [n, K_int] graph with one conflict-free scatter. Refinement (both
+  build_algos) is NN-descent: each round is ONE jitted program that
+  fori-loops over row tiles; a tile expands the FULL adjacency lists of its
+  closest / random / reverse neighbors, scores the candidates with an einsum
+  over the gathered vectors, and merges sort-dedup'd. Reverse edges are
+  rebuilt between rounds by one device-wide sort — no host round trips and no
+  dynamic shapes anywhere. `build_algo="nn_descent"` skips the cluster
+  seeding (random init, more descent rounds).
+* **Search = batched greedy expansion, one program per query tile.** Each
+  query keeps an itopk-wide candidate list; every iteration expands the best
+  `search_width` unexpanded nodes, gathers their adjacency rows, scores the
+  new frontier (einsum over gathered vectors), and merges sort-dedup'd — the
+  whole search for a 4096-query tile is a single fori_loop'd XLA program.
+
+Distances are squared L2 ("sqeuclidean" — the only metric the reference
+accepts for cagra, knn.py:1267).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["build_cagra", "cagra_search"]
+
+_SENTINEL_F = jnp.float32(jnp.inf)
+
+
+def _row_sq(x: jax.Array) -> jax.Array:
+    return jnp.sum(x * x, axis=1)
+
+
+def _merge_dedup_topk(all_ids, all_d2, keep: int, extra=None):
+    """Per-row merge of candidate lists: drop duplicate ids (keeping the
+    smallest-d2 copy), then keep the `keep` smallest distances.
+
+    Sort twice — by d2, then STABLY by id — so the first entry of every
+    equal-id run is its best copy; later copies get +inf and fall out of the
+    final top-k. `extra` (e.g. the search's expanded flags) rides along."""
+    ord1 = jnp.argsort(all_d2, axis=1)
+    ids1 = jnp.take_along_axis(all_ids, ord1, axis=1)
+    d21 = jnp.take_along_axis(all_d2, ord1, axis=1)
+    ord2 = jnp.argsort(ids1, axis=1, stable=True)
+    ids2 = jnp.take_along_axis(ids1, ord2, axis=1)
+    d22 = jnp.take_along_axis(d21, ord2, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros_like(ids2[:, :1], bool), ids2[:, 1:] == ids2[:, :-1]], axis=1
+    )
+    d22 = jnp.where(dup, _SENTINEL_F, d22)
+    _, pos = jax.lax.top_k(-d22, keep)
+    out_ids = jnp.take_along_axis(ids2, pos, axis=1)
+    out_d2 = jnp.take_along_axis(d22, pos, axis=1)
+    if extra is None:
+        return out_ids, out_d2
+    ex = jnp.take_along_axis(
+        jnp.take_along_axis(jnp.take_along_axis(extra, ord1, axis=1), ord2, axis=1),
+        pos,
+        axis=1,
+    )
+    return out_ids, out_d2, ex
+
+
+def _score_candidates(q_rows, cand, x, x_sq):
+    """d2[t, c] = ||q_rows[t] - x[cand[t, c]]||² (squared L2, >= 0); the
+    [T, C, d] gather feeds one batched einsum (the MXU side of the round)."""
+    xc = x[cand]  # [T, C, d]
+    dots = jnp.einsum("td,tcd->tc", q_rows, xc)
+    d2 = _row_sq(q_rows)[:, None] + x_sq[cand] - 2.0 * dots
+    return jnp.maximum(d2, 0.0)
+
+
+@partial(jax.jit, static_argnames=("r_max",), donate_argnums=())
+def _reverse_edges(ids: jax.Array, *, r_max: int) -> jax.Array:
+    """[n, r_max] reverse adjacency (pad −1) built fully on device: sort the
+    flat edge list by tail, position-within-run via searchsorted, one scatter
+    (mode='drop' discards overflow past r_max — hubs keep an arbitrary
+    subset, which is exactly the sampling NN-descent wants)."""
+    n, k = ids.shape
+    flat = ids.reshape(-1)
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k)).reshape(-1)
+    order = jnp.argsort(flat)
+    st = flat[order]
+    ss = src[order]
+    seg_start = jnp.searchsorted(st, jnp.arange(n, dtype=ids.dtype))
+    offs = jnp.arange(st.shape[0]) - seg_start[st]
+    rev = jnp.full((n, r_max), -1, jnp.int32)
+    return rev.at[st, offs].set(ss, mode="drop")
+
+
+@partial(
+    jax.jit,
+    static_argnames=("tile", "s_top", "s_rnd", "s_rev", "c_rnd"),
+    donate_argnums=(2, 3),
+)
+def _descent_round(
+    x, x_sq, ids, d2, rev, key, *, tile: int, s_top: int, s_rnd: int,
+    s_rev: int, c_rnd: int
+):
+    """One NN-descent round over every row, a single XLA program.
+
+    Per tile of `tile` rows: expand the FULL adjacency lists of `s_top`
+    closest + `s_rnd` random + `s_rev` reverse neighbors (full-list expansion
+    converges far better than subsampling the 2-hop set — measured 0.81 vs
+    0.59 node-level graph recall at 20k x 64), plus the reverse edges
+    themselves and `c_rnd` fresh random ids; score; merge-dedup-topk back
+    into the [n, K_int] graph. The per-row lists are distance-sorted (top_k
+    output), so `ids_t[:, :s_top]` IS the closest-neighbor set."""
+    n, d = x.shape
+    k_int = ids.shape[1]
+    n_tiles = -(-n // tile)
+
+    def body(ti, carry):
+        ids_c, d2_c = carry
+        r0 = jnp.minimum(ti * tile, n - tile)
+        rows = (r0 + jnp.arange(tile)).astype(jnp.int32)
+        tkey = jax.random.fold_in(key, ti)
+        ids_t = jax.lax.dynamic_slice(ids_c, (r0, 0), (tile, k_int))
+        d2_t = jax.lax.dynamic_slice(d2_c, (r0, 0), (tile, k_int))
+        q_rows = jax.lax.dynamic_slice(x, (r0, 0), (tile, d))
+
+        k1, k2, k3 = jax.random.split(tkey, 3)
+        top_src = ids_t[:, :s_top]
+        rnd_slots = jax.random.randint(k1, (tile, s_rnd), s_top, k_int, jnp.int32)
+        rnd_src = jnp.take_along_axis(ids_t, rnd_slots, axis=1)
+        rev_t = jax.lax.dynamic_slice(rev, (r0, 0), (tile, rev.shape[1]))
+        rev_slots = jax.random.randint(k2, (tile, s_rev), 0, rev.shape[1], jnp.int32)
+        rev_src = jnp.clip(jnp.take_along_axis(rev_t, rev_slots, axis=1), 0, n - 1)
+        src = jnp.concatenate([top_src, rnd_src, rev_src], axis=1)
+        cand_fwd = ids_c[src].reshape(tile, -1)  # FULL lists of every source
+        cand_rnd = jax.random.randint(k3, (tile, c_rnd), 0, n, jnp.int32)
+
+        cand = jnp.concatenate([cand_fwd, rev_t, cand_rnd], axis=1)
+        invalid = (cand < 0) | (cand == rows[:, None])
+        cand = jnp.clip(cand, 0, n - 1)
+        d2_cand = _score_candidates(q_rows, cand, x, x_sq)
+        d2_cand = jnp.where(invalid, _SENTINEL_F, d2_cand)
+
+        all_ids = jnp.concatenate([ids_t, cand], axis=1)
+        all_d2 = jnp.concatenate([d2_t, d2_cand], axis=1)
+        new_ids, new_d2 = _merge_dedup_topk(all_ids, all_d2, k_int)
+        ids_c = jax.lax.dynamic_update_slice(ids_c, new_ids, (r0, 0))
+        d2_c = jax.lax.dynamic_update_slice(d2_c, new_d2, (r0, 0))
+        return ids_c, d2_c
+
+    return jax.lax.fori_loop(0, n_tiles, body, (ids, d2))
+
+
+@partial(jax.jit, static_argnames=("kk",))
+def _bucket_knn(xb, ids_b, *, kk: int):
+    """Exact kNN inside padded buckets: xb [Cb, L, d], ids_b [Cb, L] global
+    ids (−1 pad). One batched [Cb, L, L] distance matmul on the MXU + top-k.
+    Returns (d2 [Cb, L, kk], neighbor ids [Cb, L, kk])."""
+    sq = jnp.sum(xb * xb, axis=2)  # [Cb, L]
+    G = jnp.einsum("cld,cmd->clm", xb, xb)
+    d2 = sq[:, :, None] + sq[:, None, :] - 2.0 * G
+    valid = ids_b >= 0
+    mask = valid[:, None, :] & valid[:, :, None]
+    eye = jnp.eye(xb.shape[1], dtype=bool)[None]
+    d2 = jnp.where(mask & ~eye, jnp.maximum(d2, 0.0), _SENTINEL_F)
+    nd2, pos = jax.lax.top_k(-d2, kk)
+    nid = jnp.take_along_axis(
+        jnp.broadcast_to(ids_b[:, None, :], d2.shape), pos, axis=2
+    )
+    return -nd2, nid
+
+
+def _cluster_seed_rep(xd, x_sq, n: int, anchors_c: int, kk: int, seed: int):
+    """One clustered brute-force seeding repetition: partition rows by
+    nearest random anchor, exact kNN within each padded bucket, scatter the
+    per-row results into [n, kk] (each row lives in exactly ONE bucket, so
+    the scatter is conflict-free). Different seeds give different Voronoi
+    partitions; merged across reps they seed the graph with near-exact local
+    edges (the IVF analog of cuVS's ivf_pq build seeding)."""
+    d = xd.shape[1]
+    rng = np.random.default_rng(seed)
+    anchors = xd[jnp.asarray(rng.choice(n, min(anchors_c, n), replace=False))]
+    assign = np.asarray(
+        jax.jit(
+            lambda X, A: jnp.argmin(
+                jnp.sum(A * A, 1)[None, :] - 2.0 * X @ A.T, axis=1
+            ).astype(jnp.int32)
+        )(xd, anchors)
+    )
+    C = anchors.shape[0]
+    counts = np.bincount(assign, minlength=C)
+    # cap pathological buckets: overflow rows just miss THIS rep's edges
+    l_cap = max(kk + 1, int(4 * max(1, n // max(C, 1))))
+    L = int(min(counts.max(), l_cap))
+    order = np.argsort(assign, kind="stable")
+    offs = np.arange(n) - (np.cumsum(counts) - counts)[assign[order]]
+    keep = offs < L
+    ids_b = np.full((C, L), -1, np.int64)
+    ids_b[assign[order][keep], offs[keep]] = order[keep]
+    idsj = jnp.asarray(ids_b)
+
+    rep_d2 = jnp.full((n, kk), _SENTINEL_F)
+    rep_id = jnp.zeros((n, kk), jnp.int32)
+    # batch buckets so the [Cb, L, L] + [Cb, L, d] tensors stay bounded
+    cb = max(1, int(500_000_000 // max(L * L * 4 + L * d * 4, 1)))
+    for c0 in range(0, C, cb):
+        idc = idsj[c0 : c0 + cb]
+        xb = xd[jnp.clip(idc, 0, n - 1)]
+        nd2, nid = _bucket_knn(xb, idc, kk=kk)
+        # pad slots (-1) are routed OUT OF BOUNDS so mode='drop' discards them
+        flat_rows = jnp.where(idc >= 0, idc, n).reshape(-1)
+        rep_d2 = rep_d2.at[flat_rows].set(nd2.reshape(-1, kk), mode="drop")
+        # under-filled buckets yield -1 neighbor ids at +inf d2: clamp to 0
+        # (a harmless inf-distance duplicate that top-k drops)
+        rep_id = rep_id.at[flat_rows].set(
+            jnp.maximum(nid.reshape(-1, kk), 0).astype(jnp.int32), mode="drop"
+        )
+    return rep_id, rep_d2
+
+
+def build_cagra(
+    x,
+    *,
+    graph_degree: int = 64,
+    intermediate_graph_degree: int = 128,
+    build_algo: str = "ivf_pq",
+    nn_descent_niter: int = 0,
+    cluster_reps: int = 3,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Build the CAGRA graph index. Returns {"x": [n,d] f32 (host),
+    "graph": [n, graph_degree] int32 (host)}.
+
+    Parameter names/defaults mirror the reference's cagra IndexParams
+    (knn.py:927-931): graph_degree 64, intermediate_graph_degree 128,
+    build_algo "ivf_pq" | "nn_descent". "ivf_pq" (default) runs
+    `cluster_reps` clustered brute-force seeding repetitions
+    (_cluster_seed_rep — exact kNN inside Voronoi buckets, pure MXU batched
+    matmuls) and then NN-descent refinement rounds; "nn_descent" is pure
+    NN-descent from a random graph. nn_descent_niter=0 auto-selects the
+    round count per build_algo (8 after cluster seeding, 14 from random —
+    measured to reach ~0.9 node-level graph recall at 20k x 64).
+    """
+    x = np.ascontiguousarray(np.asarray(x, dtype=np.float32))
+    n, d = x.shape
+    if build_algo not in ("ivf_pq", "nn_descent"):
+        raise ValueError(
+            f"build_algo {build_algo!r} not supported (ivf_pq | nn_descent)"
+        )
+    k_int = int(min(intermediate_graph_degree, max(n - 1, 1)))
+    k_out = int(min(graph_degree, k_int))
+    n_rounds = int(nn_descent_niter) or (8 if build_algo == "ivf_pq" else 14)
+
+    rng = np.random.default_rng(seed)
+    xd = jax.device_put(x)
+    x_sq = _row_sq(xd)
+
+    if build_algo == "ivf_pq" and n > 4 * k_int:
+        # clustered brute-force seeding: target bucket size ~512 rows
+        ids = jnp.zeros((n, k_int), jnp.int32)
+        d2 = jnp.full((n, k_int), _SENTINEL_F)
+        anchors_c = max(2, n // 512)
+        kk = min(64, k_int, n - 1)
+        for rep in range(max(1, cluster_reps)):
+            rid, rd2 = _cluster_seed_rep(
+                xd, x_sq, n, anchors_c, kk, seed * 1000 + rep
+            )
+            ids, d2 = _merge_dedup_topk(
+                jnp.concatenate([ids, rid], axis=1),
+                jnp.concatenate([d2, rd2], axis=1),
+                k_int,
+            )
+    else:
+        # random init; descent round 0 scores these ids through the
+        # candidate channels, so +inf stored distances are correct
+        ids = jax.device_put(rng.integers(0, n, size=(n, k_int)).astype(np.int32))
+        d2 = jnp.full((n, k_int), _SENTINEL_F)
+
+    # full-list expansion budget: (s_top+s_rnd+s_rev)*k_int + r_max + c_rnd
+    s_top, s_rnd, s_rev, c_rnd, r_max = 2, 1, 1, 32, 64
+    c_total = (s_top + s_rnd + s_rev) * k_int + r_max + c_rnd
+    # tile sized so the [tile, c_total, d] candidate gather stays ~1.5 GB
+    tile = int(min(n, max(64, (1_500_000_000 // (c_total * d * 4)) & ~63)))
+    tile = max(1, min(tile, n))
+    key = jax.random.PRNGKey(seed)
+    for rnd in range(n_rounds):
+        rev = _reverse_edges(ids, r_max=r_max)
+        ids, d2 = _descent_round(
+            xd, x_sq, ids, d2, rev, jax.random.fold_in(key, rnd),
+            tile=tile, s_top=s_top, s_rnd=s_rnd, s_rev=s_rev, c_rnd=c_rnd,
+        )
+    # prune to the final degree: the K_int list is distance-sorted by top_k
+    graph = np.asarray(ids[:, :k_out])
+    return {"x": x, "graph": graph}
+
+
+@partial(
+    jax.jit,
+    static_argnames=("itopk", "k", "search_width", "iters"),
+)
+def _search_tile(
+    xq, x, x_sq, graph, key, *, itopk: int, k: int, search_width: int, iters: int
+):
+    """Greedy graph search for one query tile — a single XLA program.
+
+    State per query: `itopk` best ids/d2 plus an expanded flag. Each
+    iteration expands the best `search_width` unexpanded candidates, scores
+    their adjacency rows, and merges (sort-dedup + top-k, flags ride along)."""
+    qn, d = xq.shape
+    n = x.shape[0]
+    deg = graph.shape[1]
+    q_sq = _row_sq(xq)
+
+    init_ids = jax.random.randint(key, (qn, itopk), 0, n, jnp.int32)
+    d2 = _score_candidates(xq, init_ids, x, x_sq)
+    ids, d2 = _merge_dedup_topk(init_ids, d2, itopk)
+    expanded = jnp.zeros((qn, itopk), bool)
+
+    def body(_, state):
+        ids, d2, expanded = state
+        sel_score = jnp.where(expanded, _SENTINEL_F, d2)
+        _, sel = jax.lax.top_k(-sel_score, search_width)  # positions [Q, W]
+        sel_ids = jnp.take_along_axis(ids, sel, axis=1)
+        hit = jnp.any(
+            jnp.arange(itopk)[None, :, None] == sel[:, None, :], axis=2
+        )
+        expanded = expanded | hit
+        cand = graph[sel_ids].reshape(qn, search_width * deg)
+        dup = jnp.any(cand[:, :, None] == ids[:, None, :], axis=2)
+        d2c = _score_candidates(xq, cand, x, x_sq)
+        d2c = jnp.where(dup | (cand < 0), _SENTINEL_F, d2c)
+        all_ids = jnp.concatenate([ids, cand], axis=1)
+        all_d2 = jnp.concatenate([d2, d2c], axis=1)
+        all_exp = jnp.concatenate(
+            [expanded, jnp.zeros_like(dup)], axis=1
+        )
+        ids, d2, expanded = _merge_dedup_topk(all_ids, all_d2, itopk, all_exp)
+        return ids, d2, expanded
+
+    ids, d2, _ = jax.lax.fori_loop(0, iters, body, (ids, d2, expanded))
+    _, pos = jax.lax.top_k(-d2, k)
+    out_ids = jnp.take_along_axis(ids, pos, axis=1)
+    out_d2 = jnp.take_along_axis(d2, pos, axis=1)
+    return out_ids, out_d2
+
+
+def cagra_search(
+    queries,
+    index: Dict[str, Any],
+    *,
+    k: int,
+    itopk_size: int = 64,
+    search_width: int = 1,
+    max_iterations: int = 0,
+    min_iterations: int = 0,
+    num_random_samplings: int = 1,
+    seed: int = 0,
+    batch_queries: int = 4096,
+):
+    """Batched greedy search over the CAGRA graph. Returns (indices [q, k]
+    int64, d2 [q, k] f32 squared-L2), both host arrays.
+
+    Search params mirror the reference's cagra SearchParams
+    (knn.py:933-938). itopk_size is rounded up to a multiple of 32 (cuVS
+    semantics, knn.py:1286-1297); max_iterations=0 auto-selects enough
+    iterations to expand the whole itopk list at the given search_width."""
+    itopk = max(32, int(math.ceil(itopk_size / 32) * 32))
+    if itopk < k:
+        raise ValueError(f"itopk_size ({itopk}) must be >= k ({k})")
+    width = max(1, int(search_width))
+    iters = int(max_iterations) if max_iterations else -(-itopk // width)
+    iters = max(iters, int(min_iterations), 1)
+
+    q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
+    nq, d = q.shape
+    # accept pre-device-put index arrays (device_put of a jax.Array is a
+    # no-op; converting one through numpy would round-trip it to host)
+    x = index["x"]
+    graph = index["graph"]
+    if not isinstance(graph, jax.Array):
+        graph = np.asarray(graph, dtype=np.int32)
+    x = jax.device_put(x)
+    graph = jax.device_put(graph)
+    x_sq = _row_sq(x)
+
+    out_i = np.empty((nq, k), np.int64)
+    out_d = np.empty((nq, k), np.float32)
+    # tile sized so the per-iteration [bq, W*deg, d] frontier gather stays
+    # ~1.5 GB regardless of dimensionality
+    deg = index["graph"].shape[1]
+    cap = int(max(256, (1_500_000_000 // (width * deg * d * 4)) & ~63))
+    bq = max(1, min(batch_queries, cap, max(nq, 1)))
+    key = jax.random.PRNGKey(seed)
+    qd = None
+    for s in range(0, nq, bq):
+        qt = q[s : s + bq]
+        valid = len(qt)
+        if valid < bq:
+            qt = np.concatenate([qt, np.zeros((bq - valid, d), np.float32)])
+        qd = jax.device_put(qt)
+        # num_random_samplings re-runs the random seeding; keep the best run
+        best_i, best_d = None, None
+        for r in range(max(1, int(num_random_samplings))):
+            ti, td = _search_tile(
+                qd, x, x_sq, graph, jax.random.fold_in(key, s * 131 + r),
+                itopk=itopk, k=k, search_width=width, iters=iters,
+            )
+            if best_i is None:
+                best_i, best_d = ti, td
+            else:
+                best_i, best_d = _merge_dedup_topk(
+                    jnp.concatenate([best_i, ti], axis=1),
+                    jnp.concatenate([best_d, td], axis=1),
+                    k,
+                )
+        out_i[s : s + valid] = np.asarray(best_i)[:valid]
+        out_d[s : s + valid] = np.asarray(best_d)[:valid]
+    return out_i, out_d

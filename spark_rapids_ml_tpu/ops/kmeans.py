@@ -24,8 +24,27 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.mesh import ROWS_AXIS
 
 
+def _mm(a: jax.Array, b: jax.Array, fast: bool) -> jax.Array:
+    """Matmul at the Lloyd-loop precision. `fast` = one-pass bf16 on the MXU
+    with f32 accumulation (explicit casts, so CPU tests see the same rounding).
+
+    Measured at the protocol shape (1M×3k, k=1000, v5e): in-loop bf16 drops
+    331→208 ms/iter while the TRUE inertia (recomputed at 3-pass-bf16 "f32"
+    precision with the final centers) agrees to 7e-6 relative — assignment
+    flips only for near-tied rows, which contribute equally either way. The
+    reported inertia is always evaluated at high precision (see kmeans_fit)."""
+    if fast:
+        return jax.lax.dot(
+            a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        ).astype(a.dtype)
+    return a @ b
+
+
 def _tile_assign_accumulate(
-    Xl: jax.Array, wl: jax.Array, centers: jax.Array, batch_rows: int
+    Xl: jax.Array, wl: jax.Array, centers: jax.Array, batch_rows: int,
+    fast: bool = False, spmd: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Scan one device's rows in tiles; returns (sums [k,d], counts [k], inertia).
 
@@ -42,12 +61,12 @@ def _tile_assign_accumulate(
         sums, counts, inertia = carry
         xb, wb = xw
         # ||x-c||² = ||x||² - 2 x·c + ||c||²; the x·cᵀ term is the MXU matmul
-        xc = xb @ centers.T  # [b, k]
+        xc = _mm(xb, centers.T, fast)  # [b, k]
         d2 = c_sq[None, :] - 2.0 * xc
         assign = jnp.argmin(d2, axis=1)  # [b]
         min_d2 = jnp.min(d2, axis=1) + jnp.sum(xb * xb, axis=1)
         oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]  # [b, k]
-        sums = sums + oh.T @ xb  # [k, d] — MXU again
+        sums = sums + _mm(oh.T, xb, fast)  # [k, d] — MXU again
         counts = counts + jnp.sum(oh, axis=0)
         inertia = inertia + jnp.sum(jnp.maximum(min_d2, 0.0) * wb)
         return (sums, counts, inertia), None
@@ -57,9 +76,11 @@ def _tile_assign_accumulate(
         jnp.zeros((k,), Xl.dtype),
         jnp.zeros((), Xl.dtype),
     )
-    # carry must be typed as varying over the mesh axis to match the
-    # per-shard accumulators (JAX shard_map vma typing)
-    init = jax.tree.map(lambda t: jax.lax.pcast(t, ROWS_AXIS, to="varying"), init)
+    if spmd:
+        # carry must be typed as varying over the mesh axis to match the
+        # per-shard accumulators (JAX shard_map vma typing); the meshless
+        # 1-device program (_lloyd_step_fused_1dev) has no axis to cast over
+        init = jax.tree.map(lambda t: jax.lax.pcast(t, ROWS_AXIS, to="varying"), init)
     batch_rows = min(batch_rows, nl)
     n_full = (nl // batch_rows) * batch_rows
 
@@ -86,8 +107,8 @@ def _finish_centers(sums, counts, inertia, centers):
 _finish_centers_jit = jax.jit(_finish_centers)
 
 
-@partial(jax.jit, static_argnames=("mesh", "batch_rows"))
-def _lloyd_step(X, w, centers, *, mesh, batch_rows):
+@partial(jax.jit, static_argnames=("mesh", "batch_rows", "fast"))
+def _lloyd_step(X, w, centers, *, mesh, batch_rows, fast=False):
     """One Lloyd iteration as a TOP-LEVEL XLA program: per-shard tiled
     assignment + accumulation, psum'd (k,d) sums/counts/inertia, center update.
 
@@ -99,7 +120,7 @@ def _lloyd_step(X, w, centers, *, mesh, batch_rows):
     replicated global value so every SPMD rank steps identically."""
 
     def local(Xl, wl):
-        sums, counts, inertia = _tile_assign_accumulate(Xl, wl, centers, batch_rows)
+        sums, counts, inertia = _tile_assign_accumulate(Xl, wl, centers, batch_rows, fast)
         sums = jax.lax.psum(sums, ROWS_AXIS)
         counts = jax.lax.psum(counts, ROWS_AXIS)
         inertia = jax.lax.psum(inertia, ROWS_AXIS)
@@ -114,8 +135,24 @@ def _lloyd_step(X, w, centers, *, mesh, batch_rows):
     return _finish_centers(sums, counts, inertia, centers)
 
 
-@partial(jax.jit, static_argnames=("size",), donate_argnums=(3, 4, 5))
-def _tile_accum_1dev(X, w, centers, sums, counts, inertia, start, *, size):
+@partial(jax.jit, static_argnames=("batch_rows", "fast"))
+def _lloyd_step_fused_1dev(X, w, centers, *, batch_rows, fast=False):
+    """One Lloyd iteration as ONE local program (no mesh, no collectives):
+    the in-program tile scan of `_tile_assign_accumulate` plus the center
+    update. This is the small-dataset single-device path — it must NOT touch
+    a Mesh: under multi-process SPMD a 1-device `get_mesh(1)` holds GLOBAL
+    device 0, which other ranks cannot address, while per-rank local fits
+    (e.g. each rank's ANN coarse quantizer) run on the rank's own default
+    device. The in-program scan may double-buffer X (see _tile_accum_1dev) —
+    affordable below _ONE_DISPATCH_MAX_BYTES, where this path is used."""
+    sums, counts, inertia = _tile_assign_accumulate(
+        X, w, centers, batch_rows, fast, spmd=False
+    )
+    return _finish_centers(sums, counts, inertia, centers)
+
+
+@partial(jax.jit, static_argnames=("size", "fast"), donate_argnums=(3, 4, 5))
+def _tile_accum_1dev(X, w, centers, sums, counts, inertia, start, *, size, fast=False):
     """Single-device tile accumulation: dynamic_slice at the PROGRAM TOP LEVEL
     (no in-program loop over X at all). XLA's choice to duplicate a loop-
     consumed operand is size-dependent — at the 1M x 3k benchmark shape even
@@ -126,19 +163,19 @@ def _tile_accum_1dev(X, w, centers, sums, counts, inertia, start, *, size):
     wb = jax.lax.dynamic_slice_in_dim(w, start, size, 0)
     k = centers.shape[0]
     c_sq = jnp.sum(centers * centers, axis=1)
-    xc = xb @ centers.T
+    xc = _mm(xb, centers.T, fast)
     d2 = c_sq[None, :] - 2.0 * xc
     assign = jnp.argmin(d2, axis=1)
     min_d2 = jnp.min(d2, axis=1) + jnp.sum(xb * xb, axis=1)
     oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
     return (
-        sums + oh.T @ xb,
+        sums + _mm(oh.T, xb, fast),
         counts + jnp.sum(oh, axis=0),
         inertia + jnp.sum(jnp.maximum(min_d2, 0.0) * wb),
     )
 
 
-def _lloyd_step_1dev(X, w, centers, batch_rows):
+def _lloyd_step_1dev(X, w, centers, batch_rows, fast=False):
     """Host-tiled Lloyd iteration for a 1-device mesh (see _tile_accum_1dev)."""
     import numpy as np
 
@@ -152,13 +189,29 @@ def _lloyd_step_1dev(X, w, centers, batch_rows):
     n_full = (n // batch_rows) * batch_rows
     for start in range(0, n_full, batch_rows):
         sums, counts, inertia = _tile_accum_1dev(
-            X, w, centers, sums, counts, inertia, np.int32(start), size=batch_rows
+            X, w, centers, sums, counts, inertia, np.int32(start),
+            size=batch_rows, fast=fast,
         )
     if n - n_full:
         sums, counts, inertia = _tile_accum_1dev(
-            X, w, centers, sums, counts, inertia, np.int32(n_full), size=n - n_full
+            X, w, centers, sums, counts, inertia, np.int32(n_full),
+            size=n - n_full, fast=fast,
         )
     return _finish_centers_jit(sums, counts, inertia, centers)
+
+
+# Below this size a 1-device fit takes the SAME one-dispatch-per-iteration
+# program as the mesh path (fori_loop of tiles inside `_lloyd_step` over a
+# 1-device mesh). The host-tiled `_lloyd_step_1dev` exists to keep the big-X
+# regime single-buffered (XLA copies a loop-consumed X at the 1M×3k protocol
+# shape), but it costs one dispatch PER TILE — through a remote PJRT tunnel
+# (~140ms/dispatch) that dominated medium datasets (measured: 45s for the ANN
+# coarse quantizer's 500k×512 k=1024 training vs ~3s with per-iteration
+# dispatch; the in-program X copy is affordable below this cap). A fully
+# fused while_loop-of-iterations variant was tried and is PATHOLOGICAL on
+# the axon backend (~80s at the same shape) — keep the iteration loop on the
+# host.
+_ONE_DISPATCH_MAX_BYTES = 2 << 30
 
 
 def kmeans_fit(
@@ -170,6 +223,8 @@ def kmeans_fit(
     max_iter: int = 20,
     tol: float = 1e-4,
     batch_rows: int = 32768,
+    precision_mode: str = "fast",
+    final_inertia: bool = True,
 ) -> Dict[str, jax.Array]:
     """Lloyd's algorithm on a row-sharded global X. Returns
     cluster_centers_ [k,d], inertia_, n_iter_.
@@ -177,26 +232,42 @@ def kmeans_fit(
     Convergence: squared center movement <= tol (sklearn/cuML semantics; the
     reference maps Spark's `tol` straight through, clustering.py:96-108).
     Host-stepped loop of jitted `_lloyd_step` programs — see the step's
-    docstring for why the loop is not a `lax.while_loop`."""
+    docstring for why the loop is not a `lax.while_loop`. Small single-device
+    datasets take the fused one-program path instead (_lloyd_fit_fused).
+
+    precision_mode: "fast" (default for f32) runs the IN-LOOP distance and
+    center-update matmuls in one-pass bf16 (see _mm — 1.6× per iteration at
+    the protocol shape, true inertia agrees to ~1e-5); "high" keeps the
+    ambient (3-pass-bf16 "f32") precision everywhere. f64 inputs always run
+    "high". The final reported inertia is high-precision in both modes."""
     centers = jnp.asarray(init_centers)
+    fast = precision_mode == "fast" and X.dtype == jnp.float32
     inertia = jnp.zeros((), X.dtype)
     n_iter = 0
+    one_dev = mesh.devices.size == 1
+    host_tiled = one_dev and X.size * X.dtype.itemsize > _ONE_DISPATCH_MAX_BYTES
 
-    def step(c):
-        if mesh.devices.size == 1:
-            return _lloyd_step_1dev(X, w, c, batch_rows)
-        return _lloyd_step(X, w, c, mesh=mesh, batch_rows=batch_rows)
+    def step(c, f):
+        if host_tiled:
+            return _lloyd_step_1dev(X, w, c, batch_rows, fast=f)
+        if one_dev:  # meshless local program (see _lloyd_step_fused_1dev)
+            return _lloyd_step_fused_1dev(X, w, c, batch_rows=batch_rows, fast=f)
+        return _lloyd_step(X, w, c, mesh=mesh, batch_rows=batch_rows, fast=f)
 
     for _ in range(max_iter):
-        centers, inertia, shift = step(centers)
+        centers, inertia, shift = step(centers, fast)
         n_iter += 1
         if float(shift) <= tol:
             break
-    # inertia reported is one iteration stale; recompute once with final centers
-    _, final_inertia, _ = step(centers)
+    # inertia reported is one iteration stale; recompute once with final
+    # centers — always at high precision. Callers that don't consume inertia
+    # (e.g. the IVF coarse quantizer) skip the pass: the high-precision
+    # program is a separate ~79s compile in a fresh process.
+    if final_inertia:
+        _, inertia, _ = step(centers, False)
     return {
         "cluster_centers_": centers,
-        "inertia_": final_inertia,
+        "inertia_": inertia,
         "n_iter_": jnp.asarray(n_iter, jnp.int32),
     }
 
@@ -245,6 +316,43 @@ def _min_d2_update(x, cand, min_d2):
     return jnp.minimum(min_d2, jnp.maximum(jnp.min(d2, axis=1), 0.0))
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _kmeanspp_device(x, sw, seed, *, k: int):
+    """Classic k-means++ as ONE device program (fori_loop over the k sequential
+    draws; categorical sampling by inverse-CDF). The host numpy loop this
+    replaces costs ~50 ms per draw at 10k×512 — 51 s for the ANN coarse
+    quantizer's k=1024 reduce; here the whole reduce is a single dispatch."""
+    n, d = x.shape
+    x_sq = jnp.sum(x * x, axis=1)
+
+    def sample(key, probs):
+        c = jnp.cumsum(probs)
+        u = jax.random.uniform(key, dtype=c.dtype) * c[-1]
+        return jnp.clip(jnp.searchsorted(c, u), 0, n - 1)
+
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    i0 = sample(k0, sw)
+    centers0 = jnp.zeros((k, d), x.dtype).at[0].set(x[i0])
+
+    def body(i, carry):
+        centers, closest, key = carry
+        prev = jax.lax.dynamic_slice_in_dim(centers, i - 1, 1, 0)[0]
+        d2 = x_sq - 2.0 * (x @ prev) + jnp.sum(prev * prev)
+        closest = jnp.minimum(closest, jnp.maximum(d2, 0.0))
+        probs = closest * sw
+        s = jnp.sum(probs)
+        probs = jnp.where(s > 0, probs, sw)  # degenerate: all points covered
+        key, kk = jax.random.split(key)
+        idx = sample(kk, probs)
+        return centers.at[i].set(x[idx]), closest, key
+
+    centers, _, _ = jax.lax.fori_loop(
+        1, k, body, (centers0, jnp.full((n,), jnp.inf, x.dtype), key)
+    )
+    return centers
+
+
 def scalable_kmeans_init(x_host, k: int, seed: int, sample_weight=None, rounds: int = 5):
     """k-means|| (Bahmani et al.) seeding — the reference's
     'scalable-k-means++' (cuML KMeansMG init). Device-assisted: each round
@@ -263,11 +371,15 @@ def scalable_kmeans_init(x_host, k: int, seed: int, sample_weight=None, rounds: 
     l = max(1, 2 * k)  # oversampling factor per round
 
     xd = jax.device_put(x)
-    first = x[rng.choice(n_sub, p=sw / sw.sum())][None, :]
-    cand_list = [first]
-    min_d2 = np.asarray(_min_d2_update(xd, jax.device_put(first), jnp.full((n_sub,), np.inf, jnp.float32)))
+    # every candidate block is PADDED to exactly l rows (repeating one row —
+    # duplicates never change a running min-distance): all `_min_d2_update`
+    # calls then share ONE compiled shape instead of one compile per block
+    # size (a fresh compile through a remote PJRT tunnel costs ~20-40s).
+    first = np.broadcast_to(x[rng.choice(n_sub, p=sw / sw.sum())], (l, x.shape[1]))
+    cand_list = [np.ascontiguousarray(first)]
+    min_d2 = _min_d2_update(xd, jax.device_put(cand_list[0]), jnp.full((n_sub,), np.inf, jnp.float32))
     for _ in range(rounds):
-        probs = np.maximum(min_d2, 0.0) * sw
+        probs = np.maximum(np.asarray(min_d2), 0.0) * sw
         s = probs.sum()
         # without-replacement sampling needs enough nonzero-probability rows
         n_new = min(l, n_sub, int(np.count_nonzero(probs)))
@@ -275,14 +387,23 @@ def scalable_kmeans_init(x_host, k: int, seed: int, sample_weight=None, rounds: 
             break
         new_idx = rng.choice(n_sub, size=n_new, replace=False, p=probs / s)
         new = x[np.sort(new_idx)]
+        if n_new < l:  # pad to the fixed block shape
+            new = np.concatenate([new, np.broadcast_to(new[0], (l - n_new, new.shape[1]))])
         cand_list.append(new)
-        min_d2 = np.asarray(_min_d2_update(xd, jax.device_put(new), jnp.asarray(min_d2)))
+        min_d2 = _min_d2_update(xd, jax.device_put(new), min_d2)
     cand = np.concatenate(cand_list, axis=0)
-    # weight candidates by how many points they own (one assignment pass)
+    # weight candidates by how many points they own (one assignment pass);
+    # duplicate (padding) rows lose every argmin tie, so they get weight 0
     assign = np.asarray(_assign_nearest(xd, jax.device_put(cand)))
-    weights = np.bincount(assign, weights=sw, minlength=len(cand)).astype(np.float64)
-    # reduce the small weighted candidate set to k with classic k-means++
-    return kmeans_plus_plus_init(cand.astype(np.float64), k, seed + 1, weights)
+    weights = np.bincount(assign, weights=sw, minlength=len(cand)).astype(np.float32)
+    # reduce the small weighted candidate set to k with k-means++ ON DEVICE
+    # (one dispatch; the host loop costs ~50s at the ANN build's k=1024)
+    centers = _kmeanspp_device(
+        jax.device_put(cand.astype(np.float32)),
+        jax.device_put(np.maximum(weights, 1e-12)),
+        seed + 1, k=k,
+    )
+    return np.asarray(centers, dtype=np.float64)
 
 
 def kmeans_plus_plus_init(x_host, k: int, seed: int, sample_weight=None):

@@ -269,15 +269,20 @@ def _coarse_quantizer(x, n_lists: int, seed: int, kmeans_iters: int = 10):
     n_lists = min(n_lists, n)
     init = scalable_kmeans_init if n_lists >= 64 else kmeans_plus_plus_init
     centers0 = init(x, n_lists, seed).astype(np.float32)
+    # ONE h2d transfer of x, reused for training and assignment; no final
+    # high-precision inertia pass (nothing consumes it, and its program is a
+    # separate ~79s compile in a fresh process)
+    xd = jax.device_put(x)
     state = kmeans_fit(
-        jax.device_put(x), jnp.ones((n,), jnp.float32), jax.device_put(centers0),
-        mesh=get_mesh(1), max_iter=kmeans_iters, tol=1e-6,
+        xd, jnp.ones((n,), jnp.float32), jax.device_put(centers0),
+        mesh=get_mesh(1), max_iter=kmeans_iters, tol=1e-6, final_inertia=False,
     )
-    centroids = np.asarray(state["cluster_centers_"])
+    centroids_dev = state["cluster_centers_"]
+    centroids = np.asarray(centroids_dev)
     assign = np.asarray(
         jax.jit(lambda X, C: jnp.argmin(
             jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1
-        ))(jax.device_put(x), jax.device_put(centroids))
+        ))(xd, centroids_dev)
     )
     counts = np.bincount(assign, minlength=n_lists)
     L = max(1, int(counts.max()))
@@ -301,7 +306,7 @@ def build_ivfpq(
     """
     import numpy as np
 
-    from .kmeans import kmeans_fit, kmeans_plus_plus_init
+    from .kmeans import _kmeanspp_device, kmeans_fit
     from ..parallel.mesh import get_mesh
 
     x, centroids, assign, sorted_assign, order, offsets, n_lists, L = _coarse_quantizer(
@@ -320,12 +325,16 @@ def build_ivfpq(
     codebooks = np.zeros((M, K, dsub), np.float32)
     mesh1 = get_mesh(1)
     for m in range(M):
-        sub = train[:, m * dsub : (m + 1) * dsub]
-        k_eff = min(K, len(sub))
-        c0 = kmeans_plus_plus_init(sub, k_eff, seed + m).astype(np.float32)
+        # ONE h2d transfer of the sub-block, shared by seeding and training
+        sub = jax.device_put(np.ascontiguousarray(train[:, m * dsub : (m + 1) * dsub]))
+        sub_w = jnp.ones((sub.shape[0],), jnp.float32)
+        k_eff = min(K, sub.shape[0])
+        c0 = _kmeanspp_device(  # one dispatch; shared shape across all M
+            sub, sub_w, seed + m, k=k_eff,
+        )
         st = kmeans_fit(
-            jax.device_put(sub), jnp.ones((len(sub),), jnp.float32), jax.device_put(c0),
-            mesh=mesh1, max_iter=pq_iters, tol=1e-6,
+            sub, sub_w, c0,
+            mesh=mesh1, max_iter=pq_iters, tol=1e-6, final_inertia=False,
         )
         codebooks[m, :k_eff] = np.asarray(st["cluster_centers_"])
         if k_eff < K:  # degenerate tiny datasets: repeat the first centroid

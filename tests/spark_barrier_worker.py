@@ -1,0 +1,94 @@
+#
+# Worker for the simulated Spark barrier-stage test (spawned by
+# tests/test_spark.py; no `test_` prefix so pytest doesn't collect it).
+#
+# Fidelity target: the reference runs its fit inside barrier-stage tasks and
+# builds its communicator from `BarrierTaskContext` (reference
+# core.py:698-797, cuml_context.py:80-103). This worker reproduces that wiring
+# exactly — the framework sees ONLY a `BarrierTaskContext`-shaped object
+# (partitionId / getTaskInfos / allGather) wrapped in `BarrierRendezvous`; the
+# allGather itself is genuinely cross-process and blocking (file-backed), so
+# rank skew, ordering and payload-size behavior match a real barrier stage,
+# unlike an in-process stub.
+#
+import os
+import sys
+
+
+class _TaskInfo:
+    def __init__(self, address: str) -> None:
+        self.address = address
+
+
+class FileBackedBarrierTaskContext:
+    """`pyspark.BarrierTaskContext` duck-type whose allGather really blocks
+    across OS processes. Only the surface the framework consumes exists."""
+
+    def __init__(self, rank: int, nranks: int, root: str, run_id: str) -> None:
+        from spark_rapids_ml_tpu.parallel import FileRendezvous
+
+        self._rank = rank
+        self._nranks = nranks
+        self._rdv = FileRendezvous(
+            rank, nranks, root, timeout_s=120.0, run_id=run_id
+        )
+
+    def partitionId(self) -> int:
+        return self._rank
+
+    def getTaskInfos(self):
+        return [_TaskInfo(f"127.0.0.1:{5000 + i}") for i in range(self._nranks)]
+
+    def allGather(self, message: str = ""):
+        return self._rdv.allgather(message)
+
+    def barrier(self) -> None:
+        self._rdv.allgather("")
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nranks = int(sys.argv[2])
+    rdv_dir = sys.argv[3]
+    out_dir = sys.argv[4]
+    run_id = sys.argv[5]
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.parallel import BarrierRendezvous, TpuContext
+
+    from tests.mp_worker import make_dataset, split_bounds
+
+    X, y_log, _ = make_dataset()
+    bounds = split_bounds(len(X), nranks)
+    lo, hi = bounds[rank], bounds[rank + 1]
+    df = pd.DataFrame({"features": list(X[lo:hi]), "label": y_log[lo:hi]})
+
+    # the task body the reference runs inside each barrier task: wrap the
+    # context, build the communicator, fit
+    ctx = FileBackedBarrierTaskContext(rank, nranks, rdv_dir, run_id)
+    rdv = BarrierRendezvous(ctx)
+    assert rdv.rank == rank and rdv.nranks == nranks
+    with TpuContext(rdv.rank, rdv.nranks, rdv, require_distributed=True):
+        pca = PCA(k=3, inputCol="features", float32_inputs=False).fit(df)
+        lr = (
+            LogisticRegression(maxIter=100, regParam=0.1, tol=1e-10, float32_inputs=False)
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+
+    np.savez(
+        os.path.join(out_dir, f"rank_{rank}.npz"),
+        pc=np.asarray(pca.pc),
+        mean=np.asarray(pca.mean),
+        coef=np.asarray(lr.coefficients),
+        intercept=np.asarray([lr.intercept]),
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

@@ -97,3 +97,73 @@ def test_gen_device_matches_spec(mesh8):
     X2, y, _ = gen_classification_device(800, 16, n_classes=3, mesh=mesh8, tile=256)
     assert set(np.unique(np.asarray(y))) <= {0, 1, 2}
     assert len(np.unique(np.asarray(y))) == 3
+
+
+def test_parquet_dataset_roundtrip(tmp_path):
+    # the reference protocol's multi-file parquet layout: write N part files,
+    # read them back bit-exact (benchmark/dataset_io.py)
+    from benchmark.dataset_io import read_parquet_dataset, write_parquet_dataset
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(257, 9)).astype(np.float32)
+    y = rng.normal(size=257)
+    path = str(tmp_path / "ds")
+    n_files = write_parquet_dataset(path, X, y, n_files=7)
+    assert n_files == 7
+    assert len(os.listdir(path)) == 7
+    X2, y2 = read_parquet_dataset(path)
+    np.testing.assert_array_equal(X2, X)
+    np.testing.assert_allclose(y2, y)
+    # no label
+    path2 = str(tmp_path / "ds2")
+    write_parquet_dataset(path2, X, None, n_files=3)
+    X3, y3 = read_parquet_dataset(path2)
+    np.testing.assert_array_equal(X3, X)
+    assert y3 is None
+
+
+def test_benchmark_dataset_path_lane(tmp_path):
+    # benches consume --dataset_path (shared parquet) instead of generating
+    from benchmark.dataset_io import write_parquet_dataset
+    from benchmark.gen_data import gen_classification_host
+
+    X, y = gen_classification_host(1500, 12, 2, 0)
+    path = str(tmp_path / "clf")
+    write_parquet_dataset(path, X, y, n_files=4)
+    row = ALGORITHMS["logistic_regression"]().run(
+        ["--dataset_path", path, "--maxIter", "10"]
+    )
+    assert row["num_rows"] == 1500 and row["num_cols"] == 12
+    assert row["accuracy"] > 0.8
+
+
+def test_benchmark_cpu_comparison_arm(tmp_path):
+    # the accelerated-vs-CPU arm (reference base.py:50-61): sklearn fit runs
+    # on the SAME host rows and the report carries cpu_fit_sec + speedup
+    row = ALGORITHMS["pca"]().run(SMOKE["pca"] + ["--cpu_comparison"])
+    assert row["cpu_fit_sec"] > 0
+    assert "speedup_vs_cpu" in row
+    row = ALGORITHMS["kmeans"]().run(SMOKE["kmeans"] + ["--cpu_comparison"])
+    assert row["cpu_fit_sec"] > 0
+
+
+def test_gen_data_cli_parquet(tmp_path):
+    from benchmark.gen_data import main as gen_main
+
+    out = str(tmp_path / "pq")
+    gen_main(["regression", "--num_rows", "300", "--num_cols", "6",
+              "--output", out, "--fmt", "parquet", "--n_files", "5"])
+    from benchmark.dataset_io import read_parquet_dataset
+
+    X, y = read_parquet_dataset(out)
+    assert X.shape == (300, 6) and y is not None and len(y) == 300
+
+
+def test_benchmark_cagra_smoke(tmp_path):
+    row = ALGORITHMS["approximate_nearest_neighbors"]().run(
+        ["--num_rows", "1200", "--num_cols", "16", "--k", "8",
+         "--num_queries", "64", "--algorithm", "cagra",
+         "--graph_degree", "24", "--intermediate_graph_degree", "32"]
+    )
+    assert row["recall"] >= 0.8
+    assert row["build_sec"] > 0 and row["search_sec"] > 0

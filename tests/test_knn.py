@@ -250,3 +250,80 @@ def test_knn_empty_query_model_join(rng):
     joined0 = nn.exactNearestNeighborsJoin(empty_q)
     assert len(joined0) == 0
     assert list(joined0.columns) == list(joined.columns)
+
+
+def test_cagra_recall_and_estimator(rng):
+    # CAGRA graph ANN (reference knn.py:902-935, 1452-1481): NN-descent build
+    # + greedy graph search must recover most true neighbors
+    item_df, query_df, items, queries = _item_query(rng, n_items=800, n_queries=40, d=16)
+    ann = (
+        ApproximateNearestNeighbors(
+            k=8,
+            algorithm="cagra",
+            algoParams={
+                "build_algo": "nn_descent",
+                "graph_degree": 32,
+                "intermediate_graph_degree": 48,
+                "itopk_size": 64,
+            },
+        )
+        .setInputCol("features")
+        .setIdCol("id")
+    )
+    model = ann.fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    _, sk_idx = _sk_knn(items, queries, 8)
+    ours = np.stack(knn_df["indices"].to_list())
+    dist = np.stack(knn_df["distances"].to_list())
+    recall = np.mean([len(set(a) & set(b)) / 8.0 for a, b in zip(ours, sk_idx)])
+    assert recall >= 0.85, recall
+    # euclidean distances, ascending per row
+    assert (np.diff(dist, axis=1) >= -1e-6).all()
+    sk_dist, _ = _sk_knn(items, queries, 8)
+    # the nearest neighbor found must score its TRUE euclidean distance
+    assert np.all(dist[:, 0] >= sk_dist[:, 0] - 1e-5)
+
+
+def test_cagra_ivfpq_seeded_build(rng):
+    # default build_algo="ivf_pq" seeds NN-descent from coarse-quantizer lists
+    item_df, query_df, items, queries = _item_query(rng, n_items=600, n_queries=25, d=8)
+    ann = (
+        ApproximateNearestNeighbors(k=5, algorithm="cagra")
+        .setInputCol("features")
+        .setIdCol("id")
+    )
+    model = ann.fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    _, sk_idx = _sk_knn(items, queries, 5)
+    ours = np.stack(knn_df["indices"].to_list())
+    recall = np.mean([len(set(a) & set(b)) / 5.0 for a, b in zip(ours, sk_idx)])
+    assert recall >= 0.85, recall
+
+
+def test_cagra_param_validation(rng):
+    # itopk_size is rounded up to a multiple of 32 and must cover k
+    # (reference knn.py:1286-1297)
+    item_df, *_ = _item_query(rng, n_items=100, n_queries=4, d=4)
+    ann = (
+        ApproximateNearestNeighbors(
+            k=40, algorithm="cagra", algoParams={"itopk_size": 1}
+        )
+        .setInputCol("features")
+        .setIdCol("id")
+    )
+    with pytest.raises(ValueError, match="itopk_size"):
+        ann.fit(item_df)
+    # itopk 33 -> internal 64 >= k=40: accepted
+    ApproximateNearestNeighbors(
+        k=40, algorithm="cagra", algoParams={"itopk_size": 33}
+    ).setInputCol("features").setIdCol("id").fit(item_df)
+    with pytest.raises(ValueError, match="compression"):
+        ApproximateNearestNeighbors(
+            k=4, algorithm="cagra", algoParams={"compression": {}}
+        )
+    with pytest.raises(ValueError, match="not supported"):
+        ApproximateNearestNeighbors(k=4, algorithm="hnsw")
+    with pytest.raises(ValueError, match="build_algo"):
+        ApproximateNearestNeighbors(
+            k=4, algorithm="cagra", algoParams={"build_algo": "bogus"}
+        ).setInputCol("features").setIdCol("id").fit(item_df)

@@ -1,0 +1,98 @@
+#
+# Large-scale sparse LogisticRegression (the reference's tests_large lane:
+# tests_large/test_large_logistic_regression.py:16-23 fits 1e7 x 2200 sparse
+# vectors at ~0.1% density). Nightly-gated with --runslow; run via
+# `ci/test.sh --nightly`.
+#
+# Exercises the padded-ELL design (ops/sparse.py) at its design point: at
+# 0.1% density the per-row nnz is Poisson(2.2), so k_max lands in the tens —
+# the ELL tensor is ~n * k_max * 8 bytes (~1-2 GB at 1e7 rows), orders of
+# magnitude below the 88 GB dense equivalent. Checked against sklearn fit on
+# a row subsample: holdout accuracy must match and the coefficient supports
+# must correlate.
+#
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+N_ROWS = 10_000_000
+N_COLS = 2200
+DENSITY = 0.001
+
+
+def _gen_sparse_classification(n, d, density, seed=0):
+    import scipy.sparse as sp
+
+    rs = np.random.RandomState(seed)
+    x = sp.random(n, d, density=density, random_state=rs, format="csr", dtype=np.float32)
+    rng = np.random.default_rng(seed)
+    coef = np.zeros(d, dtype=np.float64)
+    nz = rng.choice(d, size=d // 10, replace=False)
+    coef[nz] = rng.normal(scale=4.0, size=len(nz))
+    logits = np.asarray(x @ coef) + 0.25 * rng.normal(size=n)
+    y = (logits > 0).astype(np.float32)
+    return x, y, coef
+
+
+def test_large_sparse_logistic_regression():
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.logistic import logistic_fit_ell
+    from spark_rapids_ml_tpu.ops.sparse import csr_to_ell, ell_matmul
+
+    x, y, coef_true = _gen_sparse_classification(N_ROWS, N_COLS, DENSITY)
+
+    indices, values, k_max = csr_to_ell(x, dtype=np.float32)
+    # the ELL design point this test certifies: ~0.1% density => k_max in the
+    # tens, memory ~ n*k_max*8 bytes (documented in ops/sparse.py:20-24)
+    assert k_max <= 64, f"k_max {k_max} blows the padded-ELL budget"
+    ell_bytes = values.nbytes + indices.nbytes
+    assert ell_bytes < 6e9, f"ELL tensor {ell_bytes/1e9:.1f} GB"
+
+    state = logistic_fit_ell(
+        jax.device_put(values), jax.device_put(indices),
+        jax.device_put(y.astype(np.int32)),
+        jnp.ones((N_ROWS,), jnp.float32),
+        d=N_COLS, k=2, multinomial=False,
+        lam_l2=1e-6, fit_intercept=True, standardize=False,
+        max_iter=60, tol=1e-12,
+    )
+    coef = np.asarray(state["coef_"], dtype=np.float64).ravel()
+    intercept = float(np.asarray(state["intercept_"]).ravel()[0])
+
+    # holdout scoring through the same ELL matmul (first 200k rows)
+    n_h = 200_000
+    zh = np.asarray(
+        ell_matmul(
+            jax.device_put(values[:n_h]),
+            jax.device_put(indices[:n_h]),
+            jax.device_put(coef.astype(np.float32)[:, None]),
+        )
+    ).ravel() + intercept  # ell_matmul takes (values, indices, B)
+    acc_ours = float(((zh > 0) == (y[:n_h] > 0)).mean())
+
+    # sklearn arm on a 500k-row subsample (the reference checks its large fit
+    # against smaller-scale reference results the same way)
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    n_sub = 500_000
+    sk = SkLR(C=1.0 / (n_sub * 1e-6), max_iter=200, tol=1e-10)
+    sk.fit(x[:n_sub], y[:n_sub])
+    zs = np.asarray(x[:n_h] @ sk.coef_.ravel()) + float(sk.intercept_[0])
+    acc_sk = float(((zs > 0) == (y[:n_h] > 0)).mean())
+
+    assert acc_ours >= 0.9, acc_ours
+    assert acc_ours >= acc_sk - 0.01, (acc_ours, acc_sk)
+    # coefficient agreement in direction (full-data fit vs subsample fit)
+    cos = float(
+        coef @ sk.coef_.ravel()
+        / max(np.linalg.norm(coef) * np.linalg.norm(sk.coef_), 1e-30)
+    )
+    assert cos >= 0.97, cos
+    # the true support should carry the signal
+    cos_true = float(
+        coef @ coef_true / max(np.linalg.norm(coef) * np.linalg.norm(coef_true), 1e-30)
+    )
+    assert cos_true >= 0.9, cos_true

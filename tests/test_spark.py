@@ -1,0 +1,147 @@
+#
+# Spark barrier-stage integration lane (reference core.py:698-797 runs every
+# fit inside `mapInPandas(...).rdd.barrier()` tasks; its communicator is built
+# from `BarrierTaskContext` — cuml_context.py:80-103, conftest.py:44-70).
+#
+# Two lanes:
+#   * test_simulated_barrier_stage_fit — ALWAYS runs: N real OS processes,
+#     each wrapping a `BarrierTaskContext`-shaped object (cross-process
+#     file-backed allGather) in BarrierRendezvous + TpuContext — the exact
+#     production wiring for a Spark task body, minus the JVM.
+#   * test_pyspark_barrier_stage_fit — runs when pyspark is importable
+#     (`ci/test.sh --spark`); skipped otherwise since this image ships no
+#     pyspark. Drives the same fit from inside a REAL local[N] barrier stage.
+#
+import os
+import subprocess
+import sys
+import uuid
+
+import numpy as np
+import pandas as pd
+import pytest
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+NRANKS = 3
+
+
+def _reference_models():
+    from tests.mp_worker import make_dataset
+
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    X, y_log, _ = make_dataset()
+    df = pd.DataFrame({"features": list(X), "label": y_log})
+    pca = PCA(k=3, inputCol="features", float32_inputs=False).fit(df)
+    lr = (
+        LogisticRegression(maxIter=100, regParam=0.1, tol=1e-10, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    return pca, lr
+
+
+def test_simulated_barrier_stage_fit(tmp_path):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_ENABLE_X64"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rdv_dir = str(tmp_path / "rdv")
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir, exist_ok=True)
+    run_id = uuid.uuid4().hex
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "spark_barrier_worker.py"),
+             str(r), str(NRANKS), rdv_dir, out_dir, run_id],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(NRANKS)
+    ]
+    outputs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+
+    pca_ref, lr_ref = _reference_models()
+    results = [
+        np.load(os.path.join(out_dir, f"rank_{r}.npz")) for r in range(NRANKS)
+    ]
+    for r, res in enumerate(results):
+        # every rank must hold the SAME global model, equal to the
+        # single-process fit on the concatenated data
+        np.testing.assert_allclose(res["pc"], np.asarray(pca_ref.pc), rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(res["mean"], np.asarray(pca_ref.mean), rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(
+            res["coef"], np.asarray(lr_ref.coefficients), rtol=1e-6, atol=1e-8
+        )
+        np.testing.assert_allclose(
+            res["intercept"], [lr_ref.intercept], rtol=1e-6, atol=1e-8
+        )
+
+
+def _spark_train_body(it):
+    """Barrier-task body: the reference's train UDF shape (core.py:698-797) —
+    get the BarrierTaskContext, wrap it, build the communicator, fit, emit
+    rank 0's model."""
+    from pyspark import BarrierTaskContext
+
+    rows = list(it)
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.parallel import BarrierRendezvous, TpuContext
+
+    ctx = BarrierTaskContext.get()
+    rdv = BarrierRendezvous(ctx)
+    feats = np.asarray([r["features"] for r in rows], dtype=np.float64)
+    df = pd.DataFrame({"features": list(feats)})
+    with TpuContext(rdv.rank, rdv.nranks, rdv, require_distributed=True):
+        pca = PCA(k=3, inputCol="features", float32_inputs=False).fit(df)
+    if rdv.rank == 0:
+        yield {
+            "pc": np.asarray(pca.pc).ravel().tolist(),
+            "mean": np.asarray(pca.mean).tolist(),
+        }
+
+
+def test_pyspark_barrier_stage_fit(tmp_path):
+    pyspark = pytest.importorskip("pyspark")
+    from pyspark.sql import SparkSession
+
+    from tests.mp_worker import make_dataset, split_bounds
+
+    spark = (
+        SparkSession.builder.master(f"local[{NRANKS}]")
+        .appName("srml-tpu-barrier-it")
+        .config("spark.default.parallelism", str(NRANKS))
+        .config("spark.python.worker.reuse", "false")
+        .getOrCreate()
+    )
+    try:
+        X, _, _ = make_dataset()
+        bounds = split_bounds(len(X), NRANKS)
+        rows = [
+            {"part": r, "features": X[i].tolist()}
+            for r in range(NRANKS)
+            for i in range(bounds[r], bounds[r + 1])
+        ]
+        rdd = (
+            spark.sparkContext.parallelize(rows, NRANKS)
+            .barrier()
+            .mapPartitions(_spark_train_body)
+        )
+        out = rdd.collect()
+        assert len(out) == 1  # one model row, from rank 0
+        pca_ref, _ = _reference_models()
+        got_pc = np.asarray(out[0]["pc"]).reshape(np.asarray(pca_ref.pc).shape)
+        np.testing.assert_allclose(got_pc, np.asarray(pca_ref.pc), rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(out[0]["mean"]), np.asarray(pca_ref.mean), rtol=1e-6, atol=1e-8
+        )
+    finally:
+        spark.stop()
