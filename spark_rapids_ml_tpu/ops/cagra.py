@@ -132,6 +132,8 @@ def _descent_round(
     k_int = ids.shape[1]
     n_tiles = -(-n // tile)
 
+    half = min(64, k_int)  # expand each source's TOP-half list only
+
     def body(ti, carry):
         ids_c, d2_c = carry
         r0 = jnp.minimum(ti * tile, n - tile)
@@ -149,18 +151,34 @@ def _descent_round(
         rev_slots = jax.random.randint(k2, (tile, s_rev), 0, rev.shape[1], jnp.int32)
         rev_src = jnp.clip(jnp.take_along_axis(rev_t, rev_slots, axis=1), 0, n - 1)
         src = jnp.concatenate([top_src, rnd_src, rev_src], axis=1)
-        cand_fwd = ids_c[src].reshape(tile, -1)  # FULL lists of every source
+        cand_fwd = ids_c[src][:, :, :half].reshape(tile, -1)
         cand_rnd = jax.random.randint(k3, (tile, c_rnd), 0, n, jnp.int32)
 
         cand = jnp.concatenate([cand_fwd, rev_t, cand_rnd], axis=1)
+        # drop pads/self, anything already in the row's list, and repeat
+        # proposals within the candidate block (keep the first occurrence) —
+        # all elementwise compare masks; NO sort-based dedup in the hot loop
+        # (XLA row sorts dominated the round: 26-33s/round of 500k x 736-wide
+        # sorts, vs <1s for the masks + approx top-k)
         invalid = (cand < 0) | (cand == rows[:, None])
+        invalid |= jnp.any(cand[:, :, None] == ids_t[:, None, :], axis=2)
+        c_w = cand.shape[1]
+        earlier = jnp.arange(c_w)[None, :] < jnp.arange(c_w)[:, None]  # [C, C]
+        invalid |= jnp.any(
+            (cand[:, :, None] == cand[:, None, :]) & earlier[None], axis=2
+        )
         cand = jnp.clip(cand, 0, n - 1)
         d2_cand = _score_candidates(q_rows, cand, x, x_sq)
         d2_cand = jnp.where(invalid, _SENTINEL_F, d2_cand)
 
+        # merge with approx_min_k (the TPU-native top-k path). In-round
+        # duplicate proposals (same NEW id from two sources) may transiently
+        # occupy two slots; the next round's compare mask stops them from
+        # multiplying, and the final prune keeps k_out << k_int slack.
         all_ids = jnp.concatenate([ids_t, cand], axis=1)
         all_d2 = jnp.concatenate([d2_t, d2_cand], axis=1)
-        new_ids, new_d2 = _merge_dedup_topk(all_ids, all_d2, k_int)
+        new_d2, pos = jax.lax.approx_min_k(all_d2, k_int)
+        new_ids = jnp.take_along_axis(all_ids, pos, axis=1)
         ids_c = jax.lax.dynamic_update_slice(ids_c, new_ids, (r0, 0))
         d2_c = jax.lax.dynamic_update_slice(d2_c, new_d2, (r0, 0))
         return ids_c, d2_c
@@ -245,8 +263,10 @@ def build_cagra(
     cluster_reps: int = 3,
     seed: int = 0,
 ) -> Dict[str, Any]:
-    """Build the CAGRA graph index. Returns {"x": [n,d] f32 (host),
-    "graph": [n, graph_degree] int32 (host)}.
+    """Build the CAGRA graph index. Returns {"x": [n,d] f32,
+    "graph": [n, graph_degree] int32} — both DEVICE-resident jax.Arrays
+    (the search consumes them in HBM; fetch with np.asarray if a host copy
+    is needed).
 
     Parameter names/defaults mirror the reference's cagra IndexParams
     (knn.py:927-931): graph_degree 64, intermediate_graph_degree 128,
@@ -258,8 +278,14 @@ def build_cagra(
     round count per build_algo (8 after cluster seeding, 14 from random —
     measured to reach ~0.9 node-level graph recall at 20k x 64).
     """
-    x = np.ascontiguousarray(np.asarray(x, dtype=np.float32))
-    n, d = x.shape
+    if isinstance(x, jax.Array):
+        # device-resident input (benchmark datagen): no host round trip
+        xd = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    else:
+        xd = jax.device_put(
+            np.ascontiguousarray(np.asarray(x, dtype=np.float32))
+        )
+    n, d = xd.shape
     if build_algo not in ("ivf_pq", "nn_descent"):
         raise ValueError(
             f"build_algo {build_algo!r} not supported (ivf_pq | nn_descent)"
@@ -269,46 +295,57 @@ def build_cagra(
     n_rounds = int(nn_descent_niter) or (8 if build_algo == "ivf_pq" else 14)
 
     rng = np.random.default_rng(seed)
-    xd = jax.device_put(x)
     x_sq = _row_sq(xd)
 
     if build_algo == "ivf_pq" and n > 4 * k_int:
-        # clustered brute-force seeding: target bucket size ~512 rows
-        ids = jnp.zeros((n, k_int), jnp.int32)
-        d2 = jnp.full((n, k_int), _SENTINEL_F)
+        # clustered brute-force seeding: target bucket size ~512 rows.
+        # All reps are merged in ONE sort-dedup pass (each 500k-row sort
+        # merge costs ~8s on a v5e; one wide merge beats three narrow ones)
         anchors_c = max(2, n // 512)
         kk = min(64, k_int, n - 1)
-        for rep in range(max(1, cluster_reps)):
-            rid, rd2 = _cluster_seed_rep(
-                xd, x_sq, n, anchors_c, kk, seed * 1000 + rep
+        reps = [
+            _cluster_seed_rep(xd, x_sq, n, anchors_c, kk, seed * 1000 + rep)
+            for rep in range(max(1, cluster_reps))
+        ]
+        rep_ids = jnp.concatenate([r[0] for r in reps], axis=1)
+        rep_d2 = jnp.concatenate([r[1] for r in reps], axis=1)
+        if rep_ids.shape[1] < k_int:
+            # top-k needs width >= k_int (e.g. large intermediate_graph_degree
+            # with few reps): pad with inf-distance slots
+            pad = k_int - rep_ids.shape[1]
+            rep_ids = jnp.concatenate(
+                [rep_ids, jnp.zeros((n, pad), jnp.int32)], axis=1
             )
-            ids, d2 = _merge_dedup_topk(
-                jnp.concatenate([ids, rid], axis=1),
-                jnp.concatenate([d2, rd2], axis=1),
-                k_int,
+            rep_d2 = jnp.concatenate(
+                [rep_d2, jnp.full((n, pad), _SENTINEL_F)], axis=1
             )
+        ids, d2 = _merge_dedup_topk(rep_ids, rep_d2, k_int)
     else:
         # random init; descent round 0 scores these ids through the
         # candidate channels, so +inf stored distances are correct
         ids = jax.device_put(rng.integers(0, n, size=(n, k_int)).astype(np.int32))
         d2 = jnp.full((n, k_int), _SENTINEL_F)
 
-    # full-list expansion budget: (s_top+s_rnd+s_rev)*k_int + r_max + c_rnd
+    # expansion budget: (s_top+s_rnd+s_rev) * top-64-of-list + r_max + c_rnd
     s_top, s_rnd, s_rev, c_rnd, r_max = 2, 1, 1, 32, 64
-    c_total = (s_top + s_rnd + s_rev) * k_int + r_max + c_rnd
+    c_total = (s_top + s_rnd + s_rev) * min(64, k_int) + r_max + c_rnd
     # tile sized so the [tile, c_total, d] candidate gather stays ~1.5 GB
     tile = int(min(n, max(64, (1_500_000_000 // (c_total * d * 4)) & ~63)))
     tile = max(1, min(tile, n))
     key = jax.random.PRNGKey(seed)
+    rev = None
     for rnd in range(n_rounds):
-        rev = _reverse_edges(ids, r_max=r_max)
+        if rnd % 2 == 0 or rev is None:
+            # refresh reverse edges every OTHER round: the device-wide sort
+            # costs ~3s at 500k x 128 and one-round staleness is harmless
+            rev = _reverse_edges(ids, r_max=r_max)
         ids, d2 = _descent_round(
             xd, x_sq, ids, d2, rev, jax.random.fold_in(key, rnd),
             tile=tile, s_top=s_top, s_rnd=s_rnd, s_rev=s_rev, c_rnd=c_rnd,
         )
-    # prune to the final degree: the K_int list is distance-sorted by top_k
-    graph = np.asarray(ids[:, :k_out])
-    return {"x": x, "graph": graph}
+    # prune to the final degree: the K_int list is distance-sorted by top_k;
+    # both index halves stay ON DEVICE (the search consumes them there)
+    return {"x": xd, "graph": ids[:, :k_out]}
 
 
 @partial(
@@ -344,6 +381,11 @@ def _search_tile(
         expanded = expanded | hit
         cand = graph[sel_ids].reshape(qn, search_width * deg)
         dup = jnp.any(cand[:, :, None] == ids[:, None, :], axis=2)
+        c_w = cand.shape[1]
+        earlier = jnp.arange(c_w)[None, :] < jnp.arange(c_w)[:, None]
+        dup |= jnp.any(
+            (cand[:, :, None] == cand[:, None, :]) & earlier[None], axis=2
+        )
         d2c = _score_candidates(xq, cand, x, x_sq)
         d2c = jnp.where(dup | (cand < 0), _SENTINEL_F, d2c)
         all_ids = jnp.concatenate([ids, cand], axis=1)
@@ -351,7 +393,12 @@ def _search_tile(
         all_exp = jnp.concatenate(
             [expanded, jnp.zeros_like(dup)], axis=1
         )
-        ids, d2, expanded = _merge_dedup_topk(all_ids, all_d2, itopk, all_exp)
+        # approx_min_k: the TPU-native top-k (row sorts here dominate the
+        # whole search otherwise); cand-vs-list dups are masked above, and
+        # rare cand-vs-cand dups cost one wasted expansion at most
+        d2, pos = jax.lax.approx_min_k(all_d2, itopk)
+        ids = jnp.take_along_axis(all_ids, pos, axis=1)
+        expanded = jnp.take_along_axis(all_exp, pos, axis=1)
         return ids, d2, expanded
 
     ids, d2, _ = jax.lax.fori_loop(0, iters, body, (ids, d2, expanded))

@@ -444,3 +444,72 @@ def random_init(x_host, k: int, seed: int):
         raise ValueError(f"k={k} exceeds number of rows {n}")
     idx = rng.choice(n, k, replace=False)
     return np.asarray(x_host[idx], dtype=np.float64)
+
+
+@partial(jax.jit, static_argnames=("l",), donate_argnums=(2,))
+def _kmeanspar_round(xd, cand_prev, min_d2, sw, key, *, l: int):
+    """One k-means|| round fully on device: update min-d² against the
+    previous candidate block, then draw the next `l` candidates WITHOUT
+    replacement with probability ∝ d²·w via Gumbel-top-k (keys
+    log p + Gumbel(0,1); the top-l keys are exactly a weighted
+    without-replacement sample). Returns (new candidate block [l, d],
+    updated min_d2)."""
+    d2 = (
+        jnp.sum(xd * xd, axis=1)[:, None]
+        - 2.0 * xd @ cand_prev.T
+        + jnp.sum(cand_prev * cand_prev, axis=1)[None, :]
+    )
+    min_d2 = jnp.minimum(min_d2, jnp.maximum(jnp.min(d2, axis=1), 0.0))
+    probs = min_d2 * sw
+    total = jnp.sum(probs)
+    # degenerate (all points covered): fall back to uniform-by-weight
+    probs = jnp.where(total > 0, probs, sw)
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(key, (xd.shape[0],), minval=1e-20, maxval=1.0)
+    ))
+    keys = jnp.where(probs > 0, jnp.log(probs) + gumbel, -jnp.inf)
+    _, idx = jax.lax.top_k(keys, l)
+    return xd[idx], min_d2
+
+
+def scalable_kmeans_init_device(
+    xd: jax.Array, k: int, seed: int, sample_weight=None, rounds: int = 5
+) -> jax.Array:
+    """k-means|| seeding with every step device-resident — for data that
+    already lives in HBM (the ANN index builds). No candidate rows, distance
+    vectors or weights ever cross the host boundary: each round is one
+    fused program (_kmeanspar_round), the candidate weighting is a device
+    scatter-add, and the final reduce-to-k is `_kmeanspp_device`. Returns
+    [k, d] f32 centers ON DEVICE.
+
+    Equivalent in distribution to `scalable_kmeans_init` (Bahmani et al.
+    k-means||); the without-replacement sampling uses Gumbel-top-k instead
+    of host `rng.choice`.
+
+    Size bound: the per-round `xd[idx]` candidate gather is the fancy-index
+    pattern XLA may answer with a full temporary copy of xd at very large
+    shapes (see the 1-device KMeans notes) — callers keep xd below a few GB
+    (the ANN index builds, whose per-partition data is well under that)."""
+    n, d = xd.shape
+    l = max(1, min(2 * k, n))  # top_k sample size cannot exceed n
+    sw = (
+        jnp.ones((n,), jnp.float32)
+        if sample_weight is None
+        else jnp.asarray(sample_weight, jnp.float32)
+    )
+    key = jax.random.PRNGKey(seed)
+    k0, key = jax.random.split(key)
+    i0 = jax.random.categorical(k0, jnp.log(jnp.maximum(sw, 1e-30)))
+    cand = jnp.broadcast_to(xd[i0], (l, d))
+    min_d2 = jnp.full((n,), jnp.inf, jnp.float32)
+    blocks = [cand]
+    for r in range(rounds):
+        key, kr = jax.random.split(key)
+        cand, min_d2 = _kmeanspar_round(xd, blocks[-1], min_d2, sw, kr, l=l)
+        blocks.append(cand)
+    cand_all = jnp.concatenate(blocks, axis=0)
+    assign = _assign_nearest(xd, cand_all)
+    weights = jnp.zeros((cand_all.shape[0],), jnp.float32).at[assign].add(sw)
+    return _kmeanspp_device(
+        cand_all, jnp.maximum(weights, 1e-12), seed + 1, k=min(k, cand_all.shape[0])
+    )

@@ -238,58 +238,83 @@ def _exact_knn_sharded(
 
 
 def build_ivfflat(x, n_lists: int, seed: int = 0, kmeans_iters: int = 10):
-    """Build an IVFFlat index on host+device: returns dict with centroids
-    [n_lists, d], buckets [n_lists, L, d], bucket_ids [n_lists, L] (−1 pad).
+    """Build an IVFFlat index: returns dict with centroids [n_lists, d],
+    buckets [n_lists, L, d], bucket_ids [n_lists, L] (−1 pad) — centroids and
+    buckets are DEVICE arrays (the search consumes them in HBM; only the tiny
+    id layout is host-built).
 
-    Bucket fill is vectorized: stable-sort rows by list, compute each row's
-    offset within its list, one fancy-index scatter (no Python loop)."""
+    Bucket fill is one device gather through the host-computed padded id
+    layout — the item matrix itself never crosses back to the host (a 1 GB
+    device→host→device round trip costs minutes through a remote PJRT
+    tunnel)."""
     import numpy as np
 
-    x, centroids, assign, sorted_assign, order, offsets, n_lists, L = _coarse_quantizer(
+    xd, centroids, assign, sorted_assign, order, offsets, n_lists, L = _coarse_quantizer(
         x, n_lists, seed, kmeans_iters
     )
-    n, d = x.shape
-    buckets = np.zeros((n_lists, L, d), np.float32)
+    n, d = xd.shape
     bucket_ids = np.full((n_lists, L), -1, np.int64)
-    buckets[sorted_assign, offsets] = x[order]
     bucket_ids[sorted_assign, offsets] = order
-    return {"centroids": centroids, "buckets": buckets, "bucket_ids": bucket_ids}
+    idsj = jax.device_put(bucket_ids)
+    buckets = _gather_buckets(xd, idsj)
+    return {"centroids": centroids, "buckets": buckets, "bucket_ids": idsj}
+
+
+@jax.jit
+def _gather_buckets(X, I):
+    """Padded bucket layout via one device gather (pad ids −1 -> zero row)."""
+    n = X.shape[0]
+    return jnp.where((I >= 0)[:, :, None], X[jnp.clip(I, 0, n - 1)], 0.0)
 
 
 def _coarse_quantizer(x, n_lists: int, seed: int, kmeans_iters: int = 10):
     """Shared IVF coarse step: KMeans centroids + per-row assignment + the
-    sorted-fill layout (order, offsets, counts, L)."""
+    sorted-fill layout (order, offsets, counts, L).
+
+    Accepts a host array OR a device-resident jax.Array (benchmark datagen
+    produces the latter). Every heavy step — k-means|| seeding, Lloyd
+    iterations, assignment — is device-resident; only the [n] int32
+    assignment vector is fetched for the host-side bucket layout."""
     import numpy as np
 
-    from .kmeans import kmeans_fit, kmeans_plus_plus_init, scalable_kmeans_init
+    from .kmeans import _kmeanspp_device, kmeans_fit, scalable_kmeans_init_device
     from ..parallel.mesh import get_mesh
 
-    x = np.asarray(x, dtype=np.float32)
-    n, d = x.shape
+    if isinstance(x, jax.Array):
+        xd = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    else:
+        xd = jax.device_put(np.ascontiguousarray(np.asarray(x, dtype=np.float32)))
+    n, d = xd.shape
     n_lists = min(n_lists, n)
-    init = scalable_kmeans_init if n_lists >= 64 else kmeans_plus_plus_init
-    centers0 = init(x, n_lists, seed).astype(np.float32)
-    # ONE h2d transfer of x, reused for training and assignment; no final
-    # high-precision inertia pass (nothing consumes it, and its program is a
-    # separate ~79s compile in a fresh process)
-    xd = jax.device_put(x)
+    ones = jnp.ones((n,), jnp.float32)
+    if n_lists >= 64:
+        centers0 = scalable_kmeans_init_device(xd, n_lists, seed)
+    else:
+        # bound the k-means++ scan: one contiguous slice (ordering bias is
+        # washed out by the full-data Lloyd refinement below)
+        n_pp = min(n, 262_144)
+        xs = jax.lax.dynamic_slice_in_dim(xd, 0, n_pp, 0) if n_pp < n else xd
+        centers0 = _kmeanspp_device(
+            xs, jnp.ones((n_pp,), jnp.float32), seed, k=n_lists
+        )
+    # no final high-precision inertia pass: nothing consumes it, and its
+    # program is a separate ~79s compile in a fresh process
     state = kmeans_fit(
-        xd, jnp.ones((n,), jnp.float32), jax.device_put(centers0),
+        xd, ones, centers0,
         mesh=get_mesh(1), max_iter=kmeans_iters, tol=1e-6, final_inertia=False,
     )
-    centroids_dev = state["cluster_centers_"]
-    centroids = np.asarray(centroids_dev)
+    centroids_dev = state["cluster_centers_"].astype(jnp.float32)
     assign = np.asarray(
         jax.jit(lambda X, C: jnp.argmin(
             jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1
-        ))(xd, centroids_dev)
+        ).astype(jnp.int32))(xd, centroids_dev)
     )
     counts = np.bincount(assign, minlength=n_lists)
     L = max(1, int(counts.max()))
     order = np.argsort(assign, kind="stable")
     sorted_assign = assign[order]
     offsets = np.arange(n) - (np.cumsum(counts) - counts)[sorted_assign]
-    return x, centroids, assign, sorted_assign, order, offsets, n_lists, L
+    return xd, centroids_dev, assign, sorted_assign, order, offsets, n_lists, L
 
 
 def build_ivfpq(
@@ -309,24 +334,34 @@ def build_ivfpq(
     from .kmeans import _kmeanspp_device, kmeans_fit
     from ..parallel.mesh import get_mesh
 
-    x, centroids, assign, sorted_assign, order, offsets, n_lists, L = _coarse_quantizer(
+    xd, centroids, assign, sorted_assign, order, offsets, n_lists, L = _coarse_quantizer(
         x, n_lists, seed, kmeans_iters
     )
-    n, d = x.shape
+    n, d = xd.shape
     if d % M:
         raise ValueError(f"M={M} must divide the feature dimension d={d}")
     dsub = d // M
     K = 1 << n_bits
-    resid = (x - centroids[assign]).astype(np.float32)  # [n, d]
 
-    # train per-subspace codebooks on a residual subsample
+    # train per-subspace codebooks on a RESIDUAL subsample built from a few
+    # contiguous row blocks at random offsets: no full [n, d] residual matrix
+    # is ever materialized (that doubles HBM at large shapes), and no
+    # fancy-index gather touches the big x (the pattern XLA answers with a
+    # full device copy)
     rs = np.random.default_rng(seed)
-    train = resid[rs.choice(n, min(n, train_cap), replace=False)]
+    cap = min(n, train_cap)
+    n_blocks = min(16, max(1, cap // 1024)) if cap < n else 1
+    bs = cap // n_blocks
+    assign_dev = jax.device_put(assign)
+    blocks = []
+    for b in range(n_blocks):
+        off = int(rs.integers(0, max(1, n - bs + 1))) if cap < n else b * bs
+        blocks.append(_residual_block(xd, centroids, assign_dev, off, size=bs))
+    train = jnp.concatenate(blocks, axis=0) if len(blocks) > 1 else blocks[0]
     codebooks = np.zeros((M, K, dsub), np.float32)
     mesh1 = get_mesh(1)
     for m in range(M):
-        # ONE h2d transfer of the sub-block, shared by seeding and training
-        sub = jax.device_put(np.ascontiguousarray(train[:, m * dsub : (m + 1) * dsub]))
+        sub = train[:, m * dsub : (m + 1) * dsub]
         sub_w = jnp.ones((sub.shape[0],), jnp.float32)
         k_eff = min(K, sub.shape[0])
         c0 = _kmeanspp_device(  # one dispatch; shared shape across all M
@@ -340,18 +375,12 @@ def build_ivfpq(
         if k_eff < K:  # degenerate tiny datasets: repeat the first centroid
             codebooks[m, k_eff:] = codebooks[m, 0]
 
-    # encode all residuals: nearest codeword per subspace (device matmul)
-    @jax.jit
-    def encode(R, CB):  # R [n, M, dsub], CB [M, K, dsub]
-        d2 = (
-            jnp.sum(CB * CB, axis=2)[None, :, :]           # [1, M, K]
-            - 2.0 * jnp.einsum("nmd,mkd->nmk", R, CB)      # [n, M, K]
-        )
-        return jnp.argmin(d2, axis=2).astype(jnp.int32)    # [n, M]
-
-    codes = np.asarray(encode(
-        jax.device_put(resid.reshape(n, M, dsub)), jax.device_put(codebooks)
-    )).astype(np.uint8 if n_bits <= 8 else np.int32)
+    # encode all points: residual + nearest codeword per subspace, TILED over
+    # rows inside one program — the per-tile residual is transient, so peak
+    # HBM stays x + one tile; only the [n, M] code matrix crosses to host
+    codes = np.asarray(
+        _encode_residuals(xd, centroids, assign_dev, jax.device_put(codebooks))
+    ).astype(np.uint8 if n_bits <= 8 else np.int32)
 
     code_buckets = np.zeros((n_lists, L, M), codes.dtype)
     bucket_ids = np.full((n_lists, L), -1, np.int64)
@@ -363,6 +392,42 @@ def build_ivfpq(
         "code_buckets": code_buckets,
         "bucket_ids": bucket_ids,
     }
+
+
+@partial(jax.jit, static_argnames=("size",))
+def _residual_block(X, C, A, off, *, size):
+    """Residuals of one contiguous row block: X[off:off+size] − C[A[...]]."""
+    xb = jax.lax.dynamic_slice_in_dim(X, off, size, 0)
+    ab = jax.lax.dynamic_slice_in_dim(A, off, size, 0)
+    return (xb - C[ab]).astype(jnp.float32)
+
+
+@jax.jit
+def _encode_residuals(X, C, A, CB):
+    """PQ-encode every row: nearest codeword per subspace of (x − centroid),
+    tiled over rows so the residual never exists in full. CB [M, K, dsub]."""
+    n, d = X.shape
+    M, K, dsub = CB.shape
+    tile = max(256, min(n, 4_000_000 // max(d, 1)))
+    n_tiles = -(-n // tile)
+    cb_sq = jnp.sum(CB * CB, axis=2)  # [M, K]
+
+    def body(ti, out):
+        r0 = jnp.minimum(ti * tile, n - tile)
+        xb = jax.lax.dynamic_slice(X, (r0, 0), (tile, d))
+        ab = jax.lax.dynamic_slice(A, (r0,), (tile,))
+        R = (xb - C[ab]).reshape(tile, M, dsub)
+        d2 = cb_sq[None] - 2.0 * jnp.einsum("nmd,mkd->nmk", R, CB)
+        codes_t = jnp.argmin(d2, axis=2).astype(jnp.int32)
+        return jax.lax.dynamic_update_slice(out, codes_t, (r0, 0))
+
+    if n <= tile:
+        R = (X - C[A]).reshape(n, M, dsub)
+        d2 = cb_sq[None] - 2.0 * jnp.einsum("nmd,mkd->nmk", R, CB)
+        return jnp.argmin(d2, axis=2).astype(jnp.int32)
+    return jax.lax.fori_loop(
+        0, n_tiles, body, jnp.zeros((n, M), jnp.int32)
+    )
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "batch_queries"))
