@@ -254,11 +254,18 @@ def kmeans_fit(
             return _lloyd_step_fused_1dev(X, w, c, batch_rows=batch_rows, fast=f)
         return _lloyd_step(X, w, c, mesh=mesh, batch_rows=batch_rows, fast=f)
 
+    # convergence is tested one iteration LATE: fetching the shift scalar
+    # synchronizes with the device (~50ms each through a remote tunnel —
+    # 1.5s of the protocol fit); checking the PREVIOUS iteration's shift
+    # overlaps the fetch with the current step's compute. At most one extra
+    # Lloyd iteration runs after the tol crossing (same fixpoint).
+    prev_shift = None
     for _ in range(max_iter):
         centers, inertia, shift = step(centers, fast)
         n_iter += 1
-        if float(shift) <= tol:
+        if prev_shift is not None and float(prev_shift) <= tol:
             break
+        prev_shift = shift
     # inertia reported is one iteration stale; recompute once with final
     # centers — always at high precision. Callers that don't consume inertia
     # (e.g. the IVF coarse quantizer) skip the pass: the high-precision
