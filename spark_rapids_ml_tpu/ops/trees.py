@@ -55,19 +55,58 @@ def quantile_bins(x_host: np.ndarray, max_bins: int, sample_cap: int = 100_000, 
     return np.ascontiguousarray(edges)
 
 
-@jax.jit
-def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
-    """X [n, d] -> bin ids [n, d] via per-feature searchsorted.
+def _bin_dtype(edges):
+    return jnp.uint8 if edges.shape[1] + 1 <= 256 else jnp.int32
 
-    Stored uint8 when max_bins <= 256 (the protocol's 128-bin config halves the
-    persistent binned-matrix footprint vs int32 — 3 GiB instead of 12 GiB at
-    1M x 3k); consumers upcast at the arithmetic sites."""
-    out_dtype = jnp.uint8 if edges.shape[1] + 1 <= 256 else jnp.int32
+
+def _bin_impl(X: jax.Array, edges: jax.Array) -> jax.Array:
+    out_dtype = _bin_dtype(edges)
 
     def one_feature(col, e):
         return jnp.searchsorted(e, col, side="left").astype(out_dtype)
 
     return jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(X, edges)
+
+
+_bin_all = jax.jit(_bin_impl)
+
+
+@partial(jax.jit, static_argnames=("size",), donate_argnums=(2,))
+def _bin_tile(X, edges, out, start, *, size):
+    xb = jax.lax.dynamic_slice(X, (start, 0), (size, X.shape[1]))
+    return jax.lax.dynamic_update_slice(out, _bin_impl(xb, edges), (start, 0))
+
+
+def bin_features(X: jax.Array, edges: jax.Array, batch_rows: int = 0) -> jax.Array:
+    """X [n, d] -> bin ids [n, d] via per-feature searchsorted.
+
+    Stored uint8 when max_bins <= 256 (the protocol's 128-bin config halves the
+    persistent binned-matrix footprint vs int32 — 3 GiB instead of 12 GiB at
+    1M x 3k); consumers upcast at the arithmetic sites.
+
+    Large single-device inputs are binned in row tiles (host loop of
+    dynamic_slice programs into one donated output buffer): XLA's
+    searchsorted lowering keeps ~5 s32/f32 temporaries at the FULL operand
+    shape through its while loop, so a monolithic [1M, 3k] program wants
+    >50 GB of temp HBM next to the 11 GB X (compile-time OOM on one chip).
+    The default tile bounds the temps to ~1 GB. Sharded inputs keep the
+    one-program path (per-shard size is what matters there)."""
+    n, d = X.shape
+    if not batch_rows:
+        # ~5 full-shape temps in the searchsorted while loop, target <=1 GB
+        batch_rows = max(1024, int(50_000_000 // max(d, 1)))
+    one_dev = not hasattr(X, "devices") or len(X.devices()) == 1
+    if not one_dev or n <= 2 * batch_rows:
+        return _bin_all(X, edges)
+    import numpy as np
+
+    out = jnp.zeros((n, d), _bin_dtype(edges))
+    n_full = (n // batch_rows) * batch_rows
+    for start in range(0, n_full, batch_rows):
+        out = _bin_tile(X, edges, out, np.int32(start), size=batch_rows)
+    if n - n_full:
+        out = _bin_tile(X, edges, out, np.int32(n_full), size=n - n_full)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -140,34 +179,32 @@ def _feature_subset_ids(key, n_nodes: int, d: int, m: int):
 # ---------------------------------------------------------------------------
 
 
-def _grow_tree(
+def _tree_level(
     key,
     Xb: jax.Array,  # [n, d] bin ids (uint8 at <=256 bins; upcast at arithmetic sites)
     stats_row: jax.Array,  # [n, S] per-row stat contributions (already w-weighted)
+    node_id: jax.Array,  # [n] current node per row (level-order id)
+    active: jax.Array,  # [n] row not yet in a leaf
+    feature: jax.Array,  # [M] chosen feature per node (−1 = leaf)
+    split_bin: jax.Array,  # [M]
+    node_stats: jax.Array,  # [M, S]
     params: Dict,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Grow one tree; returns (feature [M], split_bin [M], node_stats [M, S])."""
+    depth: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Grow ONE level of one tree: chunked histograms + split selection +
+    row advance. Returns (node_id, active, feature, split_bin, node_stats)."""
     n, d = Xb.shape
     S = stats_row.shape[1]
     B = params["max_bins"]
-    max_depth = params["max_depth"]
     node_cap = params["node_chunk"]
-    M = 2 ** (max_depth + 1) - 1
-
-    feature = jnp.full((M,), -1, jnp.int32)
-    split_bin = jnp.zeros((M,), jnp.int32)
-    node_stats = jnp.zeros((M, S), stats_row.dtype)
-    node_id = jnp.zeros((n,), jnp.int32)  # current node per row (level-order id)
-    active = jnp.ones((n,), bool)  # row not yet in a leaf
-
     m = min(params["max_features"], d)
-    for depth in range(max_depth):
+
+    if True:  # keep the body's original indentation (one level of the old loop)
         level_size = 2**depth
         offset = level_size - 1
         n_chunks = max(1, -(-level_size // node_cap))
         chunk = min(level_size, node_cap)
-        key, kf = jax.random.split(key)
-        fids_level = _feature_subset_ids(kf, level_size, d, m)  # [level, m]
+        fids_level = _feature_subset_ids(key, level_size, d, m)  # [level, m]
 
         # histogram accumulation is tiled over ROWS: the scatter operand is
         # bounded to ~4M elements per pass. One [n*m]-sized scatter both
@@ -263,8 +300,12 @@ def _grow_tree(
         child = 2 * node_id + jnp.where(go_left, 1, 2)
         node_id = jnp.where(went_split, child, node_id)
         active = went_split
+    return node_id, active, feature, split_bin, node_stats
 
-    # last level: record stats for rows that reached it (all remaining leaves)
+
+def _tree_final_level(stats_row, node_id, active, node_stats, max_depth: int):
+    """Record stats for rows that reached the last level (remaining leaves)."""
+    S = stats_row.shape[1]
     level_size = 2**max_depth
     offset = level_size - 1
     local = node_id - offset
@@ -277,7 +318,35 @@ def _grow_tree(
         ],
         axis=1,
     )
-    node_stats = jax.lax.dynamic_update_slice(node_stats, last_stats, (offset, 0))
+    return jax.lax.dynamic_update_slice(node_stats, last_stats, (offset, 0))
+
+
+def _grow_tree(
+    key,
+    Xb: jax.Array,
+    stats_row: jax.Array,  # [n, S] per-row stat contributions (already w-weighted)
+    params: Dict,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Grow one tree IN-GRAPH (all levels in the caller's trace); returns
+    (feature [M], split_bin [M], node_stats [M, S]). The forest path instead
+    dispatches `_tree_level` per level from the host (see forest_fit)."""
+    n, d = Xb.shape
+    S = stats_row.shape[1]
+    max_depth = params["max_depth"]
+    M = 2 ** (max_depth + 1) - 1
+
+    feature = jnp.full((M,), -1, jnp.int32)
+    split_bin = jnp.zeros((M,), jnp.int32)
+    node_stats = jnp.zeros((M, S), stats_row.dtype)
+    node_id = jnp.zeros((n,), jnp.int32)
+    active = jnp.ones((n,), bool)
+    for depth in range(max_depth):
+        key, kf = jax.random.split(key)
+        node_id, active, feature, split_bin, node_stats = _tree_level(
+            kf, Xb, stats_row, node_id, active, feature, split_bin, node_stats,
+            params, depth,
+        )
+    node_stats = _tree_final_level(stats_row, node_id, active, node_stats, max_depth)
     return feature, split_bin, node_stats
 
 
@@ -286,13 +355,10 @@ def _grow_tree(
 # ---------------------------------------------------------------------------
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "mesh", "seed", "n_trees", "max_depth", "max_bins", "max_features", "impurity",
-        "node_chunk", "bootstrap", "subsample_rate", "min_instances", "min_info_gain", "n_stats",
-    ),
-)
+# NOT jitted: forest_fit is a HOST orchestrator — it dispatches one compact
+# jitted program per (tree round, level). Wrapping it in jit would trace the
+# whole ensemble into a single giant program (compile-helper OOM and
+# multi-minute single dispatches that kill the TPU worker at 1M x 3k).
 def forest_fit(
     Xb: jax.Array,  # [n_pad, d] bin ids (row-sharded; uint8 at <=256 bins)
     stats_row: jax.Array,  # [n_pad, S] per-row stats, zero on padding
@@ -316,62 +382,178 @@ def forest_fit(
     row shard. Returns stacked (feature [T, M], split_bin [T, M],
     node_stats [T, M, S])."""
     from jax import shard_map
+    from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import ROWS_AXIS
 
     n_dev = mesh.devices.size
     trees_per_dev = -(-n_trees // n_dev)  # reference _estimators_per_worker
+    # the axon TPU runtime kernel-faults when a level's chunk fori_loop runs
+    # more than ~16 iterations at benchmark scale (bisected at 1M x 3k,
+    # depth 13: 32 chunks of 256 nodes crashes the worker, 16 chunks of 512
+    # passes) — scale the chunk so the DEEPEST level stays within 16 chunks,
+    # while keeping the per-chunk segment space (chunk*m*bins) bounded
+    deepest = 1 << max(max_depth - 1, 0)
+    min_chunk = -(-deepest // 16)
+    seg_budget = 16_000_000
+    mem_chunk = max(64, seg_budget // max(max_features * max_bins, 1))
+    node_chunk = int(max(min(max(node_chunk, min_chunk), mem_chunk), min_chunk))
     params = {
         "max_depth": max_depth, "max_bins": max_bins, "max_features": max_features,
         "impurity": impurity, "node_chunk": node_chunk,
         "min_instances": min_instances, "min_info_gain": min_info_gain,
     }
 
-    def local(Xb_l, stats_l, w_l):
+    S = stats_row.shape[1]
+    M = 2 ** (max_depth + 1) - 1
+    n_dev_axis = P(ROWS_AXIS)
+
+    def boot_fn(stats_l, w_l, tree_i):
+        # per-device bootstrap weighting for THIS round's tree
         rank = jax.lax.axis_index(ROWS_AXIS)
-        n_l = Xb_l.shape[0]
+        n_l = stats_l.shape[0]
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed), rank * trees_per_dev + tree_i
+        )
+        k1, _ = jax.random.split(key)
+        n_draws = int(max(1, round(subsample_rate * n_l)))
+        if bootstrap:
+            # draw UNIFORMLY over valid (non-padding) rows; the user weights
+            # already scale stats_l, so weighting the draw too would apply
+            # them twice (w² effective weighting)
+            valid = (w_l > 0).astype(stats_l.dtype)
+            p = valid / jnp.maximum(jnp.sum(valid), 1e-30)
+            idx = jax.random.choice(k1, n_l, (n_draws,), replace=True, p=p)
+            wb = jnp.zeros((n_l,), stats_l.dtype).at[idx].add(1.0)
+        elif subsample_rate < 1.0:
+            # subsample without replacement (Spark bootstrap=False semantics);
+            # padding rows drawn here contribute nothing (stats are w-scaled)
+            idx = jax.random.choice(k1, n_l, (n_draws,), replace=False)
+            wb = jnp.zeros((n_l,), stats_l.dtype).at[idx].set(1.0)
+        else:
+            wb = jnp.ones((n_l,), stats_l.dtype)
+        return stats_l * wb[:, None]
 
-        def one_tree(tree_i):
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), rank * trees_per_dev + tree_i)
-            n_draws = int(max(1, round(subsample_rate * n_l)))
-            k1, key = jax.random.split(key)
-            if bootstrap:
-                # draw UNIFORMLY over valid (non-padding) rows; the user weights
-                # already scale stats_l, so weighting the draw too would apply
-                # them twice (w² effective weighting)
-                valid = (w_l > 0).astype(stats_l.dtype)
-                p = valid / jnp.maximum(jnp.sum(valid), 1e-30)
-                idx = jax.random.choice(k1, n_l, (n_draws,), replace=True, p=p)
-                wb = jnp.zeros((n_l,), stats_l.dtype).at[idx].add(1.0)
-            elif subsample_rate < 1.0:
-                # subsample without replacement (Spark bootstrap=False semantics);
-                # padding rows drawn here contribute nothing (stats are w-scaled)
-                idx = jax.random.choice(k1, n_l, (n_draws,), replace=False)
-                wb = jnp.zeros((n_l,), stats_l.dtype).at[idx].set(1.0)
-            else:
-                wb = jnp.ones((n_l,), stats_l.dtype)
-            return _grow_tree(key, Xb_l, stats_l * wb[:, None], params)
+    boot_step = jax.jit(shard_map(
+        boot_fn, mesh=mesh,
+        in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS), P()),
+        out_specs=P(ROWS_AXIS, None),
+    ))
 
-        feats, bins_, nstats = jax.lax.map(one_tree, jnp.arange(trees_per_dev))
-        return feats, bins_, nstats
+    def make_level_step(depth):
+        def fn(Xb_l, stw_l, nid_l, act_l, feat_b, bin_b, nst_b, tree_i):
+            rank = jax.lax.axis_index(ROWS_AXIS)
+            tkey = jax.random.fold_in(
+                jax.random.PRNGKey(seed), rank * trees_per_dev + tree_i
+            )
+            kf = jax.random.fold_in(tkey, 7919 + depth)  # per-level stream
+            nid, act, f, b, s = _tree_level(
+                kf, Xb_l, stw_l, nid_l, act_l,
+                feat_b[0], bin_b[0], nst_b[0], params, depth,
+            )
+            return nid, act, f[None], b[None], s[None]
 
-    feats, bins_, nstats = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS)),
-        out_specs=(P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS, None, None)),
-    )(Xb, stats_row, w)
-    # out axis 0 is [n_dev * trees_per_dev] (device-major) — the tree concat.
-    # Replicate the (small) tree arrays so every process can fetch the full
-    # forest under multi-process SPMD — the in-graph form of the reference's
-    # serialized-tree allGather + concat (tree.py:333-378).
-    from jax.sharding import NamedSharding
+        return jax.jit(shard_map(
+            fn, mesh=mesh,
+            in_specs=(
+                P(ROWS_AXIS, None), P(ROWS_AXIS, None), n_dev_axis, n_dev_axis,
+                P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS, None, None),
+                P(),
+            ),
+            out_specs=(
+                n_dev_axis, n_dev_axis,
+                P(ROWS_AXIS, None), P(ROWS_AXIS, None), P(ROWS_AXIS, None, None),
+            ),
+        ))
+
+    level_steps = [make_level_step(depth) for depth in range(max_depth)]
+
+    def final_fn(stw_l, nid_l, act_l, nst_b):
+        return _tree_final_level(stw_l, nid_l, act_l, nst_b[0], max_depth)[None]
+
+    final_step = jax.jit(shard_map(
+        final_fn, mesh=mesh,
+        in_specs=(P(ROWS_AXIS, None), n_dev_axis, n_dev_axis, P(ROWS_AXIS, None, None)),
+        out_specs=P(ROWS_AXIS, None, None),
+    ))
+
+    n_rows = Xb.shape[0]
+    tree_init = jax.jit(
+        lambda: (
+            jnp.zeros((n_rows,), jnp.int32),
+            jnp.ones((n_rows,), bool),
+            jnp.full((n_dev, M), -1, jnp.int32),
+            jnp.zeros((n_dev, M), jnp.int32),
+            jnp.zeros((n_dev, M, S), stats_row.dtype),
+        ),
+        out_shardings=(
+            NamedSharding(mesh, P(ROWS_AXIS)),
+            NamedSharding(mesh, P(ROWS_AXIS)),
+            NamedSharding(mesh, P(ROWS_AXIS, None)),
+            NamedSharding(mesh, P(ROWS_AXIS, None)),
+            NamedSharding(mesh, P(ROWS_AXIS, None, None)),
+        ),
+    )
+
+    # HOST loops over tree rounds AND levels — one dispatch per (round,
+    # level), each a compact program reused across rounds. One program
+    # growing the whole ensemble (or even one whole deep tree at protocol
+    # scale) is both a compile-memory hazard (the remote compile helper gets
+    # OOM-killed unrolling 13 levels at 1M x 3k) and a runtime hazard (the
+    # multi-minute single dispatch reproducibly kills the axon TPU worker).
+    # Tree order is ROUND-major ([round0: dev0..devN, round1: ...]) — forest
+    # aggregation is order-invariant.
+    # Per-round replication of the (small) tree arrays so every process can
+    # fetch the full forest under multi-process SPMD — the in-graph form of
+    # the reference's serialized-tree allGather + concat (tree.py:333-378).
+    # Rounds are fetched to host as they finish and concatenated in numpy:
+    # one tiny replication program compiled after round 0 (an end-of-run
+    # concat over 3x50 device arrays was a fresh multi-minute-later compile,
+    # one more exposure to remote-compile-service flakiness for no benefit).
+    import numpy as np
 
     rep = NamedSharding(mesh, P())
-    feats, bins_, nstats = (
-        jax.lax.with_sharding_constraint(a, rep) for a in (feats, bins_, nstats)
-    )
+    replicate = jax.jit(lambda f, b, s: (f, b, s), out_shardings=(rep, rep, rep))
+
+    def dispatch(fn, *args, _retries=2):
+        # the remote TPU compile service drops requests transiently (HTTP
+        # 500s, closed response bodies); every step here is a pure program
+        # over live inputs, so a bounded retry is safe and turns a dead
+        # 20-minute protocol run into a logged hiccup
+        import time as _time
+
+        for attempt in range(_retries + 1):
+            try:
+                return fn(*args)
+            except jax.errors.JaxRuntimeError as e:  # pragma: no cover - env
+                msg = str(e)
+                transient = "remote_compile" in msg or "INTERNAL" in msg
+                if not transient or attempt == _retries:
+                    raise
+                from ..utils import get_logger
+
+                get_logger("RandomForest").warning(
+                    "transient TPU compile failure (attempt %d): %s",
+                    attempt + 1, msg.splitlines()[0],
+                )
+                _time.sleep(15.0 * (attempt + 1))
+
+    rounds = []
+    for t_i in range(trees_per_dev):
+        ti = jnp.int32(t_i)
+        stw = dispatch(boot_step, stats_row, w, ti)
+        nid, act, feat_b, bin_b, nst_b = dispatch(tree_init)
+        for depth in range(max_depth):
+            nid, act, feat_b, bin_b, nst_b = dispatch(
+                level_steps[depth], Xb, stw, nid, act, feat_b, bin_b, nst_b, ti
+            )
+        nst_b = dispatch(final_step, stw, nid, act, nst_b)
+        f, b, s = dispatch(replicate, feat_b, bin_b, nst_b)
+        rounds.append((np.asarray(f), np.asarray(b), np.asarray(s)))
+    feats = np.concatenate([r[0] for r in rounds], axis=0)
+    bins_ = np.concatenate([r[1] for r in rounds], axis=0)
+    nstats = np.concatenate([r[2] for r in rounds], axis=0)
     return {"feature": feats, "split_bin": bins_, "node_stats": nstats}
 
 
