@@ -9,6 +9,17 @@
 # algos; per-algo detail goes to stderr. The full 10-config suite lives in
 # benchmark/ (python -m benchmark.benchmark_runner protocol).
 #
+# RESILIENCE (reference parity: benchmark/databricks/run_benchmark.sh runs a
+# time-limited, multi-attempt loop): the axon TPU tunnel flaps — it cost this
+# repo the round-3 multichip artifact and the whole round-4 bench. So bench.py
+# is a two-layer program:
+#   * parent (this file, no args): retries the real bench as a subprocess with
+#     bounded backoff; collects per-algo @RESULT lines from the child's stdout
+#     as they complete, so a mid-run crash keeps finished algos and a retry
+#     skips them. ALWAYS prints a parseable JSON line and exits 0 — a dead
+#     tunnel yields {"value": 0.0, ...}, never a stack trace.
+#   * child (--run): generates data and runs the algo sections, each fail-soft.
+#
 # Memory: X is 1M x 3000 f32 = 11.2 GiB, generated tile-wise DIRECTLY into a
 # row-sharded HBM buffer (benchmark/gen_data.py) — peak = X + one 64k-row tile,
 # inside a single v5e chip's 16 GB.
@@ -23,6 +34,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -31,10 +43,23 @@ import numpy as np
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_COLS = int(os.environ.get("BENCH_COLS", 3000))
 BASELINES = {"pca": 50_000.0, "kmeans": 8_333.0, "logreg": 12_500.0}
+ALGOS = ("pca", "logreg", "kmeans")
+
+# Parent retry policy (override for tests): attempts x per-attempt timeout,
+# with a longer sleep after fast failures (backend-init class) than slow ones
+# (mid-run fault: the tunnel is up, retry soon).
+MAX_ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 10))
+ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 2400))
+BACKOFF_FAST_FAIL_S = float(os.environ.get("BENCH_BACKOFF", 60))
+BACKOFF_SLOW_FAIL_S = 10.0
+FAST_FAIL_WINDOW_S = 180.0  # died in <3 min => almost surely backend init
 
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# ----------------------------------------------------------------- child ----
 
 
 def _time_fit(run, fetch, repeats=2) -> float:
@@ -67,11 +92,15 @@ def bench_kmeans(X, w, mesh) -> float:
     from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
 
     k = 1000
-    # random-row init (initMode=random protocol config). The rows are iid, so
-    # ONE contiguous k-row block at a random offset is an equally random
-    # sample: a single dynamic_slice program (per-row pulls cost ~145 s of
-    # dispatch latency through the tunnel; a fancy-index gather program on the
-    # 11 GiB X makes XLA materialize a full copy — measured OOM).
+    # random-row init (initMode=random protocol config). The rows are iid BY
+    # CONSTRUCTION (gen_classification_device draws every row from the same
+    # mixture, in tile order independent of label), so ONE contiguous k-row
+    # block at a random offset is an equally random sample. Do NOT point this
+    # at ordered/clustered data (e.g. a parquet dataset sorted by label) —
+    # there a contiguous block is a degenerate init; sample rows instead.
+    # (Per-row pulls cost ~145 s of dispatch latency through the tunnel; a
+    # fancy-index gather program on the 11 GiB X makes XLA materialize a full
+    # copy — measured OOM.)
     rng = np.random.default_rng(1)
     r0 = int(rng.integers(0, max(1, X.shape[0] - k + 1)))
     centers0 = jax.jit(lambda X: jax.lax.dynamic_slice_in_dim(X, r0, k, 0))(X)
@@ -103,11 +132,17 @@ def bench_logreg(X, w, y_idx) -> float:
     return N_ROWS / fit_s
 
 
-def main() -> None:
+def run_child() -> int:
+    """Generate data once, run each pending algo fail-soft, emit @RESULT lines."""
     import jax
 
     from benchmark.gen_data import gen_classification_device
     from spark_rapids_ml_tpu.parallel import get_mesh
+
+    skip = set(filter(None, os.environ.get("BENCH_SKIP", "").split(",")))
+    pending = [a for a in ALGOS if a not in skip]
+    if not pending:
+        return 0
 
     mesh = get_mesh()
     n_chips = int(mesh.devices.size)
@@ -123,26 +158,112 @@ def main() -> None:
     np.asarray(w[:1])  # force materialization for honest phase timing
     _log(f"datagen: {time.perf_counter() - t0:.1f}s")
 
-    results = {}
-    results["pca"] = bench_pca(X, w, mesh) / n_chips
-    results["logreg"] = bench_logreg(X, w, y_idx) / n_chips
-    results["kmeans"] = bench_kmeans(X, w, mesh) / n_chips
+    runners = {
+        "pca": lambda: bench_pca(X, w, mesh),
+        "logreg": lambda: bench_logreg(X, w, y_idx),
+        "kmeans": lambda: bench_kmeans(X, w, mesh),
+    }
+    n_fail = 0
+    for name in pending:
+        try:
+            v = runners[name]() / n_chips
+            print("@RESULT " + json.dumps({"algo": name, "rows_per_sec_chip": v}), flush=True)
+        except Exception as e:  # fail-soft: one dead section keeps the rest
+            n_fail += 1
+            _log(f"bench[{name}] FAILED: {type(e).__name__}: {e}")
+    return 1 if n_fail else 0
 
-    for name, v in results.items():
+
+# ---------------------------------------------------------------- parent ----
+
+
+def emit(results: dict) -> None:
+    """The one stdout JSON line. Degrades to value 0.0 when nothing ran."""
+    ok = {k: v for k, v in results.items() if v and np.isfinite(v)}
+    if ok:
+        geo = float(np.exp(np.mean([np.log(v) for v in ok.values()])))
+        geo_vs = float(np.exp(np.mean([np.log(ok[k] / BASELINES[k]) for k in ok])))
+    else:
+        geo, geo_vs = 0.0, 0.0
+    missing = [a for a in ALGOS if a not in ok]
+    unit = (
+        f"rows/sec/chip (geomean of PCA k=3 / KMeans k=1000 / LogReg maxIter=200 "
+        f"on {N_ROWS // 1000}k x {N_COLS}, f32"
+        + (f"; INCOMPLETE, missing {'+'.join(missing)}" if missing else "")
+        + ")"
+    )
+    for name, v in ok.items():
         _log(f"{name}: {v:,.0f} rows/sec/chip (baseline {BASELINES[name]:,.0f}; {v / BASELINES[name]:.1f}x)")
-    geo = float(np.exp(np.mean([np.log(v) for v in results.values()])))
-    geo_vs = float(np.exp(np.mean([np.log(results[k] / BASELINES[k]) for k in results])))
     print(
         json.dumps(
             {
                 "metric": "classical_ml_fit_throughput_geomean",
                 "value": round(geo, 1),
-                "unit": "rows/sec/chip (geomean of PCA k=3 / KMeans k=1000 / LogReg maxIter=200 on 1M x 3000, f32)",
+                "unit": unit,
                 "vs_baseline": round(geo_vs, 3),
             }
-        )
+        ),
+        flush=True,
     )
 
 
+def main() -> None:
+    results: dict = {}
+    try:
+        _attempt_loop(results)
+    except Exception as e:  # the JSON line is a CONTRACT: never die before emit
+        _log(f"bench driver error: {type(e).__name__}: {e}")
+    emit(results)
+
+
+def _attempt_loop(results: dict) -> None:
+    deadline = time.monotonic() + float(os.environ.get("BENCH_TOTAL_TIMEOUT", 3600 * 2.5))
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        pending = [a for a in ALGOS if a not in results]
+        if not pending:
+            break
+        if time.monotonic() > deadline:
+            _log("bench: total time budget exhausted")
+            break
+        env = dict(os.environ, BENCH_SKIP=",".join(a for a in ALGOS if a in results))
+        _log(f"bench attempt {attempt}/{MAX_ATTEMPTS}: running {'+'.join(pending)}")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run"],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=sys.stderr,
+                timeout=min(ATTEMPT_TIMEOUT_S, max(60.0, deadline - time.monotonic())),
+                text=True,
+            )
+            out, rc = proc.stdout or "", proc.returncode
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or ""
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            rc = -1
+            _log(f"bench attempt {attempt}: child timed out after {ATTEMPT_TIMEOUT_S:.0f}s")
+        for line in out.splitlines():
+            if line.startswith("@RESULT "):
+                try:
+                    rec = json.loads(line[len("@RESULT "):])
+                    results[rec["algo"]] = float(rec["rows_per_sec_chip"])
+                except (ValueError, KeyError, TypeError):
+                    pass
+        if all(a in results for a in ALGOS):
+            break
+        elapsed = time.monotonic() - t0
+        _log(f"bench attempt {attempt}: rc={rc}, have {sorted(results)} after {elapsed:.0f}s")
+        if attempt < MAX_ATTEMPTS:
+            pause = BACKOFF_FAST_FAIL_S if elapsed < FAST_FAIL_WINDOW_S else BACKOFF_SLOW_FAIL_S
+            pause = min(pause, max(0.0, deadline - time.monotonic()))
+            if pause:
+                _log(f"bench: backing off {pause:.0f}s before retry")
+                time.sleep(pause)
+
+
 if __name__ == "__main__":
+    if "--run" in sys.argv[1:]:
+        sys.exit(run_child())
     main()

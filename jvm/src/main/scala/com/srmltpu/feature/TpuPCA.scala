@@ -48,25 +48,41 @@ class TpuPCA(val k: Int) extends Serializable {
     val n = rows.count()
     require(k <= d, s"k ($k) must be <= feature dimension ($d)")
 
-    // sufficient statistics per partition: (sum x, X^T X flattened, count)
-    val zero = (new Array[Double](d), new Array[Double](d * d), 0L)
-    val (sumX, xtx, total) = rows.treeAggregate(zero)(
-      seqOp = { case ((s, c, cnt), row) =>
-        SrmlNative.ensureLoaded()
-        // accumulate one row into the gram through the blocked native kernel
-        SrmlNative.covAccumulate(row, 1L, d.toLong, c)
+    // sufficient statistics per partition: (sum x, X^T X flattened, count).
+    // Rows are buffered into multi-row blocks and handed to the native gram
+    // kernel ONE JNI call per block — a per-row seqOp would copy the full
+    // d*d accumulator (72 MB at d=3000) across the JNI boundary for every
+    // row, turning the fit into O(n*d^2) copy traffic.
+    val chunkRows = math.max(1, math.min(4096, (4 << 20) / d)) // ~32 MB block
+    val partStats = rows.mapPartitions { it =>
+      SrmlNative.ensureLoaded()
+      val s = new Array[Double](d)
+      val c = new Array[Double](d * d)
+      val buf = new Array[Double](chunkRows * d)
+      var cnt = 0L
+      var filled = 0
+      while (it.hasNext) {
+        val row = it.next()
+        System.arraycopy(row, 0, buf, filled * d, d)
         var j = 0
         while (j < d) { s(j) += row(j); j += 1 }
-        (s, c, cnt + 1L)
-      },
-      combOp = { case ((s1, c1, n1), (s2, c2, n2)) =>
-        var j = 0
-        while (j < d) { s1(j) += s2(j); j += 1 }
-        j = 0
-        while (j < d * d) { c1(j) += c2(j); j += 1 }
-        (s1, c1, n1 + n2)
+        filled += 1
+        cnt += 1
+        if (filled == chunkRows) {
+          SrmlNative.covAccumulate(buf, filled.toLong, d.toLong, c)
+          filled = 0
+        }
       }
-    )
+      if (filled > 0) SrmlNative.covAccumulate(buf, filled.toLong, d.toLong, c)
+      Iterator.single((s, c, cnt))
+    }
+    val (sumX, xtx, total) = partStats.treeReduce { case ((s1, c1, n1), (s2, c2, n2)) =>
+      var j = 0
+      while (j < d) { s1(j) += s2(j); j += 1 }
+      j = 0
+      while (j < d * d) { c1(j) += c2(j); j += 1 }
+      (s1, c1, n1 + n2)
+    }
     require(total == n && total > 1, s"degenerate dataset: $total rows")
 
     // covariance = (X^T X - n * mean mean^T) / (n - 1)

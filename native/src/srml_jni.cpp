@@ -29,6 +29,11 @@ extern "C" {
 
 JNIEXPORT void JNICALL Java_com_srmltpu_linalg_SrmlNative_covAccumulate(
     JNIEnv* env, jclass, jdoubleArray jx, jlong n, jlong d, jdoubleArray jc) {
+  // Called once per multi-row BLOCK (TpuPCA.scala buffers ~1400 rows per
+  // call), so the array copies here are ~2% of the block's gram compute.
+  // Deliberately NOT GetPrimitiveArrayCritical: the block update runs
+  // seconds of native code at d=3000, and a critical region that long pins
+  // GC for every other task thread in a shared Spark executor JVM.
   jdouble* x = env->GetDoubleArrayElements(jx, nullptr);
   jdouble* c = env->GetDoubleArrayElements(jc, nullptr);
   srml_cov_accumulate(x, n, d, c);

@@ -1,9 +1,11 @@
 #
 # spark_rapids_ml_tpu: a TPU-native distributed classical-ML framework with the
 # API surface and capabilities of spark-rapids-ml (reference at /root/reference),
-# built on JAX/XLA: solvers are SPMD programs over a `jax.sharding.Mesh` with
-# explicit collectives, data lives as row-sharded HBM-resident `jax.Array`s, and
-# the hot inner loops use pallas TPU kernels.
+# built on JAX/XLA: solvers are pure-XLA SPMD programs over a
+# `jax.sharding.Mesh` with explicit collectives, data lives as row-sharded
+# HBM-resident `jax.Array`s, and the hot inner loops are expressed as large
+# static-shape batched matmuls/reductions that XLA tiles onto the MXU —
+# measured faster than hand-written kernels for every solver profiled so far.
 #
 __version__ = "0.1.0"
 

@@ -477,6 +477,27 @@ class _TpuModel(_TpuCommon):
     def _combine(self, models: List["_TpuModel"]) -> "_TpuModel":
         raise NotImplementedError
 
+    # Spark JVM interop: name of the `spark_interop` converter for this model
+    # class (None = the reference has no `.cpu()` for it either)
+    _spark_converter: Optional[str] = None
+
+    def cpu(self):
+        """Equivalent GENUINE pyspark.ml JVM model built via py4j, usable in
+        existing Spark pipelines and JVM serving (the reference's `.cpu()`
+        capability: tree.py:524-569 + utils.py:311-481 for forests,
+        feature.py:365-379 PCA, regression.py:658-672, classification.py:
+        1301-1323). Requires pyspark and an active SparkSession; cached after
+        the first conversion."""
+        if self._spark_converter is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no Spark-ML JVM equivalent (reference parity)"
+            )
+        if getattr(self, "_spark_model", None) is None:
+            from . import spark_interop
+
+            self._spark_model = getattr(spark_interop, self._spark_converter)(self)
+        return self._spark_model
+
     # persistence ---------------------------------------------------------
     def write(self) -> "_TpuWriter":
         return _TpuWriter(self)
@@ -614,6 +635,16 @@ class _TpuModelWithColumns(_TpuModel):
 # ---------------------------------------------------------------------------
 
 
+def _prepare_save_path(path: str, overwrite: bool) -> None:
+    """Shared exists/overwrite/mkdir preamble for every writer (incl. the
+    composite CrossValidatorModel writer in tuning.py)."""
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(f"Path {path} already exists; use write().overwrite().save()")
+        shutil.rmtree(path)
+    os.makedirs(path)
+
+
 class _TpuWriter:
     def __init__(self, instance: Union[_TpuEstimator, _TpuModel]):
         self.instance = instance
@@ -625,11 +656,7 @@ class _TpuWriter:
 
     def save(self, path: str) -> None:
         inst = self.instance
-        if os.path.exists(path):
-            if not self._overwrite:
-                raise FileExistsError(f"Path {path} already exists; use write().overwrite().save()")
-            shutil.rmtree(path)
-        os.makedirs(path)
+        _prepare_save_path(path, self._overwrite)
         metadata = {
             "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
             "uid": inst.uid,
@@ -662,6 +689,22 @@ class _TpuWriter:
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
         with open(os.path.join(path, "attributes.json"), "w") as f:
             json.dump(scalars, f, default=_np_default)
+
+
+def load_instance(path: str):
+    """Load any saved estimator/model by the class recorded in its metadata —
+    the analog of pyspark.ml's DefaultParamsReader class dispatch. Composite
+    writers (CrossValidatorModel) use this to restore nested models without
+    knowing their concrete type."""
+    import importlib
+
+    with open(os.path.join(path, "metadata.json")) as f:
+        qualname = json.load(f)["class"]
+    module, _, name = qualname.rpartition(".")
+    obj: Any = importlib.import_module(module)
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj.load(path)
 
 
 class _TpuReader:

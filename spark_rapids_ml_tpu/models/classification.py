@@ -405,6 +405,8 @@ class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
 
         return DenseVector(self.intercept_)
 
+    _spark_converter = "logreg_to_spark"  # `.cpu()` (reference classification.py:1301-1323)
+
     def setFeaturesCol(self, value) -> "LogisticRegressionModel":
         return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
 

@@ -151,6 +151,8 @@ class PCAModel(_PCAParams, _TpuModelWithColumns):
         """Variance ratio per component (Spark parity: ratio, not raw variance)."""
         return self.explained_variance_ratio_
 
+    _spark_converter = "pca_to_spark"  # `.cpu()` (reference feature.py:365-379)
+
     def setInputCol(self, value: str) -> "PCAModel":
         return self._set_params(inputCol=value) if isinstance(value, str) else self._set_params(inputCols=value)
 

@@ -300,6 +300,8 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
         v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
         return float(v @ self.coef_ + self.intercept_)
 
+    _spark_converter = "linreg_to_spark"  # `.cpu()` (reference regression.py:658-672)
+
     def _out_column_names(self) -> List[str]:
         return [self.getOrDefault("predictionCol")]
 

@@ -403,6 +403,10 @@ class _RandomForestModel(_RandomForestParams, _TpuModelWithColumns):
 
         return [json.dumps(t) for t in self.trees]
 
+    # `.cpu()` (base `_TpuModel.cpu`): array forest -> genuine JVM
+    # RandomForest model (reference tree.py:524-569 _convert_to_java_trees)
+    _spark_converter = "rf_to_spark"
+
     def toDebugString(self) -> str:
         """Spark-style textual dump of the forest."""
         lines = [

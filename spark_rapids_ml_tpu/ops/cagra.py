@@ -145,7 +145,10 @@ def _descent_round(
 
         k1, k2, k3 = jax.random.split(tkey, 3)
         top_src = ids_t[:, :s_top]
-        rnd_slots = jax.random.randint(k1, (tile, s_rnd), s_top, k_int, jnp.int32)
+        # clamp the random-slot range: when k_int <= s_top (tiny n or tiny
+        # intermediate degree) [s_top, k_int) is empty — sample the whole list
+        rnd_lo = s_top if k_int > s_top else 0
+        rnd_slots = jax.random.randint(k1, (tile, s_rnd), rnd_lo, k_int, jnp.int32)
         rnd_src = jnp.take_along_axis(ids_t, rnd_slots, axis=1)
         rev_t = jax.lax.dynamic_slice(rev, (r0, 0), (tile, rev.shape[1]))
         rev_slots = jax.random.randint(k2, (tile, s_rev), 0, rev.shape[1], jnp.int32)
@@ -292,12 +295,15 @@ def build_cagra(
         )
     k_int = int(min(intermediate_graph_degree, max(n - 1, 1)))
     k_out = int(min(graph_degree, k_int))
-    n_rounds = int(nn_descent_niter) or (8 if build_algo == "ivf_pq" else 14)
+    # pick the round count from whether cluster seeding ACTUALLY runs (small n
+    # falls back to random init, which needs the longer random-init schedule)
+    use_seeding = build_algo == "ivf_pq" and n > 4 * k_int
+    n_rounds = int(nn_descent_niter) or (8 if use_seeding else 14)
 
     rng = np.random.default_rng(seed)
     x_sq = _row_sq(xd)
 
-    if build_algo == "ivf_pq" and n > 4 * k_int:
+    if use_seeding:
         # clustered brute-force seeding: target bucket size ~512 rows.
         # All reps are merged in ONE sort-dedup pass (each 500k-row sort
         # merge costs ~8s on a v5e; one wide merge beats three narrow ones)

@@ -235,6 +235,12 @@ def kmeans_fit(
     docstring for why the loop is not a `lax.while_loop`. Small single-device
     datasets take the fused one-program path instead (_lloyd_fit_fused).
 
+    The deferred (pipelined) convergence check means `n_iter_` can be ONE
+    HIGHER than sklearn/cuML would report for the same tol crossing — the
+    extra iteration runs at the converged fixpoint, so centers match. With
+    ``final_inertia=False`` no trustworthy inertia exists (the in-loop value
+    is a stale, possibly-bf16 partial) and `inertia_` is returned as NaN.
+
     precision_mode: "fast" (default for f32) runs the IN-LOOP distance and
     center-update matmuls in one-pass bf16 (see _mm — 1.6× per iteration at
     the protocol shape, true inertia agrees to ~1e-5); "high" keeps the
@@ -269,9 +275,13 @@ def kmeans_fit(
     # inertia reported is one iteration stale; recompute once with final
     # centers — always at high precision. Callers that don't consume inertia
     # (e.g. the IVF coarse quantizer) skip the pass: the high-precision
-    # program is a separate ~79s compile in a fresh process.
+    # program is a separate ~79s compile in a fresh process. The stale value
+    # must not leak to them either — return NaN so accidental consumption is
+    # loud instead of subtly wrong.
     if final_inertia:
         _, inertia, _ = step(centers, False)
+    else:
+        inertia = jnp.full((), jnp.nan, X.dtype)
     return {
         "cluster_centers_": centers,
         "inertia_": inertia,

@@ -1,0 +1,270 @@
+#
+# Spark JVM model interop: convert fitted TPU models into GENUINE pyspark.ml
+# JVM models (`model.cpu()`), so a fitted model can be handed to existing
+# Spark-ML pipelines, persisted with Spark writers, or served by JVM-only
+# infrastructure — the reference's `.cpu()` capability (reference
+# utils.py:311-481 translate_trees, tree.py:524-569 _convert_to_java_trees,
+# feature.py:365-379 PCAModel.cpu, regression.py:658-672, and
+# classification.py:1301-1323).
+#
+# Split into two layers so the logic is testable without a JVM:
+#   * `tree_spec(model, t)` — pure numpy: walks the array forest and emits a
+#     nested node spec carrying everything Spark's tree nodes need (split,
+#     REAL impurity stats, gain, prediction). Unlike the reference (which
+#     fakes internal-node impurity stats with zeros, utils.py:312-325), the
+#     array forest retains per-node sufficient statistics, so the converted
+#     Spark model gets real impurities/gains everywhere.
+#   * `*_to_spark(model)` — thin py4j constructions over the specs, gated on
+#     an active SparkSession.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+# ------------------------------------------------------------- pure layer ---
+
+
+def tree_spec(model, t: int) -> Dict[str, Any]:
+    """Nested Spark-node spec of tree `t` of an array-forest model.
+
+    Keys: every node has `impurity`, `stats` (ImpurityCalculator layout:
+    per-class weighted counts for gini/entropy, [count, sum, sumSq] for
+    variance — exactly Spark's internal stats vectors), `instance_count` and
+    `prediction` (label INDEX for classification, node mean for regression —
+    Spark's label-space contract); internal nodes add `split_feature`,
+    `threshold`, `gain` (fractional-weight Spark semantics) and
+    `left`/`right` children.
+    """
+    stats = np.asarray(model.node_stats, dtype=np.float64)
+    imp, w = model._node_impurity_weight(stats)
+    feature, threshold = model.feature, model.threshold
+    M = feature.shape[1]
+    is_clf = model._is_classification
+
+    def build(i: int) -> Dict[str, Any]:
+        node_stats = stats[t, i]
+        node: Dict[str, Any] = {
+            "impurity": float(imp[t, i]),
+            "instance_count": int(round(float(w[t, i]))),
+        }
+        if is_clf:
+            node["stats"] = [float(v) for v in node_stats]
+            node["prediction"] = float(np.argmax(node_stats))
+        else:
+            n, sy, syy = (float(v) for v in node_stats)
+            node["stats"] = [n, sy, syy]
+            node["prediction"] = sy / max(n, 1e-30)
+        f = int(feature[t, i])
+        if f >= 0 and 2 * i + 2 < M:
+            l, r = 2 * i + 1, 2 * i + 2
+            wl, wr = float(w[t, l]), float(w[t, r])
+            tot = max(wl + wr, 1e-30)
+            node.update(
+                split_feature=f,
+                threshold=float(threshold[t, i]),
+                gain=float(
+                    node["impurity"]
+                    - (wl / tot) * float(imp[t, l])
+                    - (wr / tot) * float(imp[t, r])
+                ),
+                left=build(l),
+                right=build(r),
+            )
+        return node
+
+    return build(0)
+
+
+def forest_specs(model) -> List[Dict[str, Any]]:
+    return [tree_spec(model, t) for t in range(model.num_trees)]
+
+
+# ------------------------------------------------------------- py4j layer ---
+
+
+def _require_spark() -> Tuple[Any, Any]:
+    """(SparkSession, SparkContext) of the ACTIVE session, or a clear error.
+
+    `.cpu()` builds JVM objects, so it only works where the JVM runs — inside
+    an application that already holds a SparkSession (reference
+    _get_spark_session contract, utils.py core)."""
+    try:
+        from pyspark.sql import SparkSession
+    except ImportError as e:
+        raise ImportError(
+            "model.cpu() requires pyspark (JVM model conversion); "
+            "pip install pyspark or run inside a Spark application"
+        ) from e
+    spark = SparkSession.getActiveSession()
+    if spark is None:
+        raise RuntimeError(
+            "model.cpu() needs an active SparkSession to reach the JVM; "
+            "create one first (SparkSession.builder.getOrCreate())"
+        )
+    return spark, spark.sparkContext
+
+
+def java_uid(sc, prefix: str) -> str:
+    return sc._jvm.org.apache.spark.ml.util.Identifiable.randomUID(prefix)
+
+
+def _java_double_array(sc, values) -> Any:
+    arr = sc._gateway.new_array(sc._jvm.double, len(values))
+    for i, v in enumerate(values):
+        arr[i] = float(v)
+    return arr
+
+
+def _impurity_calculator(sc, impurity: str, stats, raw_count: int):
+    jvm_imp = sc._jvm.org.apache.spark.mllib.tree.impurity
+    cls = {
+        "gini": jvm_imp.GiniCalculator,
+        "entropy": jvm_imp.EntropyCalculator,
+        "variance": jvm_imp.VarianceCalculator,
+    }[impurity]
+    return cls(_java_double_array(sc, stats), int(raw_count))
+
+
+def _build_java_node(sc, spec: Dict[str, Any], impurity: str):
+    tree_pkg = sc._jvm.org.apache.spark.ml.tree
+    calc = _impurity_calculator(sc, impurity, spec["stats"], spec["instance_count"])
+    if "split_feature" not in spec:
+        return tree_pkg.LeafNode(float(spec["prediction"]), float(spec["impurity"]), calc)
+    split = tree_pkg.ContinuousSplit(int(spec["split_feature"]), float(spec["threshold"]))
+    return tree_pkg.InternalNode(
+        float(spec["prediction"]),
+        float(spec["impurity"]),
+        float(spec["gain"]),
+        _build_java_node(sc, spec["left"], impurity),
+        _build_java_node(sc, spec["right"], impurity),
+        split,
+        calc,
+    )
+
+
+def rf_to_spark(model):
+    """Array forest -> pyspark.ml RandomForest{Classification,Regression}Model.
+
+    Classification note: Spark tree models predict label INDICES 0..k-1 (its
+    fit contract requires such labels), so exact prediction parity holds when
+    the TPU model was trained on 0..k-1 labels — the same contract the
+    reference's cuML-JSON conversion has."""
+    spark, sc = _require_spark()
+    is_clf = model._is_classification
+    impurity = str(
+        model._solver_params.get("split_criterion") or ("gini" if is_clf else "variance")
+    )
+    roots = [_build_java_node(sc, spec, impurity) for spec in forest_specs(model)]
+
+    if is_clf:
+        from pyspark.ml.classification import (
+            RandomForestClassificationModel as SparkRFClassificationModel,
+        )
+
+        uid = java_uid(sc, "rfc")
+        dt_cls = sc._jvm.org.apache.spark.ml.classification.DecisionTreeClassificationModel
+        dtrees = [dt_cls(uid, root, model.n_cols, model.numClasses) for root in roots]
+        java_trees = sc._gateway.new_array(dt_cls, len(dtrees))
+        for i, dt in enumerate(dtrees):
+            java_trees[i] = dt
+        java_model = sc._jvm.org.apache.spark.ml.classification.RandomForestClassificationModel(
+            uid, java_trees, model.n_cols, model.numClasses
+        )
+        py_model = SparkRFClassificationModel(java_model)
+        py_model.setProbabilityCol(model.getOrDefault("probabilityCol"))
+        py_model.setRawPredictionCol(model.getOrDefault("rawPredictionCol"))
+    else:
+        from pyspark.ml.regression import (
+            RandomForestRegressionModel as SparkRFRegressionModel,
+        )
+
+        uid = java_uid(sc, "rfr")
+        dt_cls = sc._jvm.org.apache.spark.ml.regression.DecisionTreeRegressionModel
+        dtrees = [dt_cls(uid, root, model.n_cols) for root in roots]
+        java_trees = sc._gateway.new_array(dt_cls, len(dtrees))
+        for i, dt in enumerate(dtrees):
+            java_trees[i] = dt
+        java_model = sc._jvm.org.apache.spark.ml.regression.RandomForestRegressionModel(
+            uid, java_trees, model.n_cols
+        )
+        py_model = SparkRFRegressionModel(java_model)
+    py_model.setFeaturesCol(model.getOrDefault("featuresCol"))
+    py_model.setPredictionCol(model.getOrDefault("predictionCol"))
+    return py_model
+
+
+def pca_to_spark(model):
+    """PCAModel -> pyspark.ml.feature.PCAModel (reference feature.py:365-379).
+
+    Spark's PCAModel.transform does NOT mean-center its input (pyspark.ml
+    semantics); the TPU model's transform does (solver semantics). The
+    converted model carries the same `pc`/`explainedVariance`, so projections
+    agree on centered data — identical to the reference's `.cpu()` behavior."""
+    from pyspark.ml.common import _py2java
+    from pyspark.ml.feature import PCAModel as SparkPCAModel
+    from pyspark.ml.linalg import DenseMatrix, Vectors
+
+    spark, sc = _require_spark()
+    pc = np.asarray(model.pc, dtype=np.float64)  # [d, k] columns = components
+    d, k = pc.shape
+    java_pc = _py2java(sc, DenseMatrix(d, k, pc.ravel(order="F").tolist(), False))
+    java_ev = _py2java(sc, Vectors.dense(np.asarray(model.explainedVariance, dtype=np.float64)))
+    java_model = sc._jvm.org.apache.spark.ml.feature.PCAModel(
+        java_uid(sc, "pca"), java_pc, java_ev
+    )
+    py_model = SparkPCAModel(java_model)
+    in_col = model.getOrDefault("inputCol") if model.isDefined("inputCol") else None
+    if in_col:
+        py_model.setInputCol(in_col)
+    py_model.setOutputCol(model._out_column_names()[0])
+    return py_model
+
+
+def linreg_to_spark(model):
+    """LinearRegressionModel -> pyspark.ml.regression.LinearRegressionModel
+    (reference regression.py:658-672)."""
+    from pyspark.ml.common import _py2java
+    from pyspark.ml.linalg import Vectors
+
+    spark, sc = _require_spark()
+    coef = _py2java(sc, Vectors.dense(np.asarray(model.coef_, dtype=np.float64).ravel()))
+    java_model = sc._jvm.org.apache.spark.ml.regression.LinearRegressionModel(
+        java_uid(sc, "linReg"), coef, float(model.intercept), 1.0
+    )
+    from pyspark.ml.regression import LinearRegressionModel as SparkLinearRegressionModel
+
+    py_model = SparkLinearRegressionModel(java_model)
+    py_model.setFeaturesCol(model.getOrDefault("featuresCol"))
+    py_model.setPredictionCol(model.getOrDefault("predictionCol"))
+    return py_model
+
+
+def logreg_to_spark(model):
+    """LogisticRegressionModel -> pyspark.ml.classification counterpart
+    (reference classification.py:1301-1323)."""
+    from pyspark.ml.common import _py2java
+    from pyspark.ml.classification import (
+        LogisticRegressionModel as SparkLogisticRegressionModel,
+    )
+    from pyspark.ml.linalg import DenseMatrix, Vectors
+
+    spark, sc = _require_spark()
+    coef = np.atleast_2d(np.asarray(model.coef_, dtype=np.float64))
+    k_rows, d = coef.shape
+    is_multinomial = len(model.classes_) > 2 or k_rows > 1
+    java_coef = _py2java(sc, DenseMatrix(k_rows, d, coef.ravel(order="F").tolist(), False))
+    java_intercept = _py2java(
+        sc, Vectors.dense(np.atleast_1d(np.asarray(model.intercept_, dtype=np.float64)))
+    )
+    java_model = sc._jvm.org.apache.spark.ml.classification.LogisticRegressionModel(
+        java_uid(sc, "logreg"), java_coef, java_intercept, len(model.classes_), is_multinomial
+    )
+    py_model = SparkLogisticRegressionModel(java_model)
+    py_model.setFeaturesCol(model.getOrDefault("featuresCol"))
+    py_model.setPredictionCol(model.getOrDefault("predictionCol"))
+    py_model.setProbabilityCol(model.getOrDefault("probabilityCol"))
+    py_model.setRawPredictionCol(model.getOrDefault("rawPredictionCol"))
+    return py_model

@@ -208,3 +208,78 @@ class CrossValidatorModel(Params):
 
     def transform(self, dataset: Any):
         return self.bestModel.transform(dataset)
+
+    # persistence: a composite directory — top-level metadata (metrics) plus
+    # nested per-model saves in each model's own format, restored by class
+    # dispatch. The reference round-trips CV models through pyspark's
+    # CrossValidatorModel writer (reference tuning.py:139-177); here every
+    # nested model reuses the framework's npz/JSON writer.
+    def write(self) -> "_CrossValidatorModelWriter":
+        return _CrossValidatorModelWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "CrossValidatorModel":
+        import json
+        import os
+
+        from .core import load_instance
+
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        best = load_instance(os.path.join(path, "bestModel"))
+        sub = None
+        if meta.get("numSubModelFolds"):
+            sub = [
+                [
+                    load_instance(os.path.join(path, "subModels", f"fold{i}", f"model{j}"))
+                    for j in range(meta["numSubModelsPerFold"])
+                ]
+                for i in range(meta["numSubModelFolds"])
+            ]
+        return cls(
+            bestModel=best,
+            avgMetrics=meta["avgMetrics"],
+            stdMetrics=meta["stdMetrics"],
+            subModels=sub,
+        )
+
+
+class _CrossValidatorModelWriter:
+    def __init__(self, instance: CrossValidatorModel) -> None:
+        self.instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_CrossValidatorModelWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        import json
+        import os
+
+        from .core import _prepare_save_path
+
+        inst = self.instance
+        if inst.bestModel is None:
+            raise ValueError("CrossValidatorModel has no bestModel to save")
+        _prepare_save_path(path, self._overwrite)
+        sub = inst.subModels
+        meta = {
+            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
+            "avgMetrics": [float(v) for v in inst.avgMetrics],
+            "stdMetrics": [float(v) for v in inst.stdMetrics],
+            "numSubModelFolds": len(sub) if sub else 0,
+            "numSubModelsPerFold": len(sub[0]) if sub else 0,
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        inst.bestModel.write().overwrite().save(os.path.join(path, "bestModel"))
+        if sub:
+            for i, fold_models in enumerate(sub):
+                for j, m in enumerate(fold_models):
+                    m.write().overwrite().save(
+                        os.path.join(path, "subModels", f"fold{i}", f"model{j}")
+                    )

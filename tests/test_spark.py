@@ -109,6 +109,217 @@ def _spark_train_body(it):
         }
 
 
+# -- Spark JVM model interop (`.cpu()`): reference utils.py:311-481 /
+# -- tree.py:524-569 / feature.py:365-379 parity -----------------------------
+
+
+def _rf_training_data(seed=0, n=300, d=6, classification=True):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    if classification:
+        y = ((x[:, 0] + 0.5 * x[:, 1] > 0).astype(int) + (x[:, 2] > 1.0)).astype(float)
+    else:
+        y = x[:, 0] * 2.0 - x[:, 3] + 0.1 * rng.normal(size=n)
+    return pd.DataFrame({"features": list(x), "label": y}), x
+
+
+def test_tree_spec_pure_layer():
+    """The py4j-free node-spec layer: structure and stats must be consistent
+    with the model's own predictions — runs WITHOUT pyspark."""
+    from spark_rapids_ml_tpu.models.classification import RandomForestClassifier
+    from spark_rapids_ml_tpu.models.regression import RandomForestRegressor
+    from spark_rapids_ml_tpu.spark_interop import forest_specs
+
+    df, x = _rf_training_data(classification=True)
+    clf = RandomForestClassifier(
+        numTrees=3, maxDepth=4, seed=7, float32_inputs=False
+    ).setFeaturesCol("features").fit(df)
+    specs = forest_specs(clf)
+    assert len(specs) == clf.num_trees
+
+    def walk(node, depth=0):
+        assert depth <= clf.max_depth
+        assert node["impurity"] >= 0 and node["instance_count"] > 0
+        assert len(node["stats"]) == clf.numClasses
+        assert node["prediction"] == float(np.argmax(node["stats"]))
+        if "split_feature" in node:
+            assert 0 <= node["split_feature"] < clf.n_cols
+            assert np.isfinite(node["threshold"])
+            # children partition the parent's instances
+            assert (
+                node["left"]["instance_count"] + node["right"]["instance_count"]
+                == node["instance_count"]
+            )
+            walk(node["left"], depth + 1)
+            walk(node["right"], depth + 1)
+
+    for spec in specs:
+        walk(spec)
+
+    # single-tree spec traversal must reproduce the model's own prediction
+    def spec_predict(node, row):
+        while "split_feature" in node:
+            node = node["left"] if row[node["split_feature"]] <= node["threshold"] else node["right"]
+        return node["prediction"]
+
+    votes = np.zeros((len(x), clf.numClasses))
+    for spec in specs:
+        for i, row in enumerate(x):
+            node = spec
+            while "split_feature" in node:
+                node = node["left"] if row[node["split_feature"]] <= node["threshold"] else node["right"]
+            s = np.asarray(node["stats"])
+            votes[i] += s / s.sum()
+    got = clf.classes_[np.argmax(votes, axis=1)]
+    want = clf.transform(df)["prediction"].to_numpy()
+    np.testing.assert_array_equal(got.astype(float), want)
+
+    # regression: leaf prediction = node mean; forest mean matches transform
+    dfr, xr = _rf_training_data(classification=False)
+    reg = RandomForestRegressor(
+        numTrees=3, maxDepth=4, seed=7, float32_inputs=False
+    ).setFeaturesCol("features").fit(dfr)
+    preds = np.zeros(len(xr))
+    for spec in forest_specs(reg):
+        preds += np.asarray([spec_predict(spec, row) for row in xr])
+    preds /= reg.num_trees
+    np.testing.assert_allclose(
+        preds, reg.transform(dfr)["prediction"].to_numpy(), rtol=1e-8, atol=1e-10
+    )
+
+
+def test_cpu_requires_pyspark_message():
+    """Without pyspark, .cpu() must raise a clear ImportError (not crash deep
+    in py4j)."""
+    try:
+        import pyspark  # noqa: F401
+
+        pytest.skip("pyspark installed; the gated parity tests cover .cpu()")
+    except ImportError:
+        pass
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    df, _ = _rf_training_data()
+    model = PCA(k=2, inputCol="features", float32_inputs=False).fit(df)
+    with pytest.raises(ImportError, match="pyspark"):
+        model.cpu()
+
+
+@pytest.fixture(scope="module")
+def spark_session():
+    pytest.importorskip("pyspark")
+    from pyspark.sql import SparkSession
+
+    spark = (
+        SparkSession.builder.master("local[2]")
+        .appName("srml-tpu-cpu-interop")
+        .getOrCreate()
+    )
+    yield spark
+    spark.stop()
+
+
+def _spark_predictions(spark, spark_model, x, cols):
+    from pyspark.ml.linalg import Vectors as SparkVectors
+
+    sdf = spark.createDataFrame(
+        [(SparkVectors.dense([float(v) for v in row]),) for row in x], ["features"]
+    )
+    rows = spark_model.transform(sdf).collect()
+    return {c: np.asarray([_to_np(r[c]) for r in rows]) for c in cols}
+
+
+def _to_np(v):
+    return v.toArray() if hasattr(v, "toArray") else v
+
+
+def test_rf_to_spark_model(spark_session):
+    """Fitted TPU RF -> genuine JVM RandomForestClassificationModel with
+    matching predictions (VERDICT round-4 item 4; reference tree.py:524-569)."""
+    from spark_rapids_ml_tpu.models.classification import RandomForestClassifier
+
+    df, x = _rf_training_data(classification=True)
+    model = RandomForestClassifier(
+        numTrees=5, maxDepth=5, seed=3, float32_inputs=False
+    ).setFeaturesCol("features").fit(df)
+    spark_model = model.cpu()
+    assert spark_model.getNumTrees == model.num_trees
+    assert spark_model.numFeatures == model.n_cols
+    assert spark_model.numClasses == model.numClasses
+
+    ours = model.transform(df)
+    got = _spark_predictions(
+        spark_session, spark_model, x, ["prediction", "probability"]
+    )
+    np.testing.assert_allclose(
+        got["prediction"], ours["prediction"].to_numpy(), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        got["probability"], np.stack(ours["probability"].to_list()), atol=1e-6
+    )
+
+
+def test_rf_regression_to_spark_model(spark_session):
+    from spark_rapids_ml_tpu.models.regression import RandomForestRegressor
+
+    df, x = _rf_training_data(classification=False)
+    model = RandomForestRegressor(
+        numTrees=5, maxDepth=5, seed=3, float32_inputs=False
+    ).setFeaturesCol("features").fit(df)
+    spark_model = model.cpu()
+    got = _spark_predictions(spark_session, spark_model, x, ["prediction"])
+    np.testing.assert_allclose(
+        got["prediction"], model.transform(df)["prediction"].to_numpy(), rtol=1e-6
+    )
+
+
+def test_pca_to_spark_model(spark_session):
+    """PCA -> JVM PCAModel: pc/explainedVariance carried exactly; projections
+    agree on centered inputs (Spark PCAModel does not mean-center)."""
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    df, x = _rf_training_data()
+    model = PCA(k=3, inputCol="features", outputCol="pca_out", float32_inputs=False).fit(df)
+    spark_model = model.cpu()
+    np.testing.assert_allclose(
+        np.asarray(spark_model.pc.toArray()), np.asarray(model.pc), rtol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(spark_model.explainedVariance.toArray()),
+        np.asarray(model.explainedVariance),
+        rtol=1e-10,
+    )
+    xc = x - np.asarray(model.mean)[None, :]
+    got = _spark_predictions(spark_session, spark_model, xc, ["pca_out"])
+    np.testing.assert_allclose(got["pca_out"], xc @ np.asarray(model.pc), atol=1e-8)
+
+
+def test_linear_models_to_spark(spark_session):
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.regression import LinearRegression
+
+    df, x = _rf_training_data(classification=False)
+    lin = LinearRegression(float32_inputs=False).setFeaturesCol("features").fit(df)
+    got = _spark_predictions(spark_session, lin.cpu(), x, ["prediction"])
+    np.testing.assert_allclose(
+        got["prediction"], lin.transform(df)["prediction"].to_numpy(), rtol=1e-6
+    )
+
+    dfc, xc = _rf_training_data(classification=True)
+    dfc["label"] = (dfc["label"] > 0).astype(float)  # binary 0/1
+    log = (
+        LogisticRegression(maxIter=200, tol=1e-12, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(dfc)
+    )
+    got = _spark_predictions(spark_session, log.cpu(), xc, ["prediction", "probability"])
+    ours = log.transform(dfc)
+    np.testing.assert_allclose(got["prediction"], ours["prediction"].to_numpy(), atol=1e-12)
+    np.testing.assert_allclose(
+        got["probability"], np.stack(ours["probability"].to_list()), atol=1e-6
+    )
+
+
 def test_pyspark_barrier_stage_fit(tmp_path):
     pyspark = pytest.importorskip("pyspark")
     from pyspark.sql import SparkSession

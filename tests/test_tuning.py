@@ -188,6 +188,57 @@ def test_cv_collect_sub_models(rng):
     assert all(len(fold_models) == 2 for fold_models in m.subModels)
 
 
+def test_cv_model_persistence_roundtrip(rng, tmp_path):
+    # reference parity: CV models save/load like every other model
+    # (reference tuning.py:139-177 round-trips through pyspark writers)
+    df = _cv_data(rng, n=80)
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.1]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid, evaluator=RegressionEvaluator(),
+        numFolds=2, collectSubModels=True, seed=3,
+    )
+    m = cv.fit(df)
+    path = str(tmp_path / "cv_model")
+    m.save(path)
+    with pytest.raises(FileExistsError):
+        m.save(path)
+    m.write().overwrite().save(path)  # overwrite lane
+
+    loaded = CrossValidatorModel.load(path)
+    np.testing.assert_allclose(loaded.avgMetrics, m.avgMetrics, rtol=1e-12)
+    np.testing.assert_allclose(loaded.stdMetrics, m.stdMetrics, rtol=1e-12)
+    np.testing.assert_allclose(
+        loaded.bestModel.coefficients, m.bestModel.coefficients, rtol=1e-12
+    )
+    assert loaded.subModels is not None and len(loaded.subModels) == 2
+    assert all(len(fold_models) == 2 for fold_models in loaded.subModels)
+    np.testing.assert_allclose(
+        loaded.subModels[1][1].coefficients, m.subModels[1][1].coefficients, rtol=1e-12
+    )
+    # loaded best model transforms identically
+    np.testing.assert_allclose(
+        loaded.transform(df)["prediction"].to_numpy(),
+        m.transform(df)["prediction"].to_numpy(),
+        rtol=1e-10,
+    )
+
+
+def test_cv_model_persistence_no_submodels(rng, tmp_path):
+    df = _cv_data(rng, n=60)
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0]).build()
+    m = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid, evaluator=RegressionEvaluator(), numFolds=2
+    ).fit(df)
+    assert m.subModels is None
+    path = str(tmp_path / "cv2")
+    m.save(path)
+    loaded = CrossValidatorModel.load(path)
+    assert loaded.subModels is None
+    np.testing.assert_allclose(loaded.avgMetrics, m.avgMetrics, rtol=1e-12)
+
+
 def test_fused_path_respects_evaluator_label_col(rng):
     df = _cv_data(rng, n=100).rename(columns={"label": "target"})
     lr = LinearRegression(float32_inputs=False, labelCol="target").setFeaturesCol("features")
