@@ -57,6 +57,39 @@ PROTOCOL = [
 ]
 
 
+def _run_protocol(rest) -> None:
+    """One process per config when --isolate is passed: a config that faults
+    the accelerator worker (observed: deep RF fits kill the axon TPU worker,
+    PROTOCOL_r03.md) or hangs cannot take the remaining configs down — the
+    same resilience contract as the reference's time-limited per-algo loop
+    (databricks/run_benchmark.sh:33-47) and the repo's bench.py."""
+    import os
+    import subprocess
+    import time
+
+    isolate = "--isolate" in rest
+    rest = [a for a in rest if a != "--isolate"]
+    time_limit = float(os.environ.get("BENCH_TIME_LIMIT", 3600))
+    for name, extra in PROTOCOL:
+        log(f"=== protocol: {name} {' '.join(extra)}")
+        # later flags win in argparse, so per-algo sizes in `extra` override
+        # the shared scale flags passed on the command line
+        if not isolate:
+            ALGORITHMS[name]().run(rest + extra)
+            continue
+        t0 = time.monotonic()
+        try:
+            rc = subprocess.run(
+                [sys.executable, "-m", "benchmark.benchmark_runner", name, *rest, *extra],
+                timeout=time_limit,
+            ).returncode
+        except subprocess.TimeoutExpired:
+            log(f"=== protocol: {name} TIMED OUT after {time_limit:.0f}s")
+            continue
+        if rc != 0:
+            log(f"=== protocol: {name} FAILED rc={rc} after {time.monotonic() - t0:.0f}s")
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
@@ -64,11 +97,7 @@ def main(argv=None) -> None:
         return
     algo, rest = argv[0], argv[1:]
     if algo == "protocol":
-        for name, extra in PROTOCOL:
-            log(f"=== protocol: {name} {' '.join(extra)}")
-            # later flags win in argparse, so per-algo sizes in `extra` override
-            # the shared scale flags passed on the command line
-            ALGORITHMS[name]().run(rest + extra)
+        _run_protocol(rest)
         return
     if algo not in ALGORITHMS:
         raise SystemExit(f"unknown algorithm {algo!r}; one of {sorted(set(ALGORITHMS))}")
