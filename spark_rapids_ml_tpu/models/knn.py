@@ -474,6 +474,14 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
                         self._solver_params["intermediate_graph_degree"]
                     ),
                     build_algo=str(self._solver_params["build_algo"]),
+                    nn_descent_niter=int(
+                        self._solver_params.get("nn_descent_niter", 0)
+                    ),
+                    cluster_reps=int(self._solver_params.get("cluster_reps", 8)),
+                    termination_threshold=float(
+                        self._solver_params.get("termination_threshold", 0.003)
+                    ),
+                    fast_score=bool(self._solver_params.get("fast_score", True)),
                     seed=0,
                 )
             else:
