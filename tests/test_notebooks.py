@@ -1,0 +1,29 @@
+#
+# Notebook smoke lane (reference ships notebooks/ and CI-checks them):
+# execute every notebook top-to-bottom on the CPU mesh. Slow (kernel startup
+# + full workflow), so nightly-gated like tests_large.
+#
+import os
+
+import pytest
+
+nbformat = pytest.importorskip("nbformat")
+pytest.importorskip("nbclient")
+
+HERE = os.path.dirname(__file__)
+NB_DIR = os.path.join(os.path.dirname(HERE), "notebooks")
+NOTEBOOKS = sorted(f for f in os.listdir(NB_DIR) if f.endswith(".ipynb"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", NOTEBOOKS)
+def test_notebook_executes(name):
+    from nbclient import NotebookClient
+
+    # the kernel is a fresh process: give it the repo import path and the
+    # same tunnel-env scrub the suite runs under
+    os.environ["PYTHONPATH"] = (
+        os.path.dirname(HERE) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    )
+    nb = nbformat.read(os.path.join(NB_DIR, name), as_version=4)
+    NotebookClient(nb, timeout=300, kernel_name="python3").execute()
