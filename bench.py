@@ -217,7 +217,10 @@ def main() -> None:
 
 
 def _attempt_loop(results: dict) -> None:
-    deadline = time.monotonic() + float(os.environ.get("BENCH_TOTAL_TIMEOUT", 3600 * 2.5))
+    # total budget DEFAULTS BELOW any plausible driver timeout: if the caller
+    # kills this process before emit(), the JSON contract is lost — 45 min
+    # fits ~4 full attempts at the protocol scale with backoff
+    deadline = time.monotonic() + float(os.environ.get("BENCH_TOTAL_TIMEOUT", 2700))
     for attempt in range(1, MAX_ATTEMPTS + 1):
         pending = [a for a in ALGOS if a not in results]
         if not pending:

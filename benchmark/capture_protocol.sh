@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+#
+# One-command protocol capture on the real chip (PROTOCOL_r{N} artifacts).
+# Usage: benchmark/capture_protocol.sh [round_tag]   (e.g. r05)
+#
+# Runs the full 10-config protocol with per-config process isolation and a
+# time limit (benchmark_runner --isolate), then walks the RandomForest
+# fallback ladder: the protocol config (50 trees / depth 13 / 128 bins at
+# 1M x 3k) first, then decreasing depths until one completes — recording the
+# deepest completing config (VERDICT r04 task 2; the axon TPU worker has
+# historically kernel-faulted on deep RF fits).
+#
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+TAG="${1:-r05}"
+CSV="PROTOCOL_${TAG}.csv"
+export BENCH_TIME_LIMIT="${BENCH_TIME_LIMIT:-2400}"
+
+echo "== protocol sweep -> ${CSV}"
+python -m benchmark.benchmark_runner protocol --isolate --report "${CSV}"
+
+echo "== RF protocol ladder (classification 50 trees, 128 bins, 1M x 3k)"
+for depth in 13 12 11 10; do
+  echo "== RF depth ${depth}"
+  if timeout "${BENCH_TIME_LIMIT}" python -m benchmark.benchmark_runner \
+      random_forest --task classification --num_rows 1000000 --num_cols 3000 \
+      --numTrees 50 --maxDepth "${depth}" --maxBins 128 --report "${CSV}"; then
+    echo "== RF depth ${depth} COMPLETED"
+    break
+  fi
+  echo "== RF depth ${depth} failed/faulted; stepping down"
+done
+
+echo "== done; rows:"
+cat "${CSV}"
