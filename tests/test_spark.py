@@ -188,6 +188,22 @@ def test_tree_spec_pure_layer():
     )
 
 
+def test_tree_spec_root_leaf():
+    # a forest whose gain bar blocks every split must convert to single
+    # LeafNode trees (the degenerate case the py4j builder must survive)
+    from spark_rapids_ml_tpu.models.classification import RandomForestClassifier
+    from spark_rapids_ml_tpu.spark_interop import forest_specs
+
+    df, _ = _rf_training_data(n=120)
+    clf = RandomForestClassifier(
+        numTrees=2, maxDepth=3, minInfoGain=1e9, seed=1, float32_inputs=False
+    ).setFeaturesCol("features").fit(df)
+    for spec in forest_specs(clf):
+        assert "split_feature" not in spec  # root is a leaf
+        assert spec["instance_count"] > 0
+        assert spec["prediction"] == float(np.argmax(spec["stats"]))
+
+
 def test_cpu_requires_pyspark_message():
     """Without pyspark, .cpu() must raise a clear ImportError (not crash deep
     in py4j)."""
