@@ -1,16 +1,16 @@
 //
 // Scala PCA estimator over the srml native kernels — the JVM API analog of
 // the reference's accelerated Spark-ML PCA (reference jvm/src/main/scala/org/
-// apache/spark/ml/feature/RapidsPCA.scala:72-166, which replaces the
-// covariance gemm + SVD with its JNI CUDA library). Design here: executors
-// reduce the covariance sufficient statistics with `treeAggregate` (each
-// partition accumulates X^T X and the weighted sum through SrmlNative), the
-// driver runs the native Jacobi eigensolver + sign canonicalization, and the
-// result is exposed with the same (pc, explainedVariance) model surface.
+// apache/spark/ml/feature/RapidsPCA.scala:72-166, which delegates the
+// covariance + SVD to RapidsRowMatrix over its JNI CUDA library). The same
+// structure here: TpuPCA.fit delegates to
+// TpuRowMatrix.computePrincipalComponentsAndExplainedVariance (distributed
+// sufficient stats through SrmlBlas, driver-side native eigensolve) and
+// exposes the (pc, explainedVariance) model surface.
 //
 package com.srmltpu.feature
 
-import com.srmltpu.linalg.SrmlNative
+import com.srmltpu.distributed.TpuRowMatrix
 
 import org.apache.spark.rdd.RDD
 
@@ -45,81 +45,9 @@ class TpuPCA(val k: Int) extends Serializable {
   /** Fit over an RDD of dense feature rows (all the same length d). */
   def fit(rows: RDD[Array[Double]]): TpuPCAModel = {
     val d = rows.first().length
-    val n = rows.count()
     require(k <= d, s"k ($k) must be <= feature dimension ($d)")
-
-    // sufficient statistics per partition: (sum x, X^T X flattened, count).
-    // Rows are buffered into multi-row blocks and handed to the native gram
-    // kernel ONE JNI call per block — a per-row seqOp would copy the full
-    // d*d accumulator (72 MB at d=3000) across the JNI boundary for every
-    // row, turning the fit into O(n*d^2) copy traffic.
-    val chunkRows = math.max(1, math.min(4096, (4 << 20) / d)) // ~32 MB block
-    val partStats = rows.mapPartitions { it =>
-      SrmlNative.ensureLoaded()
-      val s = new Array[Double](d)
-      val c = new Array[Double](d * d)
-      val buf = new Array[Double](chunkRows * d)
-      var cnt = 0L
-      var filled = 0
-      while (it.hasNext) {
-        val row = it.next()
-        System.arraycopy(row, 0, buf, filled * d, d)
-        var j = 0
-        while (j < d) { s(j) += row(j); j += 1 }
-        filled += 1
-        cnt += 1
-        if (filled == chunkRows) {
-          SrmlNative.covAccumulate(buf, filled.toLong, d.toLong, c)
-          filled = 0
-        }
-      }
-      if (filled > 0) SrmlNative.covAccumulate(buf, filled.toLong, d.toLong, c)
-      Iterator.single((s, c, cnt))
-    }
-    val (sumX, xtx, total) = partStats.treeReduce { case ((s1, c1, n1), (s2, c2, n2)) =>
-      var j = 0
-      while (j < d) { s1(j) += s2(j); j += 1 }
-      j = 0
-      while (j < d * d) { c1(j) += c2(j); j += 1 }
-      (s1, c1, n1 + n2)
-    }
-    require(total == n && total > 1, s"degenerate dataset: $total rows")
-
-    // covariance = (X^T X - n * mean mean^T) / (n - 1)
-    val mean = sumX.map(_ / total)
-    val cov = new Array[Double](d * d)
-    var i = 0
-    while (i < d) {
-      var j = 0
-      while (j < d) {
-        cov(i * d + j) = (xtx(i * d + j) - total * mean(i) * mean(j)) / (total - 1.0)
-        j += 1
-      }
-      i += 1
-    }
-
-    SrmlNative.ensureLoaded()
-    val evals = new Array[Double](d)
-    val evecs = new Array[Double](d * d)
-    val sweeps = SrmlNative.eighJacobi(cov, d.toLong, evals, evecs, 100, 1e-12)
-    require(sweeps >= 0, "eigensolver did not converge")
-
-    // top-k columns, descending eigenvalue; rows of `pc` are components
-    val pcFlat = new Array[Double](k * d)
-    val ev = new Array[Double](k)
-    var r = 0
-    while (r < k) {
-      val col = d - 1 - r // ascending -> take from the back
-      ev(r) = math.max(evals(col), 0.0)
-      var row = 0
-      while (row < d) { pcFlat(r * d + row) = evecs(row * d + col); row += 1 }
-      r += 1
-    }
-    SrmlNative.signFlip(pcFlat, k.toLong, d.toLong)
-
-    val totVar = evals.map(math.max(_, 0.0)).sum
-    val ratio = ev.map(v => if (totVar > 0) v / totVar else 0.0)
-    val pc = Array.tabulate(k)(r => pcFlat.slice(r * d, (r + 1) * d))
+    val matrix = new TpuRowMatrix(rows, d)
+    val (pc, ratio, mean) = matrix.computePrincipalComponentsAndExplainedVariance(k)
     TpuPCAModel(k, mean, pc, ratio)
   }
 }

@@ -1,0 +1,58 @@
+//
+// Typed Scala facade over the srml native kernels — the counterpart of the
+// reference's RAPIDSML.scala BLAS facade (reference jvm/src/main/scala/org/
+// apache/spark/ml/linalg/RAPIDSML.scala:38-155: typed cov/gemm/calSVD
+// wrappers over its JNI CUDA library). Callers (TpuRowMatrix, TpuPCA) use
+// these instead of raw SrmlNative entry points, so the JNI surface has one
+// owner and argument/layout contracts live in one place.
+//
+package com.srmltpu.linalg
+
+object SrmlBlas {
+
+  /** Eigendecomposition result: ascending eigenvalues, eigenvectors as
+    * COLUMNS of the row-major `evecs` [d, d] matrix. */
+  case class EighResult(evals: Array[Double], evecs: Array[Double], sweeps: Int)
+
+  /** Accumulate X^T X of a row-major block `x` [n, d] into `c` [d, d]
+    * (row-major, symmetric on completion of all blocks). One JNI call per
+    * multi-row block — never call per row (72 MB accumulator copy per call
+    * at d=3000). */
+  def covAccumulate(x: Array[Double], n: Long, d: Long, c: Array[Double]): Unit = {
+    SrmlNative.ensureLoaded()
+    require(x.length >= n * d, s"block too short: ${x.length} < ${n * d}")
+    require(c.length == d * d, s"accumulator must be d*d, got ${c.length}")
+    SrmlNative.covAccumulate(x, n, d, c)
+  }
+
+  /** Weighted column means of row-major `x` [n, d]; `w` may be null for
+    * unit weights. */
+  def weightedMean(x: Array[Double], w: Array[Double], n: Long, d: Long): Array[Double] = {
+    SrmlNative.ensureLoaded()
+    val mean = new Array[Double](d.toInt)
+    SrmlNative.weightedMean(x, w, n, d, mean)
+    mean
+  }
+
+  /** Cyclic-Jacobi symmetric eigendecomposition of row-major `a` [d, d].
+    * Throws if the sweep budget is exhausted before convergence. */
+  def eigh(a: Array[Double], d: Long, maxSweeps: Int = 100, tol: Double = 1e-12): EighResult = {
+    SrmlNative.ensureLoaded()
+    require(a.length == d * d, s"matrix must be d*d, got ${a.length}")
+    val evals = new Array[Double](d.toInt)
+    val evecs = new Array[Double]((d * d).toInt)
+    val sweeps = SrmlNative.eighJacobi(a, d, evals, evecs, maxSweeps, tol)
+    require(sweeps >= 0, s"eigensolver did not converge in $maxSweeps sweeps")
+    EighResult(evals, evecs, sweeps)
+  }
+
+  /** In-place sign canonicalization of `comps` [k, d] row-major component
+    * rows: the max-|.| element of each row is made positive (the
+    * deterministic-output convention shared with the Python layer and the
+    * reference's signFlip kernel). */
+  def signFlip(comps: Array[Double], k: Long, d: Long): Unit = {
+    SrmlNative.ensureLoaded()
+    require(comps.length == k * d, s"components must be k*d, got ${comps.length}")
+    SrmlNative.signFlip(comps, k, d)
+  }
+}
