@@ -210,6 +210,7 @@ class KMeansModel(_KMeansParams, _TpuModelWithColumns):
     """Fitted KMeans model (reference clustering.py:386-499)."""
 
     _matmul_precision = "BF16_BF16_F32_X3"
+    _spark_converter = "kmeans_to_spark"  # `.cpu()` (reference clustering.py:422-443)
 
     def __init__(
         self,

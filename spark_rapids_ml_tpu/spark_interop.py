@@ -223,6 +223,27 @@ def pca_to_spark(model):
     return py_model
 
 
+def kmeans_to_spark(model):
+    """KMeansModel -> pyspark.ml.clustering.KMeansModel via the mllib model
+    (reference clustering.py:422-443 — the JVM ml.KMeansModel has no public
+    centers constructor, so it wraps an mllib KMeansModel)."""
+    from pyspark.mllib.common import _py2java
+    from pyspark.mllib.linalg import _convert_to_vector
+    from pyspark.ml.clustering import KMeansModel as SparkKMeansModel
+
+    spark, sc = _require_spark()
+    centers = np.asarray(model.cluster_centers_, dtype=np.float64)
+    java_centers = _py2java(sc, [_convert_to_vector(c) for c in centers])
+    java_mllib_model = sc._jvm.org.apache.spark.mllib.clustering.KMeansModel(java_centers)
+    java_model = sc._jvm.org.apache.spark.ml.clustering.KMeansModel(
+        java_uid(sc, "kmeans"), java_mllib_model
+    )
+    py_model = SparkKMeansModel(java_model)
+    py_model.setFeaturesCol(model.getOrDefault("featuresCol"))
+    py_model.setPredictionCol(model.getOrDefault("predictionCol"))
+    return py_model
+
+
 def linreg_to_spark(model):
     """LinearRegressionModel -> pyspark.ml.regression.LinearRegressionModel
     (reference regression.py:658-672)."""

@@ -310,6 +310,20 @@ def test_pca_to_spark_model(spark_session):
     np.testing.assert_allclose(got["pca_out"], xc @ np.asarray(model.pc), atol=1e-8)
 
 
+def test_kmeans_to_spark_model(spark_session):
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    df, x = _rf_training_data(classification=False)
+    model = KMeans(k=4, seed=2, maxIter=20, float32_inputs=False).setFeaturesCol("features").fit(df)
+    spark_model = model.cpu()
+    got_centers = np.stack([np.asarray(c) for c in spark_model.clusterCenters()])
+    np.testing.assert_allclose(got_centers, np.asarray(model.cluster_centers_), rtol=1e-10)
+    got = _spark_predictions(spark_session, spark_model, x, ["prediction"])
+    np.testing.assert_array_equal(
+        got["prediction"], model.transform(df)["prediction"].to_numpy()
+    )
+
+
 def test_linear_models_to_spark(spark_session):
     from spark_rapids_ml_tpu.models.classification import LogisticRegression
     from spark_rapids_ml_tpu.models.regression import LinearRegression
