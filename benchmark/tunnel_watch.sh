@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+#
+# Watch the TPU tunnel; when it comes back, capture the round's on-chip
+# evidence automatically: bench.py (headline JSON), then the full protocol
+# sweep + RF ladder (capture_protocol.sh). Probe log: /tmp/tunnel_watch.log.
+#
+set -uo pipefail
+cd "$(dirname "$0")/.."
+TAG="${1:-r05}"
+for i in $(seq 1 "${2:-140}"); do
+  if timeout 120 python -c "import jax; print(jax.devices())" > /tmp/tunnel_watch.log 2>&1; then
+    echo "TUNNEL UP at probe $i ($(date -u +%H:%M:%S)): $(tail -1 /tmp/tunnel_watch.log)"
+    echo "== capturing bench.py"
+    BENCH_ATTEMPTS=3 python bench.py > "/tmp/bench_${TAG}_live.json" 2> "/tmp/bench_${TAG}_live.log"
+    echo "bench done: $(cat /tmp/bench_${TAG}_live.json)"
+    echo "== capturing protocol"
+    bash benchmark/capture_protocol.sh "${TAG}" > "/tmp/protocol_${TAG}.log" 2>&1
+    echo "protocol done; rows:"
+    cat "PROTOCOL_${TAG}.csv" 2>/dev/null
+    exit 0
+  fi
+  echo "probe $i down ($(date -u +%H:%M:%S))" >> /tmp/tunnel_watch_history.log
+  sleep 180
+done
+echo "TUNNEL STILL DOWN after all probes ($(date -u +%H:%M:%S))"
+exit 1
