@@ -1,0 +1,96 @@
+#
+# Fast unit parity for the padded-ELL sparse layer (ops/sparse.py) vs scipy —
+# the nightly 1e7-scale lane (test_large_sparse.py) certifies scale; this file
+# certifies the math across shapes, densities and edge cases.
+#
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+from spark_rapids_ml_tpu.ops.sparse import (
+    csr_to_ell,
+    ell_col_moments,
+    ell_matmul,
+    ell_matvec,
+    ell_rmatvec,
+)
+
+
+def _random_csr(rng, n, d, density, dtype=np.float32):
+    nnz_row = rng.binomial(d, density, size=n).astype(np.int64)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(nnz_row, out=indptr[1:])
+    total = int(indptr[-1])
+    indices = rng.integers(0, d, size=total).astype(np.int32)
+    data = rng.normal(size=total).astype(dtype)
+    x = sp.csr_matrix((data, indices, indptr), shape=(n, d))
+    x.sum_duplicates()
+    return x
+
+
+@pytest.mark.parametrize("n,d,density", [(200, 50, 0.1), (64, 8, 0.5), (500, 300, 0.01)])
+def test_ell_roundtrip_and_matmul_parity(rng, n, d, density):
+    x = _random_csr(rng, n, d, density)
+    indices, values, k_max = csr_to_ell(x)
+    assert indices.shape == values.shape == (n, k_max)
+    # densified ELL == densified CSR
+    dense = np.zeros((n, d), np.float32)
+    np.add.at(dense, (np.arange(n)[:, None].repeat(k_max, 1), indices), values)
+    np.testing.assert_allclose(dense, x.toarray(), atol=1e-7)
+
+    B = rng.normal(size=(d, 3)).astype(np.float32)
+    got = np.asarray(ell_matmul(jax.device_put(values), jax.device_put(indices), jax.device_put(B)))
+    np.testing.assert_allclose(got, x.toarray() @ B, rtol=1e-4, atol=1e-4)
+
+    b = B[:, 0]
+    got_v = np.asarray(ell_matvec(jax.device_put(values), jax.device_put(indices), jax.device_put(b)))
+    np.testing.assert_allclose(got_v, x.toarray() @ b, rtol=1e-4, atol=1e-4)
+
+    r = rng.normal(size=n).astype(np.float32)
+    got_r = np.asarray(ell_rmatvec(jax.device_put(values), jax.device_put(indices), jax.device_put(r), d))
+    np.testing.assert_allclose(got_r, x.toarray().T @ r, rtol=1e-4, atol=1e-4)
+
+
+def test_ell_col_moments_match_dense(rng):
+    x = _random_csr(rng, 300, 40, 0.15, dtype=np.float64)
+    w = rng.random(300)
+    indices, values, _ = csr_to_ell(x, dtype=np.float64)
+    tw, mean, var = ell_col_moments(
+        jax.device_put(values), jax.device_put(indices), jax.device_put(w), 40
+    )
+    dense = x.toarray()
+    np.testing.assert_allclose(float(tw), w.sum(), rtol=1e-12)
+    want_mean = (dense * w[:, None]).sum(0) / w.sum()
+    want_var = (dense**2 * w[:, None]).sum(0) / w.sum() - want_mean**2
+    np.testing.assert_allclose(np.asarray(mean), want_mean, rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(var), want_var, rtol=1e-9, atol=1e-12)
+
+
+def test_ell_edge_cases(rng):
+    # all-empty rows
+    x = sp.csr_matrix((5, 7), dtype=np.float32)
+    indices, values, k_max = csr_to_ell(x)
+    assert k_max == 1 and not values.any()
+    got = np.asarray(ell_matmul(values, indices, np.ones((7, 2), np.float32)))
+    np.testing.assert_array_equal(got, np.zeros((5, 2)))
+
+    # explicit k_max padding (the SPMD rendezvous-agreed width)
+    x2 = _random_csr(rng, 30, 10, 0.3)
+    i2, v2, km = csr_to_ell(x2, k_max=9)
+    assert km == 9 and i2.shape == (30, 9)
+    B = rng.normal(size=(10, 2)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ell_matmul(v2, i2, B)), x2.toarray() @ B, rtol=1e-4, atol=1e-5
+    )
+
+    # k_max smaller than the widest row must raise
+    wide = sp.csr_matrix(np.ones((2, 6), np.float32))
+    with pytest.raises(ValueError, match="k_max"):
+        csr_to_ell(wide, k_max=3)
+
+    # zero-row matrix
+    empty = sp.csr_matrix((0, 4), dtype=np.float32)
+    ie, ve, ke = csr_to_ell(empty)
+    assert ie.shape == (0, max(ke, 1))
