@@ -49,7 +49,7 @@ class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol):
     transform_queue_size = Param("transform_queue_size", "accepted, ignored (no analog)", TypeConverters.toFloat)
     a = Param("a", "embedding curve parameter a (derived from min_dist/spread if unset)", TypeConverters.identity)
     b = Param("b", "embedding curve parameter b (derived from min_dist/spread if unset)", TypeConverters.identity)
-    precomputed_knn = Param("precomputed_knn", "precomputed knn (unsupported)", TypeConverters.identity)
+    precomputed_knn = Param("precomputed_knn", "precomputed (knn_indices, knn_dists) pair", TypeConverters.identity)
     random_state = Param("random_state", "random seed", TypeConverters.identity)
     sample_fraction = Param("sample_fraction", "fraction of rows used for fit", TypeConverters.toFloat)
 
@@ -174,7 +174,14 @@ class UMAP(_UMAPParams, _TpuEstimator):
         if kwargs.get("metric") not in (None, "euclidean"):
             raise ValueError("only metric='euclidean' is supported in this build")
         if kwargs.get("precomputed_knn") is not None:
-            raise ValueError("precomputed_knn is not supported in this build")
+            # the reference's (knn_indices, knn_dists) pair (umap.py
+            # precomputed_knn -> cuML); validated against the fit rows at fit
+            pre = kwargs["precomputed_knn"]
+            if not (isinstance(pre, (tuple, list)) and len(pre) == 2):
+                raise ValueError(
+                    "precomputed_knn must be a (knn_indices, knn_dists) pair "
+                    "of [n, k] arrays (cuML/umap-learn convention)"
+                )
         if "init" in kwargs and kwargs["init"] not in ("spectral", "random"):
             raise ValueError(f"init must be 'spectral' or 'random', got {kwargs['init']!r}")
         return super()._set_params(**kwargs)
@@ -227,6 +234,15 @@ class UMAP(_UMAPParams, _TpuEstimator):
             local_devs = None
 
         sp = self._solver_params
+        pre_knn = sp.get("precomputed_knn")
+        if pre_knn is not None and (frac < 1.0 or spmd):
+            # the pair indexes the caller's row order; subsampling or the
+            # SPMD gather reorders rows out from under it (the reference has
+            # the same single-node constraint for precomputed graphs)
+            raise ValueError(
+                "precomputed_knn cannot be combined with sample_fraction < 1 "
+                "or a multi-process SPMD fit"
+            )
         n_dev = (
             len(local_devs) if local_devs is not None
             else min(self.num_workers, len(default_devices()))
@@ -250,6 +266,7 @@ class UMAP(_UMAPParams, _TpuEstimator):
                 a=sp["a"],
                 b=sp["b"],
                 random_state=sp["random_state"],
+                precomputed_knn=pre_knn,
             )
         model = UMAPModel(
             embedding_=state["embedding_"],

@@ -351,6 +351,23 @@ def default_n_epochs(n: int) -> int:
     return 500 if n <= 10000 else 200
 
 
+def _self_first(idx: np.ndarray, dist: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Normalize a kNN graph so column 0 is the point itself at distance 0
+    (ties can reorder equal-distance neighbors; precomputed graphs may omit
+    self entirely — then the farthest slot is sacrificed)."""
+    n, k = idx.shape
+    row = np.arange(n)
+    self_pos = np.argmax(idx == row[:, None], axis=1)
+    has_self = (idx == row[:, None]).any(axis=1)
+    for i in np.flatnonzero(~has_self):  # degenerate duplicates / no self
+        idx[i, -1] = i
+        dist[i, -1] = 0.0
+        self_pos[i] = k - 1
+    idx[row, self_pos], idx[:, 0] = idx[:, 0].copy(), row
+    dist[row, self_pos], dist[:, 0] = dist[:, 0].copy(), 0.0
+    return idx, dist
+
+
 def build_knn_graph(
     x: np.ndarray, n_neighbors: int, mesh, batch_queries: int = 4096
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -364,20 +381,8 @@ def build_knn_graph(
     X, w, _ = make_global_rows(mesh, xf)
     Q = device_put(xf)
     dist, idx = exact_knn(X, w > 0, Q, mesh=mesh, k=n_neighbors, batch_queries=batch_queries)
-    dist = np.array(dist, dtype=np.float32)  # writable copies: fixed up below
-    idx = np.array(idx)
-    # guarantee self in column 0 (ties can reorder equal-distance neighbors)
-    n = xf.shape[0]
-    row = np.arange(n)
-    self_pos = np.argmax(idx == row[:, None], axis=1)
-    has_self = (idx == row[:, None]).any(axis=1)
-    for i in np.flatnonzero(~has_self):  # degenerate duplicates: force self
-        idx[i, -1] = i
-        dist[i, -1] = 0.0
-        self_pos[i] = n_neighbors - 1
-    idx[row, self_pos], idx[:, 0] = idx[:, 0].copy(), row
-    dist[row, self_pos], dist[:, 0] = dist[:, 0].copy(), 0.0
-    return idx, dist
+    # writable copies: self-normalized below
+    return _self_first(np.array(idx), np.array(dist, dtype=np.float32))
 
 
 def categorical_intersection(
@@ -412,8 +417,14 @@ def umap_fit(
     a: Optional[float] = None,
     b: Optional[float] = None,
     random_state: Optional[int] = None,
+    precomputed_knn: Optional[Tuple[np.ndarray, np.ndarray]] = None,
 ) -> Dict[str, np.ndarray]:
-    """Full UMAP fit; returns {'embedding_': [n, c]} plus graph internals."""
+    """Full UMAP fit; returns {'embedding_': [n, c]} plus graph internals.
+
+    `precomputed_knn` is the reference's (knn_indices, knn_dists) pair
+    (umap.py `precomputed_knn` param → cuML): [n, >=k] arrays over THESE
+    rows; the graph build is skipped and the arrays are self-normalized and
+    truncated to k columns."""
     n = x.shape[0]
     k = min(n_neighbors, n)
     seed = int(random_state if random_state is not None else 0)
@@ -421,7 +432,20 @@ def umap_fit(
         a, b = find_ab_params(spread, min_dist)
     n_epochs = int(n_epochs) if n_epochs else default_n_epochs(n)
 
-    knn_idx, knn_dist = build_knn_graph(x, k, mesh)
+    if precomputed_knn is not None:
+        pre_idx, pre_dist = precomputed_knn
+        pre_idx = np.array(pre_idx)
+        pre_dist = np.array(pre_dist, dtype=np.float32)
+        if pre_idx.shape != pre_dist.shape or pre_idx.shape[0] != n or pre_idx.shape[1] < k:
+            raise ValueError(
+                f"precomputed_knn must be ([n, >=k], [n, >=k]) over the fit rows; "
+                f"got {pre_idx.shape}/{pre_dist.shape} for n={n}, k={k}"
+            )
+        # keep self if present anywhere, then truncate to the k nearest
+        knn_idx, knn_dist = _self_first(pre_idx, pre_dist)
+        knn_idx, knn_dist = knn_idx[:, :k], knn_dist[:, :k]
+    else:
+        knn_idx, knn_dist = build_knn_graph(x, k, mesh)
     rho, sigma = smooth_knn(jnp.asarray(knn_dist), local_connectivity)
     w = np.asarray(fuzzy_simplicial_set(
         jnp.asarray(knn_idx), jnp.asarray(knn_dist), rho, sigma, set_op_mix_ratio

@@ -34,6 +34,55 @@ def test_umap_fit_quality_trustworthiness():
     assert tw > 0.90, tw
 
 
+def test_umap_precomputed_knn_matches_builtin():
+    # the reference's precomputed_knn param (umap.py -> cuML). Handing the fit
+    # the IDENTICAL graph it would have built must reproduce the embedding
+    # exactly (the kNN stage is skipped, everything downstream is seeded);
+    # an sklearn-built exact graph (f64 vs f32 distance ties) must still give
+    # an embedding of the same quality.
+    from sklearn.manifold import trustworthiness
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    from spark_rapids_ml_tpu.ops.umap import build_knn_graph
+    from spark_rapids_ml_tpu.parallel import get_mesh
+    from spark_rapids_ml_tpu.parallel.mesh import dtype_scope
+
+    x, _ = _blobs(n=300)
+    base = UMAP(n_components=2, random_state=7).setFeaturesCol("features").fit(_df(x))
+
+    # same precision scope AND mesh as the fit (tie order is mesh-dependent)
+    with dtype_scope(np.float32):
+        idx, dist = build_knn_graph(x.astype(np.float32), 15, get_mesh())
+    pre = (
+        UMAP(n_components=2, random_state=7, precomputed_knn=(idx, dist))
+        .setFeaturesCol("features")
+        .fit(_df(x))
+    )
+    np.testing.assert_allclose(
+        np.asarray(pre.embedding_), np.asarray(base.embedding_), rtol=1e-5, atol=1e-5
+    )
+
+    sk_dist, sk_idx = SkNN(n_neighbors=15).fit(x).kneighbors(x)  # self in col 0
+    pre_sk = (
+        UMAP(n_components=2, random_state=7, precomputed_knn=(sk_idx, sk_dist))
+        .setFeaturesCol("features")
+        .fit(_df(x))
+    )
+    assert trustworthiness(x, np.asarray(pre_sk.embedding_), n_neighbors=15) > 0.90
+
+
+def test_umap_precomputed_knn_validation():
+    x, _ = _blobs(n=100)
+    with pytest.raises(ValueError, match="pair"):
+        UMAP(precomputed_knn=np.zeros((100, 15)))
+    bad = (np.zeros((50, 15), np.int64), np.zeros((50, 15)))
+    with pytest.raises(ValueError, match="precomputed_knn"):
+        UMAP(precomputed_knn=bad).setFeaturesCol("features").fit(_df(x))
+    good = (np.zeros((100, 15), np.int64), np.zeros((100, 15)))
+    with pytest.raises(ValueError, match="sample_fraction"):
+        UMAP(precomputed_knn=good, sample_fraction=0.5).setFeaturesCol("features").fit(_df(x))
+
+
 def test_umap_separates_blobs():
     from sklearn.metrics import silhouette_score
 
