@@ -398,12 +398,10 @@ class DBSCAN(_DBSCANParams, _TpuEstimator):
         self._set_params(**kwargs)
 
     def _set_params(self, **kwargs):
-        if kwargs.get("metric") == "precomputed":
+        if "metric" in kwargs and kwargs["metric"] not in ("euclidean", "cosine", "precomputed"):
             raise ValueError(
-                "the 'precomputed' metric is not supported; use sklearn/cuML directly"
+                f"metric must be 'euclidean', 'cosine' or 'precomputed', got {kwargs['metric']!r}"
             )
-        if "metric" in kwargs and kwargs["metric"] not in ("euclidean", "cosine"):
-            raise ValueError(f"metric must be 'euclidean' or 'cosine', got {kwargs['metric']!r}")
         if "algorithm" in kwargs and kwargs["algorithm"] not in ("brute", "rbc"):
             raise ValueError(f"algorithm must be 'brute' or 'rbc', got {kwargs['algorithm']!r}")
         return super()._set_params(**kwargs)

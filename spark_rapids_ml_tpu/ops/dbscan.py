@@ -58,7 +58,12 @@ def _pairwise_d2(q: jax.Array, x: jax.Array, metric: str) -> jax.Array:
     """Distance tile [tq, n]: squared euclidean, or cosine distance.
 
     Inputs are pre-normalized for cosine by `dbscan_fit`, so cosine distance
-    is 1 - q·xᵀ — both metrics ride the MXU."""
+    is 1 - q·xᵀ — both metrics ride the MXU. For "precomputed" the rows ARE
+    distances already (dbscan_fit hands each pass the matching column slice
+    of the user's distance matrix, padding columns with +huge), so the tile
+    is just `q` — no compute."""
+    if metric == "precomputed":
+        return q
     if metric == "cosine":
         return 1.0 - q @ x.T
     return jnp.sum(q * q, axis=1)[:, None] - 2.0 * (q @ x.T) + jnp.sum(x * x, axis=1)[None, :]
@@ -226,23 +231,42 @@ def dbscan_fit(
     """Full DBSCAN: returns (labels [n] int32 with -1 noise, optional core
     sample indices). Orchestrates the three jitted passes; the host round-trip
     between passes compacts the core subset so expansion is nc², not N².
+
+    metric="precomputed": `x_host` is the [n, n] distance matrix (sklearn/cuML
+    convention, raw distances vs `eps`). Each pass receives the matching
+    column slice of the matrix — the N² "distance" tiles become free reads
+    (see _pairwise_d2) and everything else is unchanged.
     """
     n, d = x_host.shape
     n_dev = mesh.devices.size
     x = np.ascontiguousarray(x_host, dtype=np.float32)
-    if metric == "cosine":
+    precomputed = metric == "precomputed"
+    if precomputed:
+        if n != d:
+            raise ValueError(f"precomputed metric needs a square distance matrix, got {n}x{d}")
+        eps2 = float(eps)
+    elif metric == "cosine":
         norms = np.linalg.norm(x, axis=1, keepdims=True)
         x = x / np.maximum(norms, 1e-12)
         eps2 = float(eps)
     elif metric == "euclidean":
         eps2 = float(eps) ** 2
     else:
-        raise ValueError(f"metric must be 'euclidean' or 'cosine', got {metric!r}")
+        raise ValueError(
+            f"metric must be 'euclidean', 'cosine' or 'precomputed', got {metric!r}"
+        )
 
     def pad_repl(a, multiple, fill=0.0):
         rem = (-a.shape[0]) % multiple
         if rem:
             a = np.pad(a, [(0, rem)] + [(0, 0)] * (a.ndim - 1), constant_values=fill)
+        return a
+
+    def pad_cols(a, width, fill=np.float32(1e30)):
+        # precomputed slices must stay column-aligned with the passes' valid
+        # masks; padded columns are masked, the fill is belt-and-braces
+        if a.shape[1] < width:
+            a = np.pad(a, [(0, 0), (0, width - a.shape[1])], constant_values=fill)
         return a
 
     tile = _tile_rows_for_budget(n, max_mbytes_per_batch)
@@ -257,6 +281,8 @@ def dbscan_fit(
     else:
         put = jax.device_put
     xp = pad_repl(x, n_dev)
+    if precomputed:
+        xp = pad_cols(xp, xp.shape[0])  # square: columns align with `valid`
     validp = np.arange(xp.shape[0]) < n
     X = put(xp)  # replicated
     valid = put(validp)
@@ -269,7 +295,9 @@ def dbscan_fit(
         labels = np.full(n, -1, np.int32)
         return labels, (core_idx if calc_core_sample_indices else None)
 
-    xc = pad_repl(x[core_idx], n_dev)
+    xc = pad_repl(x[np.ix_(core_idx, core_idx)] if precomputed else x[core_idx], n_dev)
+    if precomputed:
+        xc = pad_cols(xc, xc.shape[0])
     cvalidp = np.arange(xc.shape[0]) < nc
     Xc = put(xc)
     cvalid = put(cvalidp)
@@ -285,9 +313,16 @@ def dbscan_fit(
 
     core_labels_p = np.full(xc.shape[0], -1, np.int32)
     core_labels_p[:nc] = core_cluster
+    if precomputed:
+        # border pass rows must carry point-to-CORE distances, column-aligned
+        # with the (padded) core axis
+        xb = pad_cols(pad_repl(x[:, core_idx], n_dev), xc.shape[0])
+        X_border = put(xb)
+    else:
+        X_border = X
     labels = np.asarray(
         border_assign(
-            X, valid, Xc, cvalid, put(core_labels_p), eps2,
+            X_border, valid, Xc, cvalid, put(core_labels_p), eps2,
             mesh=mesh, metric=metric, tile_rows=tile,
         )
     )[:n].astype(np.int32)

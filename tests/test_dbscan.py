@@ -46,6 +46,36 @@ def test_dbscan_blobs_exact_sklearn(rng):
     )
 
 
+def test_dbscan_precomputed_metric(rng):
+    # metric="precomputed" (reference parity: cuML supports it): the features
+    # rows are the [n, n] distance matrix; must equal both the sklearn
+    # precomputed run and this implementation's own euclidean run
+    from scipy.spatial.distance import cdist
+    from sklearn.datasets import make_blobs
+
+    x, _ = make_blobs(n_samples=300, centers=3, cluster_std=0.6, random_state=5)
+    D = cdist(x, x)
+    model = (
+        DBSCAN(eps=0.8, min_samples=5, metric="precomputed")
+        .setFeaturesCol("features")
+        .fit(_df(D))
+    )
+    got = model.transform(_df(D))["prediction"].to_numpy()
+    _assert_equivalent(got, _sk_labels(D, 0.8, 5, metric="precomputed").labels_)
+
+    own = (
+        DBSCAN(eps=0.8, min_samples=5).setFeaturesCol("features").fit(_df(x))
+        .transform(_df(x))["prediction"].to_numpy()
+    )
+    np.testing.assert_array_equal(got, own)
+
+    # non-square matrix must raise
+    with pytest.raises(ValueError, match="square"):
+        DBSCAN(eps=0.5, min_samples=3, metric="precomputed").setFeaturesCol(
+            "features"
+        ).fit(_df(D[:, :10])).transform(_df(D[:, :10]))
+
+
 def test_dbscan_moons_and_noise(rng):
     from sklearn.datasets import make_moons
 
@@ -117,8 +147,7 @@ def test_dbscan_all_noise_and_single_cluster(rng):
 
 
 def test_dbscan_param_validation():
-    with pytest.raises(ValueError, match="precomputed"):
-        DBSCAN(metric="precomputed")
+    DBSCAN(metric="precomputed")  # supported (see test_dbscan_precomputed_metric)
     with pytest.raises(ValueError, match="metric"):
         DBSCAN(metric="manhattan")
     with pytest.raises(ValueError, match="algorithm"):
