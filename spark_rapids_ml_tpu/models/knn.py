@@ -369,6 +369,13 @@ class _ANNParams(_KNNParams):
             "build_algo": "ivf_pq",
             "graph_degree": 64,
             "intermediate_graph_degree": 128,
+            # cagra build knobs beyond the reference surface (ops/cagra.py):
+            # seeding reps / max descent rounds / cuVS-style update-rate
+            # termination / bf16 candidate scoring
+            "cluster_reps": 8,
+            "nn_descent_niter": 0,
+            "termination_threshold": 0.003,
+            "fast_score": True,
             # cagra search params (reference knn.py:933-938 SearchParams)
             "itopk_size": 64,
             "search_width": 1,
@@ -391,7 +398,9 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
     `algoParams` accepts the cuML/cuVS-style keys {"nlist", "nprobe", "M",
     "n_bits"} and the cagra keys {"build_algo", "graph_degree",
     "intermediate_graph_degree", "itopk_size", "search_width",
-    "max_iterations", "min_iterations", "num_random_samplings"}.
+    "max_iterations", "min_iterations", "num_random_samplings"} plus the
+    TPU-build knobs {"cluster_reps", "nn_descent_niter",
+    "termination_threshold", "fast_score"} (ops/cagra.py build_cagra).
     """
 
     def __init__(self, **kwargs: Any) -> None:
@@ -474,14 +483,12 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
                         self._solver_params["intermediate_graph_degree"]
                     ),
                     build_algo=str(self._solver_params["build_algo"]),
-                    nn_descent_niter=int(
-                        self._solver_params.get("nn_descent_niter", 0)
-                    ),
-                    cluster_reps=int(self._solver_params.get("cluster_reps", 8)),
+                    nn_descent_niter=int(self._solver_params["nn_descent_niter"]),
+                    cluster_reps=int(self._solver_params["cluster_reps"]),
                     termination_threshold=float(
-                        self._solver_params.get("termination_threshold", 0.003)
+                        self._solver_params["termination_threshold"]
                     ),
-                    fast_score=bool(self._solver_params.get("fast_score", True)),
+                    fast_score=bool(self._solver_params["fast_score"]),
                     seed=0,
                 )
             else:

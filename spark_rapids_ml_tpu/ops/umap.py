@@ -434,16 +434,29 @@ def umap_fit(
 
     if precomputed_knn is not None:
         pre_idx, pre_dist = precomputed_knn
-        pre_idx = np.array(pre_idx)
-        pre_dist = np.array(pre_dist, dtype=np.float32)
+        pre_idx = np.asarray(pre_idx)
+        pre_dist = np.asarray(pre_dist, dtype=np.float32)
         if pre_idx.shape != pre_dist.shape or pre_idx.shape[0] != n or pre_idx.shape[1] < k:
             raise ValueError(
                 f"precomputed_knn must be ([n, >=k], [n, >=k]) over the fit rows; "
                 f"got {pre_idx.shape}/{pre_dist.shape} for n={n}, k={k}"
             )
-        # keep self if present anywhere, then truncate to the k nearest
-        knn_idx, knn_dist = _self_first(pre_idx, pre_dist)
-        knn_idx, knn_dist = knn_idx[:, :k], knn_dist[:, :k]
+        # self in column 0 plus the k-1 NEAREST non-self entries — a plain
+        # swap-then-truncate would teleport the displaced column past k and
+        # silently drop each row's nearest neighbor whenever self was missing
+        # or sat at a column >= k. Augment with a -1-distance self column
+        # (beats every real distance), neutralize any user-supplied self
+        # duplicates at +inf, and keep the k best by a stable row sort.
+        rows = np.arange(n)
+        dist_m = np.where(pre_idx == rows[:, None], np.inf, pre_dist)
+        idx_aug = np.concatenate([rows[:, None].astype(pre_idx.dtype), pre_idx], axis=1)
+        dist_aug = np.concatenate(
+            [np.full((n, 1), -1.0, np.float32), dist_m.astype(np.float32)], axis=1
+        )
+        order = np.argsort(dist_aug, axis=1, kind="stable")[:, :k]
+        knn_idx = np.take_along_axis(idx_aug, order, axis=1)
+        knn_dist = np.take_along_axis(dist_aug, order, axis=1)
+        knn_dist[:, 0] = 0.0  # the augmented self column
     else:
         knn_idx, knn_dist = build_knn_graph(x, k, mesh)
     rho, sigma = smooth_knn(jnp.asarray(knn_dist), local_connectivity)

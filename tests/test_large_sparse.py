@@ -22,22 +22,13 @@ DENSITY = 0.001
 
 
 def _gen_sparse_classification(n, d, density, seed=0):
-    """O(nnz)-memory CSR generator. `scipy.sparse.random` is unusable at this
-    shape: sampling its n*d = 2.2e10 cell space without replacement
-    materializes index arrays orders of magnitude larger than the matrix
-    (observed host MemoryError). Per-row Binomial(d, density) nnz with
-    with-replacement column draws matches the density; the rare in-row
-    duplicate column just sums — harmless for the fit being certified."""
-    import scipy.sparse as sp
+    """Labeled sparse dataset over the shared O(nnz) generator
+    (tests/sparse_gen.py — see there for why scipy.sparse.random cannot be
+    used at this shape)."""
+    from tests.sparse_gen import random_csr
 
     rng = np.random.default_rng(seed)
-    nnz_row = rng.binomial(d, density, size=n).astype(np.int64)
-    indptr = np.zeros(n + 1, np.int64)
-    np.cumsum(nnz_row, out=indptr[1:])
-    total = int(indptr[-1])
-    indices = rng.integers(0, d, size=total).astype(np.int32)
-    data = rng.random(total, dtype=np.float32)
-    x = sp.csr_matrix((data, indices, indptr), shape=(n, d))
+    x = random_csr(rng, n, d, density)
     # DENSE coefficient support: at ~2.2 nnz/row, a sparse (d/10) support
     # leaves ~80% of rows with zero signal (label = coin flip) and caps
     # attainable accuracy near 0.6 — no solver could meet the bar below.

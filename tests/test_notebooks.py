@@ -17,13 +17,13 @@ NOTEBOOKS = sorted(f for f in os.listdir(NB_DIR) if f.endswith(".ipynb"))
 
 @pytest.mark.slow
 @pytest.mark.parametrize("name", NOTEBOOKS)
-def test_notebook_executes(name):
+def test_notebook_executes(name, monkeypatch):
     from nbclient import NotebookClient
 
-    # the kernel is a fresh process: give it the repo import path and the
-    # same tunnel-env scrub the suite runs under
-    os.environ["PYTHONPATH"] = (
-        os.path.dirname(HERE) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    # the kernel is a fresh process: give it the repo import path (scoped to
+    # this test — the kernel inherits the env; monkeypatch restores it)
+    monkeypatch.setenv(
+        "PYTHONPATH", os.path.dirname(HERE) + os.pathsep + os.environ.get("PYTHONPATH", "")
     )
     nb = nbformat.read(os.path.join(NB_DIR, name), as_version=4)
     NotebookClient(nb, timeout=300, kernel_name="python3").execute()

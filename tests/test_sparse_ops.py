@@ -19,13 +19,9 @@ from spark_rapids_ml_tpu.ops.sparse import (
 
 
 def _random_csr(rng, n, d, density, dtype=np.float32):
-    nnz_row = rng.binomial(d, density, size=n).astype(np.int64)
-    indptr = np.zeros(n + 1, np.int64)
-    np.cumsum(nnz_row, out=indptr[1:])
-    total = int(indptr[-1])
-    indices = rng.integers(0, d, size=total).astype(np.int32)
-    data = rng.normal(size=total).astype(dtype)
-    x = sp.csr_matrix((data, indices, indptr), shape=(n, d))
+    from tests.sparse_gen import random_csr
+
+    x = random_csr(rng, n, d, density, dtype=dtype, values="normal")
     x.sum_duplicates()
     return x
 

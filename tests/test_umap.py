@@ -70,6 +70,23 @@ def test_umap_precomputed_knn_matches_builtin():
     )
     assert trustworthiness(x, np.asarray(pre_sk.embedding_), n_neighbors=15) > 0.90
 
+    # WIDE + SELF-EXCLUDED pair (the advertised [n, >=k] contract): the k-1
+    # nearest non-self entries must survive normalization — regression for a
+    # swap-then-truncate bug that dropped every row's nearest neighbor
+    n = len(x)
+    rng2 = np.random.default_rng(0)
+    far_idx = rng2.integers(0, n, size=(n, 10))
+    wide_idx = np.concatenate([idx[:, 1:], far_idx], axis=1)  # no self column
+    wide_dist = np.concatenate([dist[:, 1:], np.full((n, 10), 1e6, np.float32)], axis=1)
+    pre_wide = (
+        UMAP(n_components=2, random_state=7, precomputed_knn=(wide_idx, wide_dist))
+        .setFeaturesCol("features")
+        .fit(_df(x))
+    )
+    np.testing.assert_allclose(
+        np.asarray(pre_wide.embedding_), np.asarray(base.embedding_), rtol=1e-5, atol=1e-5
+    )
+
 
 def test_umap_precomputed_knn_validation():
     x, _ = _blobs(n=100)
