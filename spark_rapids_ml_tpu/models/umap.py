@@ -36,7 +36,7 @@ class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol):
 
     n_neighbors = Param("n_neighbors", "size of the local neighborhood", TypeConverters.toFloat)
     n_components = Param("n_components", "embedding dimension", TypeConverters.toInt)
-    metric = Param("metric", "distance metric (euclidean)", TypeConverters.toString)
+    metric = Param("metric", "distance metric: 'euclidean' or 'cosine'", TypeConverters.toString)
     n_epochs = Param("n_epochs", "number of optimization epochs", TypeConverters.identity)
     learning_rate = Param("learning_rate", "initial embedding learning rate", TypeConverters.toFloat)
     init = Param("init", "embedding initialization: 'spectral' or 'random'", TypeConverters.toString)
@@ -171,8 +171,10 @@ class UMAP(_UMAPParams, _TpuEstimator):
         self._set_params(**kwargs)
 
     def _set_params(self, **kwargs):
-        if kwargs.get("metric") not in (None, "euclidean"):
-            raise ValueError("only metric='euclidean' is supported in this build")
+        if kwargs.get("metric") not in (None, "euclidean", "cosine"):
+            raise ValueError(
+                f"metric must be 'euclidean' or 'cosine', got {kwargs['metric']!r}"
+            )
         if kwargs.get("precomputed_knn") is not None:
             # the reference's (knn_indices, knn_dists) pair (umap.py
             # precomputed_knn -> cuML); validated against the fit rows at fit
@@ -267,6 +269,7 @@ class UMAP(_UMAPParams, _TpuEstimator):
                 b=sp["b"],
                 random_state=sp["random_state"],
                 precomputed_knn=pre_knn,
+                metric=str(sp["metric"]),
             )
         model = UMAPModel(
             embedding_=state["embedding_"],
@@ -368,6 +371,7 @@ class UMAPModel(_UMAPParams, _TpuModel):
                 a=self.a_,
                 b=self.b_,
                 random_state=sp["random_state"],
+                metric=str(sp["metric"]),
             )
         return pd.DataFrame(
             {"features": list(feats), self.getOutputCol(): list(emb)}

@@ -418,13 +418,26 @@ def umap_fit(
     b: Optional[float] = None,
     random_state: Optional[int] = None,
     precomputed_knn: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    metric: str = "euclidean",
 ) -> Dict[str, np.ndarray]:
     """Full UMAP fit; returns {'embedding_': [n, c]} plus graph internals.
 
     `precomputed_knn` is the reference's (knn_indices, knn_dists) pair
     (umap.py `precomputed_knn` param → cuML): [n, >=k] arrays over THESE
     rows; the graph build is skipped and the arrays are self-normalized and
-    truncated to k columns."""
+    truncated to k columns.
+
+    metric="cosine": rows are unit-normalized, the graph is built with the
+    euclidean kernel (identical neighbor RANKING on unit vectors) and the
+    stored distances become cosine distances via d_cos = d²/2 — so
+    smooth-kNN bandwidths live in the metric's own scale, umap-learn
+    semantics. Only the graph stage sees the metric; the layout SGD is
+    metric-free."""
+    if metric not in ("euclidean", "cosine"):
+        raise ValueError(f"metric must be 'euclidean' or 'cosine', got {metric!r}")
+    if metric == "cosine":
+        x = np.asarray(x, np.float32)
+        x = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
     n = x.shape[0]
     k = min(n_neighbors, n)
     seed = int(random_state if random_state is not None else 0)
@@ -459,6 +472,8 @@ def umap_fit(
         knn_dist[:, 0] = 0.0  # the augmented self column
     else:
         knn_idx, knn_dist = build_knn_graph(x, k, mesh)
+        if metric == "cosine":
+            knn_dist = (knn_dist * knn_dist) / 2.0  # unit rows: 1 - cosθ
     rho, sigma = smooth_knn(jnp.asarray(knn_dist), local_connectivity)
     w = np.asarray(fuzzy_simplicial_set(
         jnp.asarray(knn_idx), jnp.asarray(knn_dist), rho, sigma, set_op_mix_ratio
@@ -505,24 +520,32 @@ def umap_transform(
     a: float = 1.577,
     b: float = 0.895,
     random_state: Optional[int] = None,
+    metric: str = "euclidean",
 ) -> np.ndarray:
     """Embed NEW points against a fitted model: kNN into the training set,
     smooth-kNN weights, init at the weighted mean of neighbor embeddings, then
     a short optimization against the FROZEN training embedding (umap-learn
-    transform semantics)."""
+    transform semantics). metric="cosine" matches the fit-side convention
+    (unit-normalize both sides, d_cos = d²/2)."""
     from ..parallel.mesh import make_global_rows
     from .knn import exact_knn
 
+    x_new = np.ascontiguousarray(x_new, dtype=np.float32)
+    raw_data = np.ascontiguousarray(raw_data, dtype=np.float32)
+    if metric == "cosine":
+        x_new = x_new / np.maximum(np.linalg.norm(x_new, axis=1, keepdims=True), 1e-12)
+        raw_data = raw_data / np.maximum(
+            np.linalg.norm(raw_data, axis=1, keepdims=True), 1e-12
+        )
     n_new = x_new.shape[0]
     k = min(n_neighbors, raw_data.shape[0])
     seed = int(random_state if random_state is not None else 0)
 
-    X, w_mask, _ = make_global_rows(mesh, np.ascontiguousarray(raw_data, dtype=np.float32))
-    dist, idx = exact_knn(
-        X, w_mask > 0, jax.device_put(np.ascontiguousarray(x_new, dtype=np.float32)),
-        mesh=mesh, k=k,
-    )
+    X, w_mask, _ = make_global_rows(mesh, raw_data)
+    dist, idx = exact_knn(X, w_mask > 0, jax.device_put(x_new), mesh=mesh, k=k)
     dist = np.asarray(dist, np.float32)
+    if metric == "cosine":
+        dist = (dist * dist) / 2.0
     idx = np.asarray(idx)
 
     rho, sigma = smooth_knn(jnp.asarray(dist), local_connectivity)

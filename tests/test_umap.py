@@ -88,6 +88,38 @@ def test_umap_precomputed_knn_matches_builtin():
     )
 
 
+def test_umap_cosine_metric():
+    # angular clusters with wildly varying radii: cosine separates them,
+    # euclidean mixes them (radius dominates) — the metric must reach the
+    # graph stage, and transform must follow the same convention
+    from sklearn.manifold import trustworthiness
+    from sklearn.metrics import silhouette_score
+
+    rng = np.random.default_rng(3)
+    k_dirs = 4
+    dirs = rng.normal(size=(k_dirs, 16))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    lab = rng.integers(0, k_dirs, size=400)
+    radii = rng.uniform(0.1, 100.0, size=400)[:, None]
+    x = dirs[lab] * radii + 0.01 * rng.normal(size=(400, 16))
+
+    model = (
+        UMAP(n_components=2, metric="cosine", random_state=4)
+        .setFeaturesCol("features")
+        .fit(_df(x))
+    )
+    emb = np.asarray(model.embedding_)
+    assert silhouette_score(emb, lab) > 0.5
+    assert trustworthiness(x, emb, n_neighbors=15, metric="cosine") > 0.9
+
+    out = model.transform(_df(x[:50]))
+    emb_new = np.stack(out[model.getOutputCol()].to_list())
+    assert emb_new.shape == (50, 2) and np.isfinite(emb_new).all()
+
+    with pytest.raises(ValueError, match="metric"):
+        UMAP(metric="manhattan")
+
+
 def test_umap_precomputed_knn_validation():
     x, _ = _blobs(n=100)
     with pytest.raises(ValueError, match="pair"):
