@@ -407,6 +407,17 @@ class _RandomForestModel(_RandomForestParams, _TpuModelWithColumns):
     # RandomForest model (reference tree.py:524-569 _convert_to_java_trees)
     _spark_converter = "rf_to_spark"
 
+    def predictLeaf(self, value) -> float:
+        """Leaf indices for a feature vector, via the converted JVM model —
+        the reference delegates to `.cpu()` identically (tree.py:513-518)."""
+        from ..linalg import Vector
+
+        if isinstance(value, Vector):
+            from pyspark.ml.linalg import Vectors as SparkVectors
+
+            value = SparkVectors.dense(value.toArray().tolist())
+        return self.cpu().predictLeaf(value)
+
     def toDebugString(self) -> str:
         """Spark-style textual dump of the forest."""
         lines = [

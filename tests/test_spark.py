@@ -273,6 +273,9 @@ def test_rf_to_spark_model(spark_session):
     np.testing.assert_allclose(
         got["probability"], np.stack(ours["probability"].to_list()), atol=1e-6
     )
+    # predictLeaf delegates through the JVM model (reference tree.py:513-518)
+    leaves = model.predictLeaf(x[0])
+    assert np.asarray(leaves.toArray() if hasattr(leaves, "toArray") else leaves).shape[-1] == model.num_trees
 
 
 def test_rf_regression_to_spark_model(spark_session):
