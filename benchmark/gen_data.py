@@ -219,16 +219,36 @@ def gen_classification_host(n_rows: int, n_cols: int, n_classes: int = 2, seed: 
     return x, np.argmax(z, axis=1).astype(np.int64)
 
 
+def random_csr(rng, n_rows: int, n_cols: int, density: float, dtype=np.float32,
+               values: str = "uniform"):
+    """O(nnz)-memory CSR generator. `scipy.sparse.random` is unusable at
+    protocol scale: sampling its n*d cell space without replacement
+    materializes index arrays orders of magnitude larger than the matrix
+    (observed host MemoryError at 1e7 x 2200 on a 125 GB box). Per-row
+    Binomial(d, density) nnz with with-replacement column draws matches the
+    density; rare in-row duplicate columns sum — harmless for every consumer
+    here. `values` = "uniform" [0,1) or "normal"."""
+    import scipy.sparse as sp
+
+    nnz_row = rng.binomial(n_cols, density, size=n_rows).astype(np.int64)
+    indptr = np.zeros(n_rows + 1, np.int64)
+    np.cumsum(nnz_row, out=indptr[1:])
+    total = int(indptr[-1])
+    indices = rng.integers(0, n_cols, size=total).astype(np.int32)
+    if values == "normal":
+        data = rng.normal(size=total).astype(dtype)
+    else:
+        data = rng.random(total, dtype=np.float32).astype(dtype)
+    return sp.csr_matrix((data, indices, indptr), shape=(n_rows, n_cols))
+
+
 def gen_sparse_regression_host(
     n_rows: int, n_cols: int, density: float = 0.001, seed: int = 0, noise: float = 0.01
 ):
     """Sparse CSR regression set (reference gen_data_distributed.py
     SparseRegressionDataGen:581 analog)."""
-    import scipy.sparse as sp
-
-    rs = np.random.RandomState(seed)
-    x = sp.random(n_rows, n_cols, density=density, random_state=rs, format="csr", dtype=np.float32)
     rng = np.random.default_rng(seed)
+    x = random_csr(rng, n_rows, n_cols, density)
     coef = np.zeros(n_cols, dtype=np.float32)
     k = max(1, n_cols // 40)
     coef[:k] = rng.normal(size=k)

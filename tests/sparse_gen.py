@@ -1,26 +1,6 @@
 #
-# Shared O(nnz)-memory CSR generator for the sparse test lanes.
-# `scipy.sparse.random` is unusable at large shapes: sampling its n*d cell
-# space without replacement materializes index arrays orders of magnitude
-# larger than the matrix (observed host MemoryError at 1e7 x 2200 on a
-# 125 GB box). Per-row Binomial(d, density) nnz with with-replacement column
-# draws matches the density; rare in-row duplicate columns sum — harmless
-# for every consumer here.
+# Shared CSR generator for the sparse test lanes — delegates to the
+# benchmark's O(nnz) generator (benchmark/gen_data.py random_csr; see there
+# for why scipy.sparse.random cannot be used at scale).
 #
-import numpy as np
-import scipy.sparse as sp
-
-
-def random_csr(rng, n, d, density, dtype=np.float32, values="uniform"):
-    """[n, d] CSR with ~`density` fill; `values` = "uniform" [0,1) or
-    "normal"."""
-    nnz_row = rng.binomial(d, density, size=n).astype(np.int64)
-    indptr = np.zeros(n + 1, np.int64)
-    np.cumsum(nnz_row, out=indptr[1:])
-    total = int(indptr[-1])
-    indices = rng.integers(0, d, size=total).astype(np.int32)
-    if values == "normal":
-        data = rng.normal(size=total).astype(dtype)
-    else:
-        data = rng.random(total, dtype=np.float32).astype(dtype)
-    return sp.csr_matrix((data, indices, indptr), shape=(n, d))
+from benchmark.gen_data import random_csr  # noqa: F401
