@@ -118,6 +118,27 @@ class RandomForestClassificationModel(HasProbabilityCol, HasRawPredictionCol, _R
         dist = np.asarray(self._raw_forest_output(v[None, :]), dtype=np.float64)[0]
         return float(self.classes_[int(np.argmax(dist))])
 
+    def predictRaw(self, value):
+        """Summed per-tree normalized votes (Spark's RF raw prediction;
+        computed natively — the reference delegates to .cpu())."""
+        from ..linalg import DenseVector, Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        dist = np.asarray(self._raw_forest_output(v[None, :]), dtype=np.float64)[0]
+        return DenseVector(dist * self.num_trees)
+
+    def predictProbability(self, value):
+        from ..linalg import DenseVector, Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        dist = np.asarray(self._raw_forest_output(v[None, :]), dtype=np.float64)[0]
+        return DenseVector(dist / max(dist.sum(), 1e-30))
+
+    def evaluate(self, dataset):
+        """Evaluate on a dataset via the converted JVM model's summary
+        (reference classification.py:604-662)."""
+        return self.cpu().evaluate(dataset)
+
 
 class _LogisticRegressionParams(
     HasEnableSparseDataOptim,
@@ -494,6 +515,28 @@ class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
         v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
         _, prob = self._raw_prob(v[None, :])
         return DenseVector(prob[0])
+
+    def predictRaw(self, value):
+        """Raw margin scores per class (Spark surface; computed natively —
+        the reference delegates to .cpu(), classification.py:1559-1576)."""
+        from ..linalg import DenseVector, Vector
+
+        v = value.toArray() if isinstance(value, Vector) else np.asarray(value)
+        raw, _ = self._raw_prob(v[None, :])
+        return DenseVector(raw[0])
+
+    def evaluate(self, dataset):
+        """Evaluate on a dataset via the converted JVM model's summary (the
+        reference's exact behavior, classification.py:1592-1599)."""
+        return self.cpu().evaluate(dataset)
+
+    @property
+    def summary(self):
+        """No training summary is retained (reference parity,
+        classification.py:1550-1557)."""
+        raise RuntimeError(
+            f"No training summary available for this {type(self).__name__}"
+        )
 
     # -- fused CV path ------------------------------------------------------
     def _combine(self, models: List["LogisticRegressionModel"]) -> "LogisticRegressionModel":

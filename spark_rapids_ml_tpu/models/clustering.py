@@ -365,6 +365,18 @@ class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol)
     def getMaxMbytesPerBatch(self):
         return self.getOrDefault("max_mbytes_per_batch")
 
+    def getAlgorithm(self) -> str:
+        return self.getOrDefault("algorithm")
+
+    def setAlgorithm(self, value: str):
+        return self._set_params(algorithm=value)
+
+    def getCalcCoreSampleIndices(self) -> bool:
+        return self.getOrDefault("calc_core_sample_indices")
+
+    def setCalcCoreSampleIndices(self, value: bool):
+        return self._set_params(calc_core_sample_indices=value)
+
     def setFeaturesCol(self, value):
         return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
 

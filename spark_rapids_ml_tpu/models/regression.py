@@ -175,6 +175,9 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
     def setStandardization(self, value: bool) -> "LinearRegression":
         return self._set_params(standardization=value)
 
+    def setLoss(self, value: str) -> "LinearRegression":
+        return self._set_params(loss=value)
+
     def setFeaturesCol(self, value) -> "LinearRegression":
         return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)
 
@@ -286,6 +289,17 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
     @property
     def hasSummary(self) -> bool:
         return False
+
+    @property
+    def scale(self) -> float:
+        """Huber loss is unsupported (squaredError only); 1.0 for API
+        compatibility (reference regression.py:699-703)."""
+        return 1.0
+
+    def evaluate(self, dataset):
+        """Evaluate on a dataset via the converted JVM model's summary
+        (reference regression.py:711-715)."""
+        return self.cpu().evaluate(dataset)
 
     def setFeaturesCol(self, value) -> "LinearRegressionModel":
         return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)

@@ -156,6 +156,8 @@ def test_dbscan_param_validation():
     assert d.getEps() == 0.25
     assert d.getMinSamples() == 7
     assert d.solver_params["eps"] == 0.25
+    assert d.setAlgorithm("rbc").getAlgorithm() == "rbc"
+    assert d.setCalcCoreSampleIndices(False).getCalcCoreSampleIndices() is False
 
 
 def test_dbscan_fit_is_noop_and_persistence(tmp_path, rng):

@@ -137,6 +137,10 @@ def test_spark_model_surface(rng):
     assert p0 in (0.0, 1.0)
     pp = m.predictProbability(x[0])
     np.testing.assert_allclose(np.sum(pp.toArray()), 1.0, atol=1e-9)
+    raw = m.predictRaw(x[0]).toArray()
+    assert raw.shape == pp.toArray().shape and np.isfinite(raw).all()
+    with pytest.raises(RuntimeError, match="summary"):
+        m.summary
 
     dfm, xm, ym = _multi_data(rng, n=150)
     mm = LogisticRegression(float32_inputs=False).setFeaturesCol("features").fit(dfm)

@@ -135,6 +135,12 @@ def test_rf_single_vector_predict(rng):
     model = RandomForestClassifier(numTrees=10, maxDepth=5, seed=5).setFeaturesCol("features").fit(df)
     out = model.transform(df)
     assert model.predict(x[0]) == float(np.asarray(out["prediction"])[0])
+    # native raw/probability single-vector surface (reference delegates to cpu())
+    raw = model.predictRaw(x[0]).toArray()
+    np.testing.assert_allclose(raw, np.stack(out["rawPrediction"].to_list())[0], rtol=1e-6)
+    prob = model.predictProbability(x[0]).toArray()
+    np.testing.assert_allclose(prob.sum(), 1.0, atol=1e-9)
+    np.testing.assert_allclose(prob, np.stack(out["probability"].to_list())[0], rtol=1e-6)
 
     dfr, xr, yr = _reg_data(rng, n=150)
     mr = RandomForestRegressor(numTrees=10, maxDepth=5, seed=5).setFeaturesCol("features").fit(dfr)
