@@ -352,9 +352,15 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
 class _ANNParams(_KNNParams):
     algorithm = Param("algorithm", "ANN algorithm: 'ivfflat', 'ivfpq' or 'cagra'", TypeConverters.toString)
     algoParams = Param("algoParams", "algorithm-specific parameters dict", TypeConverters.identity)
+    metric = Param("metric", "distance metric: euclidean | sqeuclidean | cosine", TypeConverters.toString)
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {"k": "n_neighbors", "metric": "metric"}
 
     def _get_solver_params_default(self) -> Dict[str, Any]:
         return {
+            "metric": "euclidean",
             "n_neighbors": 5,
             "batch_queries": 1024,
             "n_lists": 64,
@@ -416,6 +422,13 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
                 f"algorithm {kwargs['algorithm']!r} not supported"
                 " (ivfflat | ivfpq | cagra)"
             )
+        if "metric" in kwargs and kwargs["metric"] not in (
+            "euclidean", "sqeuclidean", "cosine",
+        ):
+            raise ValueError(
+                f"metric {kwargs['metric']!r} not supported"
+                " (euclidean | sqeuclidean | cosine)"
+            )
         if "algoParams" in kwargs:
             ap = kwargs.pop("algoParams") or {}
             if "compression" in ap:
@@ -439,6 +452,22 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
     def setIdCol(self, value: str) -> "ApproximateNearestNeighbors":
         return self._set_params(idCol=value)
 
+    # reference accessor surface (knn.py:850-888)
+    def setAlgorithm(self, value: str) -> "ApproximateNearestNeighbors":
+        return self._set_params(algorithm=value)
+
+    def getAlgorithm(self) -> str:
+        return self.getOrDefault("algorithm")
+
+    def setAlgoParams(self, value: Dict[str, Any]) -> "ApproximateNearestNeighbors":
+        return self._set_params(algoParams=value)
+
+    def setMetric(self, value: str) -> "ApproximateNearestNeighbors":
+        return self._set_params(metric=value)
+
+    def getMetric(self) -> str:
+        return str(self._solver_params["metric"])
+
     def _get_tpu_fit_func(self, extracted):  # pragma: no cover - _fit_internal overridden
         raise NotImplementedError
 
@@ -451,6 +480,14 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
         feats = extracted.features
         if hasattr(feats, "todense"):
             feats = np.asarray(feats.todense())
+        if str(self._solver_params["metric"]) == "cosine":
+            # cosine rides the euclidean kernels on unit vectors (identical
+            # ranking); stored index vectors are normalized, searches
+            # normalize queries and convert distances (kneighbors)
+            feats = np.asarray(feats, np.float32)
+            feats = feats / np.maximum(
+                np.linalg.norm(feats, axis=1, keepdims=True), 1e-12
+            )
         algo = self.getOrDefault("algorithm")
         # index BUILD must not run at raw TPU bf16 (1-pass, ~3 digits — wrecks
         # quantizer training and recall), but the 3-pass mode's ~1e-6 relative
@@ -519,11 +556,17 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
 
     def _refine_exact(self, queries: np.ndarray, cand_idx: np.ndarray, k: int):
         """Exact re-rank of ADC candidates (cuVS refine): gather the candidate
-        item vectors and score true euclidean distances; −1 pads stay last."""
+        item vectors and score true euclidean distances; −1 pads stay last.
+        Under metric='cosine' both sides are unit-normalized (queries arrive
+        normalized from kneighbors; the stored item vectors are raw)."""
         items = self._item_extracted.features
         if hasattr(items, "todense"):
             items = np.asarray(items.todense())
         items = np.asarray(items, dtype=np.float64)
+        if str(self._solver_params["metric"]) == "cosine":
+            items = items / np.maximum(
+                np.linalg.norm(items, axis=1, keepdims=True), 1e-12
+            )
         q = np.asarray(queries, dtype=np.float64)
         safe = np.maximum(cand_idx, 0)
         cand = items[safe]  # [nq, k_adc, d]
@@ -575,10 +618,16 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
                 qcounts = [int(c) for c in rdv.allgather(str(len(query_ids)))]
                 query_ids = query_ids + sum(qcounts[: active.rank])
 
+        metric = str(self._solver_params["metric"])
         with dtype_scope(np.float32):
             queries = query_ex.features
             if hasattr(queries, "todense"):
                 queries = np.asarray(queries.todense())
+            if metric == "cosine":
+                queries = np.asarray(queries, np.float32)
+                queries = queries / np.maximum(
+                    np.linalg.norm(queries, axis=1, keepdims=True), 1e-12
+                )
             if spmd:
                 queries, q_offset = allgather_concat(
                     active.rendezvous, np.asarray(queries, dtype=np.float32)
@@ -633,6 +682,12 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
                     batch_queries=int(self._solver_params["batch_queries"]),
                 )
         dist = np.asarray(dist, dtype=np.float64)
+        # metric output conversion (monotone — safe before the SPMD merge):
+        # the kernels produce euclidean distances (on unit vectors for cosine)
+        if metric == "sqeuclidean":
+            dist = dist * dist
+        elif metric == "cosine":
+            dist = (dist * dist) / 2.0  # unit vectors: 1 - cosθ; inf pads stay inf
         idx = np.asarray(idx)
         indices = np.where(idx >= 0, item_ids[np.maximum(idx, 0)], -1)
         if spmd:

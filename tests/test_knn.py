@@ -252,6 +252,47 @@ def test_knn_empty_query_model_join(rng):
     assert list(joined0.columns) == list(joined.columns)
 
 
+def test_ann_metric_sqeuclidean_and_cosine(rng):
+    # reference ANN metric surface (knn.py:845-888): sqeuclidean = squared
+    # euclidean outputs; cosine = unit-normalized index/query with cosine
+    # distances, recall checked against sklearn's cosine kNN
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    item_df, query_df, items, queries = _item_query(rng, n_items=500, n_queries=30, d=12)
+    base = (
+        ApproximateNearestNeighbors(k=6, algoParams={"nlist": 8, "nprobe": 8})
+        .setInputCol("features").setIdCol("id")
+    )
+    _, _, knn_eu = base.fit(item_df).kneighbors(query_df)
+
+    sq = base.copy().setMetric("sqeuclidean")
+    assert sq.getMetric() == "sqeuclidean"
+    _, _, knn_sq = sq.fit(item_df).kneighbors(query_df)
+    d_eu = np.stack(knn_eu["distances"].to_list())
+    d_sq = np.stack(knn_sq["distances"].to_list())
+    np.testing.assert_allclose(d_sq, d_eu**2, rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.stack(knn_eu["indices"].to_list()), np.stack(knn_sq["indices"].to_list())
+    )
+
+    cos = (
+        ApproximateNearestNeighbors(k=6, metric="cosine", algoParams={"nlist": 8, "nprobe": 8})
+        .setInputCol("features").setIdCol("id")
+    )
+    _, _, knn_cos = cos.fit(item_df).kneighbors(query_df)
+    ours = np.stack(knn_cos["indices"].to_list())
+    d_cos = np.stack(knn_cos["distances"].to_list())
+    sk = SkNN(n_neighbors=6, metric="cosine").fit(items)
+    sk_dist, sk_idx = sk.kneighbors(queries)
+    recall = np.mean([len(set(a) & set(b)) / 6.0 for a, b in zip(ours, sk_idx)])
+    assert recall > 0.95, recall  # nprobe == nlist: exhaustive search
+    # cosine distances in the metric's own scale (1 - cos)
+    np.testing.assert_allclose(np.sort(d_cos[:, 0]), np.sort(sk_dist[:, 0]), atol=1e-5)
+
+    with pytest.raises(ValueError, match="metric"):
+        ApproximateNearestNeighbors(metric="manhattan")
+
+
 def test_cagra_recall_and_estimator(rng):
     # CAGRA graph ANN (reference knn.py:902-935, 1452-1481): NN-descent build
     # + greedy graph search must recover most true neighbors
