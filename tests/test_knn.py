@@ -289,6 +289,24 @@ def test_ann_metric_sqeuclidean_and_cosine(rng):
     # cosine distances in the metric's own scale (1 - cos)
     np.testing.assert_allclose(np.sort(d_cos[:, 0]), np.sort(sk_dist[:, 0]), atol=1e-5)
 
+    # the reference's cagra path REQUIRES metric="sqeuclidean"
+    # (knn.py:1267) — that exact configuration must work here too
+    cg = (
+        ApproximateNearestNeighbors(
+            k=6, algorithm="cagra", metric="sqeuclidean",
+            algoParams={"build_algo": "nn_descent", "itopk_size": 64},
+        )
+        .setInputCol("features").setIdCol("id")
+    )
+    _, _, knn_cg = cg.fit(item_df).kneighbors(query_df)
+    d_cg = np.stack(knn_cg["distances"].to_list())
+    i_cg = np.stack(knn_cg["indices"].to_list())
+    # squared-euclidean outputs: nearest distances match sklearn's squared
+    sk_eu = SkNN(n_neighbors=6).fit(items)
+    skd, _ = sk_eu.kneighbors(queries)
+    np.testing.assert_allclose(d_cg[:, 0], skd[:, 0] ** 2, rtol=1e-3, atol=1e-4)
+    assert (np.diff(d_cg, axis=1) >= -1e-5).all()
+
     with pytest.raises(ValueError, match="metric"):
         ApproximateNearestNeighbors(metric="manhattan")
 
