@@ -580,6 +580,14 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
     def _get_solver_params_default(self) -> Dict[str, Any]:
         return _ANNParams._get_solver_params_default(self)
 
+    # the reference mixes the accessor surface into the model too (knn.py
+    # params class shared by estimator and model)
+    def getAlgorithm(self) -> str:
+        return self._algorithm
+
+    def getMetric(self) -> str:
+        return str(self._solver_params["metric"])
+
     def kneighbors(self, query_df: Any) -> Tuple[Any, Any, Any]:
         """Under multi-process SPMD this is the reference's local-index +
         broadcast-query + global top-k merge (knn.py:1189-1261): each rank
