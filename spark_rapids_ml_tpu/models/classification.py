@@ -136,8 +136,11 @@ class RandomForestClassificationModel(HasProbabilityCol, HasRawPredictionCol, _R
 
     def evaluate(self, dataset):
         """Evaluate on a dataset via the converted JVM model's summary
-        (reference classification.py:604-662)."""
-        return self.cpu().evaluate(dataset)
+        (reference classification.py:604-662). Accepts framework datasets
+        (pandas/arrow/dict) or a Spark DataFrame."""
+        from ..spark_interop import as_spark_df
+
+        return self.cpu().evaluate(as_spark_df(dataset))
 
 
 class _LogisticRegressionParams(
@@ -527,8 +530,11 @@ class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
 
     def evaluate(self, dataset):
         """Evaluate on a dataset via the converted JVM model's summary (the
-        reference's exact behavior, classification.py:1592-1599)."""
-        return self.cpu().evaluate(dataset)
+        reference's exact behavior, classification.py:1592-1599). Accepts
+        framework datasets (pandas/arrow/dict) or a Spark DataFrame."""
+        from ..spark_interop import as_spark_df
+
+        return self.cpu().evaluate(as_spark_df(dataset))
 
     @property
     def summary(self):

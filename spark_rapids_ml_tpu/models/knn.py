@@ -439,8 +439,21 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
                 "nlist": "n_lists", "nprobe": "n_probes", "M": "pq_m",
                 "n_bits": "pq_n_bits", "refine_ratio": "refine_ratio",
             }
+            # REPLACE semantics (reference setAlgoParams resets the whole
+            # Param dict): keys a previous algoParams set revert to their
+            # defaults first, so config sweeps don't inherit stale knobs
+            defaults = self._get_solver_params_default()
+            for prev in getattr(self, "_algo_params_keys", ()):  # type: ignore[attr-defined]
+                if prev in defaults:
+                    self._solver_params[prev] = defaults[prev]
+                else:
+                    self._solver_params.pop(prev, None)
+            applied = set()
             for key, v in ap.items():
-                self._solver_params[mapped.get(key, key)] = v
+                solver_key = mapped.get(key, key)
+                self._solver_params[solver_key] = v
+                applied.add(solver_key)
+            self._algo_params_keys = applied
         return super()._set_params(**kwargs)
 
     def setK(self, value: int) -> "ApproximateNearestNeighbors":
@@ -484,10 +497,9 @@ class ApproximateNearestNeighbors(_ANNParams, _TpuEstimator):
             # cosine rides the euclidean kernels on unit vectors (identical
             # ranking); stored index vectors are normalized, searches
             # normalize queries and convert distances (kneighbors)
-            feats = np.asarray(feats, np.float32)
-            feats = feats / np.maximum(
-                np.linalg.norm(feats, axis=1, keepdims=True), 1e-12
-            )
+            from ..utils import unit_rows
+
+            feats = unit_rows(feats)
         algo = self.getOrDefault("algorithm")
         # index BUILD must not run at raw TPU bf16 (1-pass, ~3 digits — wrecks
         # quantizer training and recall), but the 3-pass mode's ~1e-6 relative
@@ -564,9 +576,9 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
             items = np.asarray(items.todense())
         items = np.asarray(items, dtype=np.float64)
         if str(self._solver_params["metric"]) == "cosine":
-            items = items / np.maximum(
-                np.linalg.norm(items, axis=1, keepdims=True), 1e-12
-            )
+            from ..utils import unit_rows
+
+            items = np.asarray(unit_rows(items), dtype=np.float64)
         q = np.asarray(queries, dtype=np.float64)
         safe = np.maximum(cand_idx, 0)
         cand = items[safe]  # [nq, k_adc, d]
@@ -632,10 +644,9 @@ class ApproximateNearestNeighborsModel(NearestNeighborsModel):
             if hasattr(queries, "todense"):
                 queries = np.asarray(queries.todense())
             if metric == "cosine":
-                queries = np.asarray(queries, np.float32)
-                queries = queries / np.maximum(
-                    np.linalg.norm(queries, axis=1, keepdims=True), 1e-12
-                )
+                from ..utils import unit_rows
+
+                queries = unit_rows(queries)
             if spmd:
                 queries, q_offset = allgather_concat(
                     active.rendezvous, np.asarray(queries, dtype=np.float32)

@@ -298,8 +298,11 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
 
     def evaluate(self, dataset):
         """Evaluate on a dataset via the converted JVM model's summary
-        (reference regression.py:711-715)."""
-        return self.cpu().evaluate(dataset)
+        (reference regression.py:711-715). Accepts framework datasets
+        (pandas/arrow/dict) or a Spark DataFrame."""
+        from ..spark_interop import as_spark_df
+
+        return self.cpu().evaluate(as_spark_df(dataset))
 
     def setFeaturesCol(self, value) -> "LinearRegressionModel":
         return self._set_params(featuresCol=value) if isinstance(value, str) else self._set_params(featuresCols=value)

@@ -409,14 +409,12 @@ class _RandomForestModel(_RandomForestParams, _TpuModelWithColumns):
 
     def predictLeaf(self, value) -> float:
         """Leaf indices for a feature vector, via the converted JVM model —
-        the reference delegates to `.cpu()` identically (tree.py:513-518)."""
-        from ..linalg import Vector
+        the reference delegates to `.cpu()` identically (tree.py:513-518).
+        Accepts any row representation (numpy, list, framework or pyspark
+        Vector) — py4j cannot marshal numpy arrays directly."""
+        from ..spark_interop import to_spark_vector
 
-        if isinstance(value, Vector):
-            from pyspark.ml.linalg import Vectors as SparkVectors
-
-            value = SparkVectors.dense(value.toArray().tolist())
-        return self.cpu().predictLeaf(value)
+        return self.cpu().predictLeaf(to_spark_vector(value))
 
     def toDebugString(self) -> str:
         """Spark-style textual dump of the forest."""

@@ -436,8 +436,9 @@ def umap_fit(
     if metric not in ("euclidean", "cosine"):
         raise ValueError(f"metric must be 'euclidean' or 'cosine', got {metric!r}")
     if metric == "cosine":
-        x = np.asarray(x, np.float32)
-        x = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        from ..utils import unit_rows
+
+        x = unit_rows(x)
     n = x.shape[0]
     k = min(n_neighbors, n)
     seed = int(random_state if random_state is not None else 0)
@@ -533,10 +534,10 @@ def umap_transform(
     x_new = np.ascontiguousarray(x_new, dtype=np.float32)
     raw_data = np.ascontiguousarray(raw_data, dtype=np.float32)
     if metric == "cosine":
-        x_new = x_new / np.maximum(np.linalg.norm(x_new, axis=1, keepdims=True), 1e-12)
-        raw_data = raw_data / np.maximum(
-            np.linalg.norm(raw_data, axis=1, keepdims=True), 1e-12
-        )
+        from ..utils import unit_rows
+
+        x_new = np.ascontiguousarray(unit_rows(x_new))
+        raw_data = np.ascontiguousarray(unit_rows(raw_data))
     n_new = x_new.shape[0]
     k = min(n_neighbors, raw_data.shape[0])
     seed = int(random_state if random_state is not None else 0)

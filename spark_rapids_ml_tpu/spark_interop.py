@@ -111,6 +111,39 @@ def java_uid(sc, prefix: str) -> str:
     return sc._jvm.org.apache.spark.ml.util.Identifiable.randomUID(prefix)
 
 
+def to_spark_vector(value):
+    """Any row representation (framework Vector, ndarray, list, pyspark
+    Vector) -> pyspark.ml.linalg Vector. py4j cannot marshal numpy arrays
+    (Pyrolite ClassDict pickling error), so every JVM-bound single-vector
+    call must cross through this."""
+    from pyspark.ml.linalg import Vector as SparkVector, Vectors as SparkVectors
+
+    if isinstance(value, SparkVector):
+        return value
+    if hasattr(value, "toArray"):
+        value = value.toArray()
+    return SparkVectors.dense([float(v) for v in np.asarray(value).ravel()])
+
+
+def as_spark_df(dataset):
+    """Any framework dataset (pandas DataFrame, pyarrow Table, dict, or an
+    actual Spark DataFrame) -> Spark DataFrame, with array/Vector cells
+    converted to pyspark Vectors. The JVM-summary paths (`model.evaluate`)
+    need a genuine Spark DataFrame; handing py4j a pandas frame dies in the
+    MLSerDe pickle branch."""
+    if hasattr(dataset, "sparkSession") and hasattr(dataset, "rdd"):
+        return dataset  # already a Spark DataFrame
+    from .data import as_pandas
+
+    spark, _ = _require_spark()
+    pdf = as_pandas(dataset).copy(deep=False)
+    for col in pdf.columns:
+        first = pdf[col].iloc[0] if len(pdf) else None
+        if isinstance(first, (list, tuple, np.ndarray)) or hasattr(first, "toArray"):
+            pdf[col] = pdf[col].map(to_spark_vector)
+    return spark.createDataFrame(pdf)
+
+
 def _java_double_array(sc, values) -> Any:
     arr = sc._gateway.new_array(sc._jvm.double, len(values))
     for i, v in enumerate(values):

@@ -30,6 +30,16 @@ def get_logger(cls_or_name, level: str = "INFO") -> logging.Logger:
     return logger
 
 
+def unit_rows(x: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    """Row-normalize to unit L2 norm with a zero-norm guard — THE cosine
+    convention shared by every cosine path (ANN index/query/refine, UMAP
+    fit/transform): zero rows stay zero (distance 1 to everything through
+    the 1 − cosθ formula, matching sklearn's handling closely enough for
+    ranking)."""
+    x = np.asarray(x, np.float32)
+    return x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), eps)
+
+
 def concat_and_free(chunks: List[np.ndarray]) -> np.ndarray:
     """Memory-frugal concat: frees source chunks as it copies
     (reference utils.py:213-252 `_concat_and_free`)."""

@@ -252,6 +252,18 @@ def test_knn_empty_query_model_join(rng):
     assert list(joined0.columns) == list(joined.columns)
 
 
+def test_ann_set_algo_params_replace_semantics():
+    # reference setAlgoParams REPLACES the param dict: keys a previous call
+    # set must revert to defaults, not linger across config sweeps
+    est = ApproximateNearestNeighbors(algoParams={"nlist": 32, "nprobe": 16})
+    assert est.solver_params["n_lists"] == 32 and est.solver_params["n_probes"] == 16
+    est.setAlgoParams({"nprobe": 4})
+    assert est.solver_params["n_probes"] == 4
+    assert est.solver_params["n_lists"] == 64  # back to the default
+    est.setAlgoParams({})
+    assert est.solver_params["n_probes"] == 8  # all defaults restored
+
+
 def test_ann_metric_sqeuclidean_and_cosine(rng):
     # reference ANN metric surface (knn.py:845-888): sqeuclidean = squared
     # euclidean outputs; cosine = unit-normalized index/query with cosine
