@@ -252,6 +252,32 @@ def test_knn_empty_query_model_join(rng):
     assert list(joined0.columns) == list(joined.columns)
 
 
+def test_cagra_early_exit_triggers(rng, monkeypatch):
+    # with an absurd threshold every round is "converged": the update-rate
+    # early exit must cut the descent far short of the 14-round random-init max
+    import spark_rapids_ml_tpu.ops.cagra as cg
+
+    calls = []
+    orig = cg._descent_round
+
+    def spy(*a, **k):
+        out = orig(*a, **k)
+        calls.append(int(out[2]))
+        return out
+
+    monkeypatch.setattr(cg, "_descent_round", spy)
+    x = rng.normal(size=(600, 8)).astype(np.float32)
+    idx = cg.build_cagra(x, build_algo="nn_descent", termination_threshold=1.0, seed=0)
+    assert len(calls) < 14, calls
+    assert np.asarray(idx["graph"]).shape[0] == 600
+
+    # threshold 0 (never converged by the bar): runs the full schedule
+    calls.clear()
+    cg.build_cagra(x, build_algo="nn_descent", termination_threshold=0.0, seed=0,
+                   nn_descent_niter=5)
+    assert len(calls) == 5
+
+
 def test_ann_set_algo_params_replace_semantics():
     # reference setAlgoParams REPLACES the param dict: keys a previous call
     # set must revert to defaults, not linger across config sweeps
