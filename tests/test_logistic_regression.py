@@ -34,6 +34,34 @@ def _multi_data(rng, n=600, d=5, k=3):
     return pd.DataFrame({"features": list(x.astype(np.float64)), "label": y.astype(np.float64)}), x, y
 
 
+def test_multinomial_many_classes_vs_sklearn(rng):
+    # 20-class softmax: intercept centering, per-class coef recovery and
+    # accuracy parity must hold well beyond the small-k tests
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    df, x, y = _multi_data(rng, n=3000, d=12, k=20)
+    model = (
+        LogisticRegression(maxIter=300, regParam=0.01, tol=1e-10, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    assert model.numClasses == 20
+    assert np.asarray(model.coefficientMatrix).shape == (20, 12)
+    # softmax shift invariance: intercepts are centered (Spark parity)
+    np.testing.assert_allclose(np.mean(np.asarray(model.interceptVector)), 0.0, atol=1e-8)
+
+    sk = SkLR(C=1.0 / (3000 * 0.01), max_iter=2000, tol=1e-10).fit(x, y)
+    ours = model.transform(df)["prediction"].to_numpy()
+    acc_ours = (ours == y).mean()
+    acc_sk = (sk.predict(x) == y).mean()
+    assert acc_ours >= acc_sk - 0.01, (acc_ours, acc_sk)
+    # probabilities agree in aggregate (same regularized optimum)
+    probs = np.stack(model.transform(df)["probability"].to_list())
+    np.testing.assert_allclose(
+        probs.mean(axis=0), sk.predict_proba(x).mean(axis=0), atol=5e-3
+    )
+
+
 def test_binomial_vs_sklearn(rng):
     from sklearn.linear_model import LogisticRegression as SkLR
 
