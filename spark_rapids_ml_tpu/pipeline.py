@@ -1,0 +1,121 @@
+#
+# Pipeline / PipelineModel — the pyspark.ml.Pipeline contract for chaining
+# this framework's estimators and transformers without a Spark session.
+# (The reference's estimators plug into pyspark's own Pipeline; outside
+# Spark that class cannot drive them, so the framework carries the minimal
+# equivalent: fit chains stage-by-stage, transformers pass through, the
+# fitted PipelineModel transforms in sequence and persists like
+# CrossValidatorModel — a composite directory restored by class dispatch.)
+#
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .params import Params
+
+
+def _is_estimator(stage: Any) -> bool:
+    return hasattr(stage, "fit")
+
+
+class Pipeline(Params):
+    """Chain of stages; estimators are fit on the running transform of the
+    input, transformers (fitted models) are applied as-is (pyspark.ml
+    semantics: a transformer stage transforms the data seen by later
+    stages).
+
+    >>> model = Pipeline(stages=[pca, lr]).fit(df)
+    >>> out = model.transform(df)
+    """
+
+    def __init__(self, stages: Optional[List[Any]] = None) -> None:
+        super().__init__()
+        self._stages: List[Any] = list(stages or [])
+
+    def getStages(self) -> List[Any]:
+        return self._stages
+
+    def setStages(self, value: List[Any]) -> "Pipeline":
+        self._stages = list(value)
+        return self
+
+    def fit(self, dataset: Any) -> "PipelineModel":
+        if not self._stages:
+            raise ValueError("Pipeline has no stages")
+        df = dataset
+        fitted: List[Any] = []
+        for i, stage in enumerate(self._stages):
+            if _is_estimator(stage):
+                model = stage.fit(df)
+            elif hasattr(stage, "transform"):
+                model = stage
+            else:
+                raise TypeError(f"stage {i} ({type(stage).__name__}) is neither estimator nor transformer")
+            fitted.append(model)
+            if i < len(self._stages) - 1:  # the last stage's output is unused
+                df = model.transform(df)
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Params):
+    def __init__(self, stages: Optional[List[Any]] = None) -> None:
+        super().__init__()
+        self.stages: List[Any] = list(stages or [])
+
+    def transform(self, dataset: Any):
+        df = dataset
+        for stage in self.stages:
+            df = stage.transform(df)
+        return df
+
+    # persistence: composite directory, one sub-save per stage (the same
+    # shape as CrossValidatorModel), restored by class dispatch
+    def write(self) -> "_PipelineModelWriter":
+        return _PipelineModelWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        import json
+        import os
+
+        from .core import load_instance
+
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        stages = [
+            load_instance(os.path.join(path, f"stage{i}"))
+            for i in range(meta["numStages"])
+        ]
+        return cls(stages=stages)
+
+
+class _PipelineModelWriter:
+    def __init__(self, instance: PipelineModel) -> None:
+        self.instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_PipelineModelWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        import json
+        import os
+
+        from .core import _prepare_save_path
+
+        inst = self.instance
+        if not inst.stages:
+            raise ValueError("PipelineModel has no stages to save")
+        _prepare_save_path(path, self._overwrite)
+        meta = {
+            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
+            "numStages": len(inst.stages),
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        for i, stage in enumerate(inst.stages):
+            stage.write().overwrite().save(os.path.join(path, f"stage{i}"))

@@ -1,0 +1,71 @@
+#
+# Pipeline / PipelineModel tests — the pyspark.ml.Pipeline contract driven
+# without a Spark session (chained fit/transform, composite persistence).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.linalg import Vectors
+from spark_rapids_ml_tpu.models.classification import LogisticRegression
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.pipeline import Pipeline, PipelineModel
+
+
+def _data(rng, n=400, d=10):
+    x = rng.normal(size=(n, d))
+    # anisotropic: the label-carrying dimensions dominate the variance, so a
+    # k=4 PCA stage keeps the signal (isotropic features would rotate it away)
+    x[:, 0] *= 6.0
+    x[:, 1] *= 4.0
+    y = (x[:, 0] / 6.0 + 0.5 * x[:, 1] / 4.0 > 0).astype(float)
+    return pd.DataFrame({"features": [Vectors.dense(r) for r in x], "label": y}), x, y
+
+
+def test_pipeline_pca_then_logreg(rng, tmp_path):
+    df, x, y = _data(rng)
+    pca = PCA(k=4, inputCol="features", outputCol="pca_features", float32_inputs=False)
+    lr = (
+        LogisticRegression(maxIter=100, regParam=0.01, float32_inputs=False)
+        .setFeaturesCol("pca_features")
+    )
+    model = Pipeline(stages=[pca, lr]).fit(df)
+    assert isinstance(model, PipelineModel) and len(model.stages) == 2
+
+    out = model.transform(df)
+    assert {"pca_features", "prediction", "probability"} <= set(out.columns)
+    acc = (out["prediction"].to_numpy() == y).mean()
+    assert acc > 0.9, acc
+
+    # manual chaining must match exactly
+    pca_model = pca.fit(df)
+    lr_model = lr.fit(pca_model.transform(df))
+    manual = lr_model.transform(pca_model.transform(df))["prediction"].to_numpy()
+    np.testing.assert_array_equal(out["prediction"].to_numpy(), manual)
+
+    # persistence round-trip through the composite writer + class dispatch
+    path = str(tmp_path / "pipe")
+    model.save(path)
+    with pytest.raises(FileExistsError):
+        model.save(path)
+    loaded = PipelineModel.load(path)
+    np.testing.assert_array_equal(
+        loaded.transform(df)["prediction"].to_numpy(), out["prediction"].to_numpy()
+    )
+
+
+def test_pipeline_transformer_stage_passthrough(rng):
+    # a FITTED model mixed into the stage list acts as a transformer
+    df, x, y = _data(rng, n=200)
+    pca_model = PCA(k=3, inputCol="features", outputCol="p", float32_inputs=False).fit(df)
+    lr = LogisticRegression(maxIter=50, float32_inputs=False).setFeaturesCol("p")
+    model = Pipeline(stages=[pca_model, lr]).fit(df)
+    out = model.transform(df)
+    assert "prediction" in out.columns and len(out) == 200
+
+
+def test_pipeline_validation():
+    with pytest.raises(ValueError, match="stages"):
+        Pipeline().fit(pd.DataFrame({"features": []}))
+    with pytest.raises(TypeError, match="stage 0"):
+        Pipeline(stages=[object()]).fit(pd.DataFrame({"features": []}))
