@@ -39,6 +39,25 @@ class Pipeline(Params):
         self._stages = list(value)
         return self
 
+    def copy(self, extra: Optional[dict] = None) -> "Pipeline":
+        """Copy with `extra` param overrides ROUTED TO THE OWNING STAGE
+        (pyspark Pipeline.copy semantics) — this is what lets
+        CrossValidator(estimator=Pipeline(...)) sweep a stage's params
+        through the fallback fit-per-model path."""
+        extra = dict(extra or {})
+        stages = []
+        for s in self._stages:
+            if hasattr(s, "copy") and hasattr(s, "hasParam"):
+                own = {
+                    p: v
+                    for p, v in extra.items()
+                    if s.hasParam(getattr(p, "name", str(p)))
+                }
+                stages.append(s.copy(own))
+            else:
+                stages.append(s)
+        return Pipeline(stages=stages)
+
     def fit(self, dataset: Any) -> "PipelineModel":
         if not self._stages:
             raise ValueError("Pipeline has no stages")

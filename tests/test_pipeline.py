@@ -64,6 +64,33 @@ def test_pipeline_transformer_stage_passthrough(rng):
     assert "prediction" in out.columns and len(out) == 200
 
 
+def test_cross_validator_over_pipeline(rng):
+    # the standard pyspark workflow: CV sweeping a stage param of a Pipeline
+    # (takes the fallback fit-per-model path; Pipeline.copy routes the grid
+    # entry to the stage that owns the param)
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    df, x, y = _data(rng, n=240)
+    lr = LogisticRegression(maxIter=60, float32_inputs=False).setFeaturesCol("pca_features")
+    pipe = Pipeline(stages=[
+        PCA(k=4, inputCol="features", outputCol="pca_features", float32_inputs=False),
+        lr,
+    ])
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.001, 1.0]).build()
+    cv = CrossValidator(
+        estimator=pipe, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=2, seed=1,
+    )
+    cv_model = cv.fit(df)
+    assert len(cv_model.avgMetrics) == 2
+    # tiny regularization must win on separable data
+    assert int(np.argmax(cv_model.avgMetrics)) == 0
+    out = cv_model.transform(df)
+    assert (out["prediction"].to_numpy() == y).mean() > 0.9
+
+
 def test_pipeline_validation():
     with pytest.raises(ValueError, match="stages"):
         Pipeline().fit(pd.DataFrame({"features": []}))
