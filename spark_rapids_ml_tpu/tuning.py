@@ -283,3 +283,144 @@ class _CrossValidatorModelWriter:
                     m.write().overwrite().save(
                         os.path.join(path, "subModels", f"fold{i}", f"model{j}")
                     )
+
+
+class TrainValidationSplit(_ValidatorParams):
+    """Single train/validation split over a param grid — the other member of
+    pyspark.ml.tuning (the reference leaves it to pyspark; outside Spark that
+    class cannot drive these estimators, so the framework carries it). Uses
+    the same fused fitMultiple + _combine + _transform_evaluate path as
+    CrossValidator when the estimator supports it.
+
+    >>> tvs = TrainValidationSplit(estimator=lr, estimatorParamMaps=grid,
+    ...                            evaluator=ev, trainRatio=0.75)
+    >>> model = tvs.fit(df)
+    """
+
+    trainRatio = Param("trainRatio", "fraction of rows used for training (rest validates)", TypeConverters.toFloat)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(trainRatio=0.75)
+        for name in ("estimator", "estimatorParamMaps", "evaluator"):
+            if name in kwargs:
+                getattr(self, f"set{name[0].upper()}{name[1:]}")(kwargs.pop(name))
+        self._set(**kwargs)
+
+    def setTrainRatio(self, value: float) -> "TrainValidationSplit":
+        return self._set(trainRatio=value)
+
+    def getTrainRatio(self) -> float:
+        return self.getOrDefault("trainRatio")
+
+    def fit(self, dataset: Any) -> "TrainValidationSplitModel":
+        from .data import as_pandas
+
+        est = self.getEstimator()
+        epm = self.getEstimatorParamMaps()
+        eva = self.getEvaluator()
+        if est is None or epm is None or eva is None:
+            raise ValueError("estimator, estimatorParamMaps and evaluator must all be set")
+        ratio = float(self.getOrDefault("trainRatio"))
+        if not 0.0 < ratio < 1.0:
+            raise ValueError(f"trainRatio must be in (0, 1), got {ratio}")
+        logger = get_logger(type(self))
+
+        pdf = as_pandas(dataset)
+        n = len(pdf)
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        perm = rng.permutation(n)
+        n_train = int(round(ratio * n))
+        if n_train == 0 or n_train == n:
+            raise ValueError(f"trainRatio={ratio} leaves an empty split for {n} rows")
+        train = pdf.iloc[perm[:n_train]].reset_index(drop=True)
+        valid = pdf.iloc[perm[n_train:]].reset_index(drop=True)
+
+        accelerated = isinstance(est, _TpuEstimator) and est._supportsTransformEvaluate(eva)
+        logger.info(
+            "TrainValidationSplit: %d train / %d valid x %d param maps (%s path)",
+            n_train, n - n_train, len(epm),
+            "fused single-pass" if accelerated else "fallback per-model",
+        )
+        if accelerated:
+            models = [m for _, m in sorted(est.fitMultiple(train, epm))]
+            combined = models[0]._combine(models)
+            metrics = np.asarray(combined._transform_evaluate(valid, eva))
+        else:
+            models = [est.copy(pm).fit(train) for pm in epm]
+            metrics = np.asarray([eva.evaluate(m.transform(valid)) for m in models])
+
+        best_idx = int(np.argmax(metrics) if eva.isLargerBetter() else np.argmin(metrics))
+        logger.info("TrainValidationSplit: best param map %d (metric %.6f)", best_idx, metrics[best_idx])
+        best_model = est.copy(epm[best_idx]).fit(pdf)
+        sub = models if bool(self.getOrDefault("collectSubModels")) else None
+        return TrainValidationSplitModel(
+            bestModel=best_model, validationMetrics=list(metrics), subModels=sub
+        )
+
+
+class TrainValidationSplitModel(Params):
+    def __init__(self, bestModel=None, validationMetrics=None, subModels=None) -> None:
+        super().__init__()
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics or []
+        self.subModels = subModels
+
+    def transform(self, dataset: Any):
+        return self.bestModel.transform(dataset)
+
+    def write(self) -> "_TrainValidationSplitModelWriter":
+        return _TrainValidationSplitModelWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "TrainValidationSplitModel":
+        import json
+        import os
+
+        from .core import load_instance
+
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        best = load_instance(os.path.join(path, "bestModel"))
+        sub = None
+        if meta.get("numSubModels"):
+            sub = [
+                load_instance(os.path.join(path, "subModels", f"model{j}"))
+                for j in range(meta["numSubModels"])
+            ]
+        return cls(bestModel=best, validationMetrics=meta["validationMetrics"], subModels=sub)
+
+
+class _TrainValidationSplitModelWriter:
+    def __init__(self, instance: TrainValidationSplitModel) -> None:
+        self.instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_TrainValidationSplitModelWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        import json
+        import os
+
+        from .core import _prepare_save_path
+
+        inst = self.instance
+        if inst.bestModel is None:
+            raise ValueError("TrainValidationSplitModel has no bestModel to save")
+        _prepare_save_path(path, self._overwrite)
+        meta = {
+            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
+            "validationMetrics": [float(v) for v in inst.validationMetrics],
+            "numSubModels": len(inst.subModels) if inst.subModels else 0,
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        inst.bestModel.write().overwrite().save(os.path.join(path, "bestModel"))
+        if inst.subModels:
+            for j, m in enumerate(inst.subModels):
+                m.write().overwrite().save(os.path.join(path, "subModels", f"model{j}"))

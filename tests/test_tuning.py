@@ -13,7 +13,13 @@ from spark_rapids_ml_tpu.evaluation import (
 )
 from spark_rapids_ml_tpu.metrics import MulticlassMetrics, RegressionMetrics, _SummarizerBuffer
 from spark_rapids_ml_tpu.models.regression import LinearRegression
-from spark_rapids_ml_tpu.tuning import CrossValidator, CrossValidatorModel, ParamGridBuilder
+from spark_rapids_ml_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
 
 
 def test_regression_metrics_vs_sklearn(rng):
@@ -186,6 +192,58 @@ def test_cv_collect_sub_models(rng):
     m = cv.fit(df)
     assert m.subModels is not None and len(m.subModels) == 2
     assert all(len(fold_models) == 2 for fold_models in m.subModels)
+
+
+def test_train_validation_split_fused_and_fallback(rng):
+    df = _cv_data(rng)
+    lr = LinearRegression(standardization=False, float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.5, 10.0]).build()
+    ev = RegressionEvaluator(metricName="rmse")
+    tvs = TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid, evaluator=ev, trainRatio=0.75, seed=4
+    )
+    m = tvs.fit(df)
+    assert isinstance(m, TrainValidationSplitModel)
+    assert len(m.validationMetrics) == 3
+    assert int(np.argmin(m.validationMetrics)) == 0  # tiny reg wins
+    assert "prediction" in m.transform(df).columns
+
+    # fused path must equal the manual per-model loop on the SAME split
+    rng2 = np.random.default_rng(4)
+    perm = rng2.permutation(len(df))
+    n_train = int(round(0.75 * len(df)))
+    train, valid = df.iloc[perm[:n_train]], df.iloc[perm[n_train:]]
+    manual = [
+        ev.evaluate(lr.copy(pm).fit(train).transform(valid)) for pm in grid
+    ]
+    np.testing.assert_allclose(m.validationMetrics, manual, rtol=1e-8)
+
+
+def test_train_validation_split_persistence(rng, tmp_path):
+    df = _cv_data(rng, n=150)
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.1]).build()
+    m = TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid, evaluator=RegressionEvaluator(),
+        collectSubModels=True,
+    ).fit(df)
+    assert m.subModels is not None and len(m.subModels) == 2
+    path = str(tmp_path / "tvs")
+    m.save(path)
+    loaded = TrainValidationSplitModel.load(path)
+    np.testing.assert_allclose(loaded.validationMetrics, m.validationMetrics, rtol=1e-12)
+    assert len(loaded.subModels) == 2
+    np.testing.assert_allclose(
+        loaded.transform(df)["prediction"].to_numpy(),
+        m.transform(df)["prediction"].to_numpy(),
+        rtol=1e-10,
+    )
+
+    with pytest.raises(ValueError, match="trainRatio"):
+        TrainValidationSplit(
+            estimator=lr, estimatorParamMaps=grid, evaluator=RegressionEvaluator(),
+            trainRatio=1.5,
+        ).fit(df)
 
 
 def test_cv_model_persistence_roundtrip(rng, tmp_path):
