@@ -637,12 +637,47 @@ class _TpuModelWithColumns(_TpuModel):
 
 def _prepare_save_path(path: str, overwrite: bool) -> None:
     """Shared exists/overwrite/mkdir preamble for every writer (incl. the
-    composite CrossValidatorModel writer in tuning.py)."""
+    composite writers below)."""
     if os.path.exists(path):
         if not overwrite:
             raise FileExistsError(f"Path {path} already exists; use write().overwrite().save()")
         shutil.rmtree(path)
     os.makedirs(path)
+
+
+class CompositeWriter:
+    """Writer for models made of OTHER models (CrossValidatorModel,
+    TrainValidationSplitModel, PipelineModel): one metadata.json carrying the
+    class + caller-provided fields, plus nested per-child sub-saves in each
+    child's own format. One implementation so the save protocol (overwrite
+    semantics, metadata shape, child layout) cannot drift between the
+    composite model types.
+
+    build_meta(instance) -> dict of extra metadata fields;
+    iter_children(instance) -> iterable of (relative_subdir, child_model).
+    """
+
+    def __init__(self, instance: Any, build_meta, iter_children) -> None:
+        self.instance = instance
+        self._build_meta = build_meta
+        self._iter_children = iter_children
+        self._overwrite = False
+
+    def overwrite(self) -> "CompositeWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        inst = self.instance
+        _prepare_save_path(path, self._overwrite)
+        meta = {
+            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
+            **self._build_meta(inst),
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        for rel, child in self._iter_children(inst):
+            child.write().overwrite().save(os.path.join(path, rel))
 
 
 class _TpuWriter:

@@ -40,38 +40,58 @@ class Pipeline(Params):
         return self
 
     def copy(self, extra: Optional[dict] = None) -> "Pipeline":
-        """Copy with `extra` param overrides ROUTED TO THE OWNING STAGE
-        (pyspark Pipeline.copy semantics) — this is what lets
-        CrossValidator(estimator=Pipeline(...)) sweep a stage's params
-        through the fallback fit-per-model path."""
+        """Copy with `extra` param overrides routed to the stage that owns
+        each param — this is what lets CrossValidator / TrainValidationSplit
+        sweep a stage's params through the fallback fit-per-model path.
+
+        Param objects here are per-NAME singletons (mixin class attributes),
+        so a name carried by MORE THAN ONE stage cannot identify its target:
+        that case raises instead of silently re-tuning every matching stage
+        (pyspark disambiguates via per-instance parent uids; this framework
+        keeps the simpler Param model and makes the ambiguity loud)."""
         extra = dict(extra or {})
-        stages = []
-        for s in self._stages:
-            if hasattr(s, "copy") and hasattr(s, "hasParam"):
-                own = {
-                    p: v
-                    for p, v in extra.items()
-                    if s.hasParam(getattr(p, "name", str(p)))
-                }
-                stages.append(s.copy(own))
-            else:
-                stages.append(s)
-        return Pipeline(stages=stages)
+        routable = [
+            s if (hasattr(s, "copy") and hasattr(s, "hasParam")) else None
+            for s in self._stages
+        ]
+        per_stage: List[dict] = [{} for _ in self._stages]
+        for p, v in extra.items():
+            name = getattr(p, "name", str(p))
+            owners = [i for i, s in enumerate(routable) if s is not None and s.hasParam(name)]
+            if not owners:
+                continue
+            if len(owners) > 1:
+                raise ValueError(
+                    f"param {name!r} is carried by stages {owners}; tuning it through "
+                    "a Pipeline is ambiguous — set it on the intended stage directly"
+                )
+            per_stage[owners[0]][p] = v
+        return Pipeline(
+            stages=[
+                s.copy(per_stage[i]) if routable[i] is not None else s
+                for i, s in enumerate(self._stages)
+            ]
+        )
 
     def fit(self, dataset: Any) -> "PipelineModel":
         if not self._stages:
             raise ValueError("Pipeline has no stages")
+        for i, stage in enumerate(self._stages):
+            if not (_is_estimator(stage) or hasattr(stage, "transform")):
+                raise TypeError(
+                    f"stage {i} ({type(stage).__name__}) is neither estimator nor transformer"
+                )
+        # pyspark semantics: transform only feeds LATER ESTIMATORS — stop
+        # running the data forward past the last estimator stage
+        last_est = max(
+            (i for i, s in enumerate(self._stages) if _is_estimator(s)), default=-1
+        )
         df = dataset
         fitted: List[Any] = []
         for i, stage in enumerate(self._stages):
-            if _is_estimator(stage):
-                model = stage.fit(df)
-            elif hasattr(stage, "transform"):
-                model = stage
-            else:
-                raise TypeError(f"stage {i} ({type(stage).__name__}) is neither estimator nor transformer")
+            model = stage.fit(df) if _is_estimator(stage) else stage
             fitted.append(model)
-            if i < len(self._stages) - 1:  # the last stage's output is unused
+            if i < last_est:
                 df = model.transform(df)
         return PipelineModel(stages=fitted)
 
@@ -87,10 +107,20 @@ class PipelineModel(Params):
             df = stage.transform(df)
         return df
 
-    # persistence: composite directory, one sub-save per stage (the same
-    # shape as CrossValidatorModel), restored by class dispatch
-    def write(self) -> "_PipelineModelWriter":
-        return _PipelineModelWriter(self)
+    # persistence: composite directory, one sub-save per stage (the shared
+    # CompositeWriter protocol), restored by class dispatch
+    def write(self):
+        from .core import CompositeWriter
+
+        if not self.stages:
+            raise ValueError("PipelineModel has no stages to save")
+        return CompositeWriter(
+            self,
+            build_meta=lambda inst: {"numStages": len(inst.stages)},
+            iter_children=lambda inst: (
+                (f"stage{i}", s) for i, s in enumerate(inst.stages)
+            ),
+        )
 
     def save(self, path: str) -> None:
         self.write().save(path)
@@ -109,32 +139,3 @@ class PipelineModel(Params):
             for i in range(meta["numStages"])
         ]
         return cls(stages=stages)
-
-
-class _PipelineModelWriter:
-    def __init__(self, instance: PipelineModel) -> None:
-        self.instance = instance
-        self._overwrite = False
-
-    def overwrite(self) -> "_PipelineModelWriter":
-        self._overwrite = True
-        return self
-
-    def save(self, path: str) -> None:
-        import json
-        import os
-
-        from .core import _prepare_save_path
-
-        inst = self.instance
-        if not inst.stages:
-            raise ValueError("PipelineModel has no stages to save")
-        _prepare_save_path(path, self._overwrite)
-        meta = {
-            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
-            "numStages": len(inst.stages),
-        }
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f, indent=2)
-        for i, stage in enumerate(inst.stages):
-            stage.write().overwrite().save(os.path.join(path, f"stage{i}"))

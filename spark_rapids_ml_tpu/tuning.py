@@ -211,11 +211,31 @@ class CrossValidatorModel(Params):
 
     # persistence: a composite directory — top-level metadata (metrics) plus
     # nested per-model saves in each model's own format, restored by class
-    # dispatch. The reference round-trips CV models through pyspark's
-    # CrossValidatorModel writer (reference tuning.py:139-177); here every
-    # nested model reuses the framework's npz/JSON writer.
-    def write(self) -> "_CrossValidatorModelWriter":
-        return _CrossValidatorModelWriter(self)
+    # dispatch (the shared CompositeWriter protocol). The reference
+    # round-trips CV models through pyspark's CrossValidatorModel writer
+    # (reference tuning.py:139-177).
+    def write(self):
+        from .core import CompositeWriter
+
+        if self.bestModel is None:
+            raise ValueError("CrossValidatorModel has no bestModel to save")
+
+        def children(inst):
+            yield "bestModel", inst.bestModel
+            for i, fold_models in enumerate(inst.subModels or ()):
+                for j, m in enumerate(fold_models):
+                    yield f"subModels/fold{i}/model{j}", m
+
+        return CompositeWriter(
+            self,
+            build_meta=lambda inst: {
+                "avgMetrics": [float(v) for v in inst.avgMetrics],
+                "stdMetrics": [float(v) for v in inst.stdMetrics],
+                "numSubModelFolds": len(inst.subModels) if inst.subModels else 0,
+                "numSubModelsPerFold": len(inst.subModels[0]) if inst.subModels else 0,
+            },
+            iter_children=children,
+        )
 
     def save(self, path: str) -> None:
         self.write().save(path)
@@ -247,44 +267,6 @@ class CrossValidatorModel(Params):
         )
 
 
-class _CrossValidatorModelWriter:
-    def __init__(self, instance: CrossValidatorModel) -> None:
-        self.instance = instance
-        self._overwrite = False
-
-    def overwrite(self) -> "_CrossValidatorModelWriter":
-        self._overwrite = True
-        return self
-
-    def save(self, path: str) -> None:
-        import json
-        import os
-
-        from .core import _prepare_save_path
-
-        inst = self.instance
-        if inst.bestModel is None:
-            raise ValueError("CrossValidatorModel has no bestModel to save")
-        _prepare_save_path(path, self._overwrite)
-        sub = inst.subModels
-        meta = {
-            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
-            "avgMetrics": [float(v) for v in inst.avgMetrics],
-            "stdMetrics": [float(v) for v in inst.stdMetrics],
-            "numSubModelFolds": len(sub) if sub else 0,
-            "numSubModelsPerFold": len(sub[0]) if sub else 0,
-        }
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f, indent=2)
-        inst.bestModel.write().overwrite().save(os.path.join(path, "bestModel"))
-        if sub:
-            for i, fold_models in enumerate(sub):
-                for j, m in enumerate(fold_models):
-                    m.write().overwrite().save(
-                        os.path.join(path, "subModels", f"fold{i}", f"model{j}")
-                    )
-
-
 class TrainValidationSplit(_ValidatorParams):
     """Single train/validation split over a param grid — the other member of
     pyspark.ml.tuning (the reference leaves it to pyspark; outside Spark that
@@ -302,6 +284,10 @@ class TrainValidationSplit(_ValidatorParams):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._setDefault(trainRatio=0.75)
+        # fold-specific inherited params have no meaning for a single split —
+        # drop them so explainParams doesn't advertise dead knobs
+        for dead in ("numFolds", "foldCol"):
+            self._defaultParamMap.pop(self.getParam(dead), None)
         for name in ("estimator", "estimatorParamMaps", "evaluator"):
             if name in kwargs:
                 getattr(self, f"set{name[0].upper()}{name[1:]}")(kwargs.pop(name))
@@ -347,7 +333,18 @@ class TrainValidationSplit(_ValidatorParams):
             combined = models[0]._combine(models)
             metrics = np.asarray(combined._transform_evaluate(valid, eva))
         else:
-            models = [est.copy(pm).fit(train) for pm in epm]
+            # parallelism spans PARAM MAPS here (pyspark TVS semantics; CV
+            # parallelizes over folds instead)
+            par = min(int(self.getOrDefault("parallelism")), len(epm))
+
+            def fit_one(pm):
+                return est.copy(pm).fit(train)
+
+            if par > 1:
+                with ThreadPool(par) as pool:
+                    models = pool.map(fit_one, epm)
+            else:
+                models = [fit_one(pm) for pm in epm]
             metrics = np.asarray([eva.evaluate(m.transform(valid)) for m in models])
 
         best_idx = int(np.argmax(metrics) if eva.isLargerBetter() else np.argmin(metrics))
@@ -369,8 +366,25 @@ class TrainValidationSplitModel(Params):
     def transform(self, dataset: Any):
         return self.bestModel.transform(dataset)
 
-    def write(self) -> "_TrainValidationSplitModelWriter":
-        return _TrainValidationSplitModelWriter(self)
+    def write(self):
+        from .core import CompositeWriter
+
+        if self.bestModel is None:
+            raise ValueError("TrainValidationSplitModel has no bestModel to save")
+
+        def children(inst):
+            yield "bestModel", inst.bestModel
+            for j, m in enumerate(inst.subModels or ()):
+                yield f"subModels/model{j}", m
+
+        return CompositeWriter(
+            self,
+            build_meta=lambda inst: {
+                "validationMetrics": [float(v) for v in inst.validationMetrics],
+                "numSubModels": len(inst.subModels) if inst.subModels else 0,
+            },
+            iter_children=children,
+        )
 
     def save(self, path: str) -> None:
         self.write().save(path)
@@ -392,35 +406,3 @@ class TrainValidationSplitModel(Params):
                 for j in range(meta["numSubModels"])
             ]
         return cls(bestModel=best, validationMetrics=meta["validationMetrics"], subModels=sub)
-
-
-class _TrainValidationSplitModelWriter:
-    def __init__(self, instance: TrainValidationSplitModel) -> None:
-        self.instance = instance
-        self._overwrite = False
-
-    def overwrite(self) -> "_TrainValidationSplitModelWriter":
-        self._overwrite = True
-        return self
-
-    def save(self, path: str) -> None:
-        import json
-        import os
-
-        from .core import _prepare_save_path
-
-        inst = self.instance
-        if inst.bestModel is None:
-            raise ValueError("TrainValidationSplitModel has no bestModel to save")
-        _prepare_save_path(path, self._overwrite)
-        meta = {
-            "class": f"{type(inst).__module__}.{type(inst).__qualname__}",
-            "validationMetrics": [float(v) for v in inst.validationMetrics],
-            "numSubModels": len(inst.subModels) if inst.subModels else 0,
-        }
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f, indent=2)
-        inst.bestModel.write().overwrite().save(os.path.join(path, "bestModel"))
-        if inst.subModels:
-            for j, m in enumerate(inst.subModels):
-                m.write().overwrite().save(os.path.join(path, "subModels", f"model{j}"))

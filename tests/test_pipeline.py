@@ -91,6 +91,21 @@ def test_cross_validator_over_pipeline(rng):
     assert (out["prediction"].to_numpy() == y).mean() > 0.9
 
 
+def test_pipeline_copy_ambiguous_param_raises(rng):
+    # Params are per-NAME singletons: a grid param carried by two stages
+    # cannot identify its target — must raise, not silently re-tune both
+    lr = LogisticRegression()
+    pca = PCA(k=2)
+    pipe = Pipeline(stages=[pca, lr])
+    shared = lr.getParam("featuresCol")  # both stages carry featuresCol
+    with pytest.raises(ValueError, match="ambiguous"):
+        pipe.copy({shared: "x"})
+    # unambiguous params route fine
+    out = pipe.copy({lr.getParam("regParam"): 0.5})
+    assert out.getStages()[1].getOrDefault("regParam") == 0.5
+    assert out.getStages()[0].getOrDefault("k") == 2
+
+
 def test_pipeline_validation():
     with pytest.raises(ValueError, match="stages"):
         Pipeline().fit(pd.DataFrame({"features": []}))
