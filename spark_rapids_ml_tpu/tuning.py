@@ -293,6 +293,15 @@ class TrainValidationSplit(_ValidatorParams):
                 getattr(self, f"set{name[0].upper()}{name[1:]}")(kwargs.pop(name))
         self._set(**kwargs)
 
+    def explainParams(self) -> str:
+        # hide the fold-specific inherited params (dead knobs for a single
+        # split); they must stay resolvable internally for the base __init__
+        return "\n".join(
+            self.explainParam(p)
+            for p in self.params
+            if p.name not in ("numFolds", "foldCol")
+        )
+
     def setTrainRatio(self, value: float) -> "TrainValidationSplit":
         return self._set(trainRatio=value)
 
