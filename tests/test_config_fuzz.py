@@ -1,0 +1,121 @@
+#
+# Seeded config fuzz: random VALID param combinations across the estimator
+# surface, each driven fit -> transform -> save/load -> transform-parity on
+# tiny data. Catches param-plumbing, solver-edge and persistence crashes
+# that targeted tests don't enumerate. Deterministic per seed.
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.linalg import Vectors
+
+
+def _df(rng, n=80, d=6):
+    x = rng.normal(size=(n, d))
+    y_bin = (x[:, 0] > 0).astype(float)
+    y_reg = x @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+    return pd.DataFrame(
+        {"features": [Vectors.dense(r) for r in x], "label": y_bin, "target": y_reg}
+    )
+
+
+def _roundtrip(model, df, tmp_path, tag):
+    out1 = model.transform(df)
+    pred_col = [c for c in out1.columns if c not in ("features", "label", "target")][0]
+    path = str(tmp_path / tag)
+    model.write().overwrite().save(path)
+    from spark_rapids_ml_tpu.core import load_instance
+
+    loaded = load_instance(path)
+    out2 = loaded.transform(df)
+    a = np.asarray([np.asarray(v).ravel() for v in out1[pred_col]], dtype=np.float64)
+    b = np.asarray([np.asarray(v).ravel() for v in out2[pred_col]], dtype=np.float64)
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_estimator_config_fuzz(seed, tmp_path):
+    from spark_rapids_ml_tpu.models.classification import (
+        LogisticRegression,
+        RandomForestClassifier,
+    )
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.models.regression import (
+        LinearRegression,
+        RandomForestRegressor,
+    )
+
+    rng = np.random.default_rng(seed)
+    df = _df(rng)
+    pick = lambda *opts: opts[int(rng.integers(len(opts)))]  # noqa: E731
+
+    cases = [
+        (
+            "pca",
+            PCA(
+                k=int(rng.integers(1, 6)),
+                inputCol="features",
+                outputCol="o",
+                float32_inputs=pick(True, False),
+            ),
+        ),
+        (
+            "kmeans",
+            KMeans(
+                k=int(rng.integers(2, 8)),
+                maxIter=int(rng.integers(2, 15)),
+                initMode=pick("k-means||", "random"),
+                seed=int(rng.integers(100)),
+                tol=float(pick(0.0, 1e-6, 1e-2)),
+            ).setFeaturesCol("features"),
+        ),
+        (
+            "linreg",
+            LinearRegression(
+                regParam=float(pick(0.0, 1e-3, 0.5)),
+                elasticNetParam=float(pick(0.0, 0.3, 1.0)),
+                fitIntercept=pick(True, False),
+                standardization=pick(True, False),
+                labelCol="target",
+                float32_inputs=pick(True, False),
+            ).setFeaturesCol("features"),
+        ),
+        (
+            "logreg",
+            LogisticRegression(
+                regParam=float(pick(0.0, 1e-3, 0.1)),
+                elasticNetParam=float(pick(0.0, 0.5)),
+                maxIter=int(rng.integers(5, 40)),
+                fitIntercept=pick(True, False),
+                standardization=pick(True, False),
+            ).setFeaturesCol("features"),
+        ),
+        (
+            "rfc",
+            RandomForestClassifier(
+                numTrees=int(rng.integers(1, 6)),
+                maxDepth=int(rng.integers(1, 6)),
+                maxBins=int(pick(4, 16, 32)),
+                impurity=pick("gini", "entropy"),
+                featureSubsetStrategy=pick("auto", "all", "sqrt"),
+                bootstrap=pick(True, False),
+                seed=int(rng.integers(100)),
+            ).setFeaturesCol("features"),
+        ),
+        (
+            "rfr",
+            RandomForestRegressor(
+                numTrees=int(rng.integers(1, 5)),
+                maxDepth=int(rng.integers(1, 5)),
+                maxBins=int(pick(4, 16)),
+                subsamplingRate=float(pick(0.5, 1.0)),
+                labelCol="target",
+                seed=int(rng.integers(100)),
+            ).setFeaturesCol("features"),
+        ),
+    ]
+    for tag, est in cases:
+        model = est.fit(df)
+        _roundtrip(model, df, tmp_path, f"{tag}_{seed}")
