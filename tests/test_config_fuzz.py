@@ -119,3 +119,42 @@ def test_estimator_config_fuzz(seed, tmp_path):
     for tag, est in cases:
         model = est.fit(df)
         _roundtrip(model, df, tmp_path, f"{tag}_{seed}")
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_clustering_manifold_config_fuzz(seed):
+    # DBSCAN (fit-is-noop, transform clusters) and UMAP (graph + SGD layout)
+    # under randomized valid configs — no persistence round-trip for DBSCAN
+    # labels (transform is the work), UMAP checked for finite embeddings
+    from spark_rapids_ml_tpu.models.clustering import DBSCAN
+    from spark_rapids_ml_tpu.models.umap import UMAP
+
+    rng = np.random.default_rng(100 + seed)
+    df = _df(rng, n=120, d=5)
+    pick = lambda *opts: opts[int(rng.integers(len(opts)))]  # noqa: E731
+
+    db = DBSCAN(
+        eps=float(pick(0.3, 1.0, 3.0)),
+        min_samples=int(rng.integers(2, 8)),
+        metric=pick("euclidean", "cosine"),
+        calc_core_sample_indices=pick(True, False),
+    ).setFeaturesCol("features")
+    out = db.fit(df).transform(df)
+    labels = out["prediction"].to_numpy()
+    assert len(labels) == len(df) and (labels >= -1).all()
+
+    um = UMAP(
+        n_neighbors=int(rng.integers(4, 12)),
+        n_components=int(pick(2, 3)),
+        n_epochs=int(pick(30, 80)),
+        init=pick("spectral", "random"),
+        metric=pick("euclidean", "cosine"),
+        min_dist=float(pick(0.05, 0.5)),
+        negative_sample_rate=int(pick(2, 5)),
+        random_state=seed,
+    ).setFeaturesCol("features")
+    m = um.fit(df)
+    emb = np.asarray(m.embedding_)
+    assert np.isfinite(emb).all() and emb.shape[0] == len(df)
+    t = m.transform(df.head(20))
+    assert np.isfinite(np.stack(t[m.getOutputCol()].to_list())).all()
