@@ -116,6 +116,19 @@ def test_umap_cosine_metric():
     emb_new = np.stack(out[model.getOutputCol()].to_list())
     assert emb_new.shape == (50, 2) and np.isfinite(emb_new).all()
 
+    # persistence must carry the metric: a reloaded model transforms with the
+    # same cosine convention (bit-equal to the in-memory transform)
+    import tempfile
+
+    p = tempfile.mkdtemp() + "/umap_cos"
+    model.write().overwrite().save(p)
+    loaded = UMAPModel.load(p)
+    assert str(loaded._solver_params["metric"]) == "cosine"
+    out2 = loaded.transform(_df(x[:50]))
+    np.testing.assert_allclose(
+        np.stack(out2[loaded.getOutputCol()].to_list()), emb_new, rtol=1e-6, atol=1e-7
+    )
+
     with pytest.raises(ValueError, match="metric"):
         UMAP(metric="manhattan")
 
