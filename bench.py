@@ -47,12 +47,17 @@ ALGOS = ("pca", "logreg", "kmeans")
 
 # Parent retry policy (override for tests): attempts x per-attempt timeout,
 # with a longer sleep after fast failures (backend-init class) than slow ones
-# (mid-run fault: the tunnel is up, retry soon).
+# (mid-run fault: the tunnel is up, retry soon). READY_TIMEOUT bounds backend
+# init SEPARATELY: a hung tunnel blocks inside jax backend init without ever
+# erroring (the observed failure mode) — the child announces @READY once the
+# mesh exists, and the parent kills inits that never get there instead of
+# burning the whole attempt budget on one hang.
 MAX_ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 10))
 ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 2400))
+READY_TIMEOUT_S = float(os.environ.get("BENCH_READY_TIMEOUT", 240))
 BACKOFF_FAST_FAIL_S = float(os.environ.get("BENCH_BACKOFF", 60))
 BACKOFF_SLOW_FAIL_S = 10.0
-FAST_FAIL_WINDOW_S = 180.0  # died in <3 min => almost surely backend init
+FAST_FAIL_WINDOW_S = 300.0  # died in <5 min => almost surely backend init
 
 
 def _log(msg: str) -> None:
@@ -145,6 +150,7 @@ def run_child() -> int:
         return 0
 
     mesh = get_mesh()
+    print("@READY", flush=True)  # backend init survived — parent relaxes its watchdog
     n_chips = int(mesh.devices.size)
     t0 = time.perf_counter()
     _log(f"generating {N_ROWS}x{N_COLS} dataset tile-wise ON DEVICE...")
@@ -175,6 +181,49 @@ def run_child() -> int:
 
 
 # ---------------------------------------------------------------- parent ----
+
+
+def _run_child_watched(env: dict, attempt_timeout: float):
+    """Run one bench child with TWO deadlines: READY_TIMEOUT_S until the
+    child's @READY (backend init — where a dead tunnel hangs forever), then
+    `attempt_timeout` overall. Returns (stdout_so_far, rc)."""
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--run"],
+        env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+    )
+    lines: list = []
+    ready = threading.Event()
+
+    def reader():
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith(("@READY", "@RESULT")):
+                ready.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    start = time.monotonic()
+    ready_deadline = start + READY_TIMEOUT_S
+    hard_deadline = start + attempt_timeout
+    killed = None
+    while proc.poll() is None:
+        now = time.monotonic()
+        if not ready.is_set() and now > ready_deadline:
+            killed = f"backend init hang (> {READY_TIMEOUT_S:.0f}s to @READY)"
+            break
+        if now > hard_deadline:
+            killed = f"attempt timeout ({attempt_timeout:.0f}s)"
+            break
+        time.sleep(1.0)
+    if killed is not None:
+        _log(f"bench child killed: {killed}")
+        proc.kill()
+    proc.wait()
+    t.join(5.0)
+    return "".join(lines), (proc.returncode if killed is None else -1)
 
 
 def emit(results: dict) -> None:
@@ -231,22 +280,10 @@ def _attempt_loop(results: dict) -> None:
         env = dict(os.environ, BENCH_SKIP=",".join(a for a in ALGOS if a in results))
         _log(f"bench attempt {attempt}/{MAX_ATTEMPTS}: running {'+'.join(pending)}")
         t0 = time.monotonic()
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--run"],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=sys.stderr,
-                timeout=min(ATTEMPT_TIMEOUT_S, max(60.0, deadline - time.monotonic())),
-                text=True,
-            )
-            out, rc = proc.stdout or "", proc.returncode
-        except subprocess.TimeoutExpired as e:
-            out = e.stdout or ""
-            if isinstance(out, bytes):
-                out = out.decode(errors="replace")
-            rc = -1
-            _log(f"bench attempt {attempt}: child timed out after {ATTEMPT_TIMEOUT_S:.0f}s")
+        out, rc = _run_child_watched(
+            env,
+            attempt_timeout=min(ATTEMPT_TIMEOUT_S, max(60.0, deadline - time.monotonic())),
+        )
         for line in out.splitlines():
             if line.startswith("@RESULT "):
                 try:
