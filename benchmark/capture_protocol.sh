@@ -17,8 +17,18 @@ TAG="${1:-r05}"
 CSV="PROTOCOL_${TAG}.csv"
 export BENCH_TIME_LIMIT="${BENCH_TIME_LIMIT:-2400}"
 
+probe_chip() {
+  # a dead tunnel HANGS at backend init: bound the probe so a mid-capture
+  # outage aborts the run in minutes, not BENCH_TIME_LIMIT per config
+  timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+probe_chip || { echo "== chip unreachable before sweep; aborting"; exit 1; }
+
 echo "== protocol sweep -> ${CSV}"
 python -m benchmark.benchmark_runner protocol --isolate --report "${CSV}"
+
+probe_chip || { echo "== chip lost after sweep; skipping RF ladder"; exit 1; }
 
 echo "== RF protocol ladder (classification 50 trees, 128 bins, 1M x 3k)"
 for depth in 13 12 11 10; do
@@ -30,6 +40,7 @@ for depth in 13 12 11 10; do
     break
   fi
   echo "== RF depth ${depth} failed/faulted; stepping down"
+  probe_chip || { echo "== chip lost during RF ladder; stopping"; break; }
 done
 
 echo "== done; rows:"
