@@ -194,6 +194,7 @@ def _run_child_watched(env: dict, attempt_timeout: float):
         env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
     )
     lines: list = []
+    init_hang = False
     ready = threading.Event()
 
     def reader():
@@ -213,6 +214,7 @@ def _run_child_watched(env: dict, attempt_timeout: float):
         now = time.monotonic()
         if not ready.is_set() and now > ready_deadline:
             killed = f"backend init hang (> {READY_TIMEOUT_S:.0f}s to @READY)"
+            init_hang = True
             break
         if now > hard_deadline:
             killed = f"attempt timeout ({attempt_timeout:.0f}s)"
@@ -223,7 +225,7 @@ def _run_child_watched(env: dict, attempt_timeout: float):
         proc.kill()
     proc.wait()
     t.join(5.0)
-    return "".join(lines), (proc.returncode if killed is None else -1)
+    return "".join(lines), (proc.returncode if killed is None else -1), init_hang
 
 
 def emit(results: dict) -> None:
@@ -268,8 +270,13 @@ def main() -> None:
 def _attempt_loop(results: dict) -> None:
     # total budget DEFAULTS BELOW any plausible driver timeout: if the caller
     # kills this process before emit(), the JSON contract is lost — 45 min
-    # fits ~4 full attempts at the protocol scale with backoff
+    # fits ~4 full attempts at the protocol scale with backoff. A run of
+    # consecutive init-hang kills (the tunnel never answered once) ends the
+    # loop even earlier: sustained outage, emit the degraded JSON while the
+    # caller is still listening.
     deadline = time.monotonic() + float(os.environ.get("BENCH_TOTAL_TIMEOUT", 2700))
+    max_init_hangs = int(os.environ.get("BENCH_MAX_INIT_HANGS", 3))
+    init_hangs = 0
     for attempt in range(1, MAX_ATTEMPTS + 1):
         pending = [a for a in ALGOS if a not in results]
         if not pending:
@@ -280,7 +287,7 @@ def _attempt_loop(results: dict) -> None:
         env = dict(os.environ, BENCH_SKIP=",".join(a for a in ALGOS if a in results))
         _log(f"bench attempt {attempt}/{MAX_ATTEMPTS}: running {'+'.join(pending)}")
         t0 = time.monotonic()
-        out, rc = _run_child_watched(
+        out, rc, init_hang = _run_child_watched(
             env,
             attempt_timeout=min(ATTEMPT_TIMEOUT_S, max(60.0, deadline - time.monotonic())),
         )
@@ -295,6 +302,13 @@ def _attempt_loop(results: dict) -> None:
             break
         elapsed = time.monotonic() - t0
         _log(f"bench attempt {attempt}: rc={rc}, have {sorted(results)} after {elapsed:.0f}s")
+        init_hangs = init_hangs + 1 if init_hang else 0
+        if init_hangs >= max_init_hangs:
+            _log(
+                f"bench: {init_hangs} consecutive backend-init hangs — "
+                "sustained accelerator outage, giving up early"
+            )
+            break
         if attempt < MAX_ATTEMPTS:
             pause = BACKOFF_FAST_FAIL_S if elapsed < FAST_FAIL_WINDOW_S else BACKOFF_SLOW_FAIL_S
             pause = min(pause, max(0.0, deadline - time.monotonic()))
