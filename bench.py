@@ -45,6 +45,25 @@ N_COLS = int(os.environ.get("BENCH_COLS", 3000))
 BASELINES = {"pca": 50_000.0, "kmeans": 8_333.0, "logreg": 12_500.0}
 ALGOS = ("pca", "logreg", "kmeans")
 
+# Optional sparse lane (BENCH_SPARSE=1): the reference tests_large scale shape
+# (1e7 x 2200 at 0.1% density) streamed partition-parallel from
+# benchmark/gen_data_distributed.py into padded ELL — the full CSR is never
+# materialized driver-side. Reported as its own @RESULT line; NOT part of the
+# headline geomean (BASELINES has no entry for it).
+SPARSE_ALGO = "sparse_logreg"
+SPARSE_ROWS = int(os.environ.get("BENCH_SPARSE_ROWS", 10_000_000))
+SPARSE_COLS = int(os.environ.get("BENCH_SPARSE_COLS", 2200))
+SPARSE_DENSITY = float(os.environ.get("BENCH_SPARSE_DENSITY", 0.001))
+
+
+def bench_algos() -> tuple:
+    if os.environ.get("BENCH_SPARSE"):
+        # sparse FIRST: its ELL tensors are freed when its runner returns,
+        # BEFORE the ~12 GiB dense protocol block is generated — running it
+        # last would stack both datasets on the chip and OOM a single v5e
+        return (SPARSE_ALGO,) + ALGOS
+    return ALGOS
+
 # Parent retry policy (override for tests): attempts x per-attempt timeout,
 # with a longer sleep after fast failures (backend-init class) than slow ones
 # (mid-run fault: the tunnel is up, retry soon). READY_TIMEOUT bounds backend
@@ -112,8 +131,10 @@ def bench_kmeans(X, w, mesh) -> float:
     np.asarray(centers0[:1])
 
     def run():
+        from spark_rapids_ml_tpu.parallel.mesh import effective_matmul_precision
+
         # KMeans precision policy: 3-pass bf16 MXU (parallel/mesh.py dtype_scope)
-        with jax.default_matmul_precision("BF16_BF16_F32_X3"):
+        with jax.default_matmul_precision(effective_matmul_precision("BF16_BF16_F32_X3")):
             return kmeans_fit(
                 X, w, centers0, mesh=mesh, max_iter=30, tol=1e-20, batch_rows=65536
             )
@@ -137,6 +158,29 @@ def bench_logreg(X, w, y_idx) -> float:
     return N_ROWS / fit_s
 
 
+def bench_sparse_logreg(mesh) -> float:
+    """Sparse scale-shape fit: stream gen_data_distributed partitions into
+    ELL (chunked, no full-CSR materialization), binarize the target, fit the
+    certified tests_large config (scale-only standardization, maxIter=60)."""
+    from benchmark.gen_data_distributed import sparse_classification_ell
+    from spark_rapids_ml_tpu.ops.logistic import logistic_fit_ell
+
+    t0 = time.perf_counter()
+    data = sparse_classification_ell(SPARSE_ROWS, SPARSE_COLS, SPARSE_DENSITY, 0, mesh)
+    np.asarray(data["w"][:1])
+    _log(f"sparse datagen+ingest: {time.perf_counter() - t0:.1f}s (k_max={data['k_max']})")
+
+    run = lambda: logistic_fit_ell(  # noqa: E731
+        data["values"], data["indices"], data["y"], data["w"],
+        d=SPARSE_COLS, k=2, multinomial=False, lam_l2=1e-6,
+        fit_intercept=True, standardize=True, max_iter=60, tol=1e-12,
+    )
+    np.asarray(run()["coef_"])  # compile + warm
+    fit_s = _time_fit(run, lambda s: s["coef_"], repeats=1)
+    _log(f"sparse_logreg: {fit_s:.2f}s fit ({SPARSE_ROWS}x{SPARSE_COLS} @ {SPARSE_DENSITY})")
+    return SPARSE_ROWS / fit_s
+
+
 def run_child() -> int:
     """Generate data once, run each pending algo fail-soft, emit @RESULT lines."""
     import jax
@@ -145,29 +189,42 @@ def run_child() -> int:
     from spark_rapids_ml_tpu.parallel import get_mesh
 
     skip = set(filter(None, os.environ.get("BENCH_SKIP", "").split(",")))
-    pending = [a for a in ALGOS if a not in skip]
+    pending = [a for a in bench_algos() if a not in skip]
     if not pending:
         return 0
 
     mesh = get_mesh()
     print("@READY", flush=True)  # backend init survived — parent relaxes its watchdog
     n_chips = int(mesh.devices.size)
-    t0 = time.perf_counter()
-    _log(f"generating {N_ROWS}x{N_COLS} dataset tile-wise ON DEVICE...")
-    # single chip: plain (uncommitted-sharding) arrays — a committed
-    # NamedSharding makes Shardy insert a full input-resharding copy of X in
-    # downstream programs (11 GiB here), while GSPMD on a 1-device mesh needs
-    # no sharding annotations at all
-    X, y_idx, w = gen_classification_device(
-        N_ROWS, N_COLS, n_classes=2, mesh=mesh if n_chips > 1 else None
-    )
-    np.asarray(w[:1])  # force materialization for honest phase timing
-    _log(f"datagen: {time.perf_counter() - t0:.1f}s")
+
+    dense: dict = {}
+
+    def dense_data() -> dict:
+        """Generate the dense protocol block LAZILY, on the first dense
+        runner — so the sparse lane (which runs first) never coexists with
+        the ~12 GiB dense X on the chip."""
+        if not dense:
+            t0 = time.perf_counter()
+            _log(f"generating {N_ROWS}x{N_COLS} dataset tile-wise ON DEVICE...")
+            # single chip: plain (uncommitted-sharding) arrays — a committed
+            # NamedSharding makes Shardy insert a full input-resharding copy of
+            # X in downstream programs (11 GiB here), while GSPMD on a 1-device
+            # mesh needs no sharding annotations at all
+            X, y_idx, w = gen_classification_device(
+                N_ROWS, N_COLS, n_classes=2, mesh=mesh if n_chips > 1 else None
+            )
+            np.asarray(w[:1])  # force materialization for honest phase timing
+            _log(f"datagen: {time.perf_counter() - t0:.1f}s")
+            dense.update(X=X, y_idx=y_idx, w=w)
+        return dense
 
     runners = {
-        "pca": lambda: bench_pca(X, w, mesh),
-        "logreg": lambda: bench_logreg(X, w, y_idx),
-        "kmeans": lambda: bench_kmeans(X, w, mesh),
+        SPARSE_ALGO: lambda: bench_sparse_logreg(mesh),
+        "pca": lambda: bench_pca(dense_data()["X"], dense_data()["w"], mesh),
+        "logreg": lambda: bench_logreg(
+            dense_data()["X"], dense_data()["w"], dense_data()["y_idx"]
+        ),
+        "kmeans": lambda: bench_kmeans(dense_data()["X"], dense_data()["w"], mesh),
     }
     n_fail = 0
     for name in pending:
@@ -229,8 +286,13 @@ def _run_child_watched(env: dict, attempt_timeout: float):
 
 
 def emit(results: dict) -> None:
-    """The one stdout JSON line. Degrades to value 0.0 when nothing ran."""
-    ok = {k: v for k, v in results.items() if v and np.isfinite(v)}
+    """The one stdout JSON line. Degrades to value 0.0 when nothing ran.
+    Only the three headline BASELINES algos enter the geomean; extra lanes
+    (sparse_logreg) are logged to stderr."""
+    for name, v in results.items():
+        if name not in BASELINES and v and np.isfinite(v):
+            _log(f"{name}: {v:,.0f} rows/sec/chip (no baseline; excluded from geomean)")
+    ok = {k: v for k, v in results.items() if k in BASELINES and v and np.isfinite(v)}
     if ok:
         geo = float(np.exp(np.mean([np.log(v) for v in ok.values()])))
         geo_vs = float(np.exp(np.mean([np.log(ok[k] / BASELINES[k]) for k in ok])))
@@ -278,13 +340,13 @@ def _attempt_loop(results: dict) -> None:
     max_init_hangs = int(os.environ.get("BENCH_MAX_INIT_HANGS", 3))
     init_hangs = 0
     for attempt in range(1, MAX_ATTEMPTS + 1):
-        pending = [a for a in ALGOS if a not in results]
+        pending = [a for a in bench_algos() if a not in results]
         if not pending:
             break
         if time.monotonic() > deadline:
             _log("bench: total time budget exhausted")
             break
-        env = dict(os.environ, BENCH_SKIP=",".join(a for a in ALGOS if a in results))
+        env = dict(os.environ, BENCH_SKIP=",".join(a for a in bench_algos() if a in results))
         _log(f"bench attempt {attempt}/{MAX_ATTEMPTS}: running {'+'.join(pending)}")
         t0 = time.monotonic()
         out, rc, init_hang = _run_child_watched(
@@ -298,7 +360,7 @@ def _attempt_loop(results: dict) -> None:
                     results[rec["algo"]] = float(rec["rows_per_sec_chip"])
                 except (ValueError, KeyError, TypeError):
                     pass
-        if all(a in results for a in ALGOS):
+        if all(a in results for a in bench_algos()):
             break
         elapsed = time.monotonic() - t0
         _log(f"bench attempt {attempt}: rc={rc}, have {sorted(results)} after {elapsed:.0f}s")

@@ -1,0 +1,66 @@
+#
+# Ingest micro-benchmark: host->HBM placement, chunked per-shard vs the old
+# monolithic pad+device_put path (tentpole acceptance for the streaming
+# ingest rework). `fit` is the CHUNKED placement (so fit_rows_per_sec is the
+# ingest throughput the framework actually ships); `monolithic_place`
+# records the old path's wall time on the same block for comparison, and
+# `extract` times the chunked column->block conversion of a per-row column.
+#
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+from .base import BenchmarkBase
+
+
+class BenchmarkIngest(BenchmarkBase):
+    name = "ingest"
+    extra_args = {
+        "skip_extract": (int, 0, "1 = skip the column->block extraction timing"),
+    }
+
+    def gen_dataset(self, args, mesh) -> Dict[str, Any]:
+        rng = np.random.default_rng(args.seed)
+        # +1 row: force the tail-pad/monolithic-pad path both benches exercise
+        n = args.num_rows + 1
+        return {"X_host": rng.standard_normal((n, args.num_cols), dtype=np.float32)}
+
+    def run_once(self, args, data, mesh):
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import make_global_rows
+        from spark_rapids_ml_tpu.parallel.mesh import pad_rows, row_sharding
+
+        x = data["X_host"]
+
+        t0 = time.perf_counter()
+        X, w, _ = make_global_rows(mesh, x)
+        jax.block_until_ready(X)
+        chunked_s = time.perf_counter() - t0
+        del X, w
+
+        t0 = time.perf_counter()
+        xp, _ = pad_rows(x, int(mesh.devices.size))
+        Xm = jax.device_put(xp, row_sharding(mesh, 2))
+        wm = jax.device_put(np.ones(xp.shape[0], np.float32), row_sharding(mesh, 1))
+        jax.block_until_ready(Xm)
+        mono_s = time.perf_counter() - t0
+        del Xm, wm, xp
+
+        out = {"fit": chunked_s, "monolithic_place": mono_s}
+        if not args.skip_extract:
+            from spark_rapids_ml_tpu.data import extract_dataset
+
+            rows = list(x)  # per-row object column (the pandas-ingest shape)
+            t0 = time.perf_counter()
+            extracted = extract_dataset({"features": rows}, input_col="features")
+            out["extract"] = time.perf_counter() - t0
+            assert extracted.n_rows == x.shape[0]
+        return out
+
+
+if __name__ == "__main__":
+    BenchmarkIngest().run()

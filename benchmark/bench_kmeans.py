@@ -87,10 +87,11 @@ class BenchmarkKMeans(BenchmarkBase):
         from jax import default_matmul_precision
 
         from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
+        from spark_rapids_ml_tpu.parallel.mesh import effective_matmul_precision
 
         def run():
             # KMeans precision policy: 3-pass bf16 MXU (see parallel/mesh.py)
-            with default_matmul_precision("BF16_BF16_F32_X3"):
+            with default_matmul_precision(effective_matmul_precision("BF16_BF16_F32_X3")):
                 return kmeans_fit(
                     data["X"], data["w"], data["centers0"], mesh=mesh,
                     max_iter=args.maxIter, tol=1e-20, batch_rows=args.batch_rows,

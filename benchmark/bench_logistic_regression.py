@@ -19,9 +19,15 @@ class BenchmarkLogisticRegression(BenchmarkBase):
         "reg": (float, 1e-5, "regParam (protocol: 1e-5)"),
         "elasticNetParam": (float, 0.0, "L1 ratio (OWL-QN path when > 0)"),
         "n_classes": (int, 2, "label cardinality"),
+        "density": (float, 0.0,
+                    "feature density; > 0 runs the sparse padded-ELL lane over"
+                    " the partition-parallel generator (reference tests_large"
+                    " shape: 1e7 x 2200 at 0.001)"),
     }
 
     def gen_dataset(self, args, mesh):
+        if args.density > 0:
+            return self._gen_sparse(args, mesh)
         if args.cpu_comparison:
             from .gen_data import gen_classification_host
 
@@ -35,9 +41,39 @@ class BenchmarkLogisticRegression(BenchmarkBase):
         fetch(w[:1])
         return {"X": X, "y": y, "w": w}
 
+    def _gen_sparse(self, args, mesh):
+        """Sparse lane: stream partition-parallel CSR partitions into padded
+        ELL (never materializing the full CSR driver-side), binarize the
+        regression target at 0, and row-shard the ELL tensors on the mesh —
+        the one certified recipe shared with bench.py."""
+        # fail fast on flag combinations the lane cannot honor, BEFORE the
+        # (potentially minutes-long) scale-shape generation
+        if args.cpu_comparison:
+            raise SystemExit(
+                "--cpu_comparison is not supported with --density (the sparse "
+                "lane streams partitions and keeps no host CSR copy)"
+            )
+        if args.n_classes != 2:
+            raise SystemExit(
+                "--density runs the binarized-target sparse lane; only "
+                "--n_classes 2 is supported"
+            )
+        from .gen_data_distributed import sparse_classification_ell
+
+        data = sparse_classification_ell(
+            args.num_rows, args.num_cols, args.density, args.seed, mesh
+        )
+        fetch(data["w"][:1])
+        return data
+
     def dataset_from_arrays(self, X, y, args, mesh):
         from spark_rapids_ml_tpu.parallel import make_global_rows
 
+        if args.density > 0:
+            raise SystemExit(
+                "--dataset_path loads a dense block; it cannot be combined "
+                "with the --density sparse-ELL lane"
+            )
         if y is None:
             raise ValueError("logistic_regression dataset needs a label column")
         Xh = np.asarray(X, dtype=np.float32)
@@ -66,18 +102,28 @@ class BenchmarkLogisticRegression(BenchmarkBase):
         return {"cpu_fit": time.perf_counter() - t0}
 
     def run_once(self, args, data, mesh):
-        from spark_rapids_ml_tpu.ops.logistic import logistic_fit
+        from spark_rapids_ml_tpu.ops.logistic import logistic_fit, logistic_fit_ell
 
         l1 = args.reg * args.elasticNetParam
 
-        def run():
-            return logistic_fit(
-                data["X"], data["y"], data["w"],
-                k=args.n_classes, multinomial=args.n_classes > 2,
-                lam_l2=args.reg * (1.0 - args.elasticNetParam), lam_l1=l1,
-                use_l1=l1 > 0, fit_intercept=True, standardize=True,
-                max_iter=args.maxIter, tol=1e-30,
-            )
+        if args.density > 0:
+            def run():
+                return logistic_fit_ell(
+                    data["values"], data["indices"], data["y"], data["w"],
+                    d=args.num_cols, k=2, multinomial=False,
+                    lam_l2=args.reg * (1.0 - args.elasticNetParam), lam_l1=l1,
+                    use_l1=l1 > 0, fit_intercept=True, standardize=True,
+                    max_iter=args.maxIter, tol=1e-30,
+                )
+        else:
+            def run():
+                return logistic_fit(
+                    data["X"], data["y"], data["w"],
+                    k=args.n_classes, multinomial=args.n_classes > 2,
+                    lam_l2=args.reg * (1.0 - args.elasticNetParam), lam_l1=l1,
+                    use_l1=l1 > 0, fit_intercept=True, standardize=True,
+                    max_iter=args.maxIter, tol=1e-30,
+                )
 
         fetch(run()["coef_"])  # compile outside timing
         state = {}
@@ -99,6 +145,23 @@ class BenchmarkLogisticRegression(BenchmarkBase):
 
         coef = self._state["coef_"]
         intercept = self._state["intercept_"]
+
+        if args.density > 0:
+            from spark_rapids_ml_tpu.ops.sparse import ell_matmul
+
+            @jax.jit
+            def acc_ell(values, indices, y, w):
+                z = ell_matmul(values, indices, jnp.asarray(coef[0])[:, None])[:, 0]
+                pred = (z + intercept[0] > 0).astype(jnp.int32)
+                # padding rows carry w == 0: mask them out of the mean
+                return jnp.sum(w * (pred == y).astype(jnp.float32)) / jnp.sum(w)
+
+            return {
+                "accuracy": float(np.asarray(
+                    acc_ell(data["values"], data["indices"], data["y"], data["w"])
+                )),
+                "n_iter": float(self._state["n_iter_"]),
+            }
 
         @jax.jit
         def acc(X, y):

@@ -15,6 +15,7 @@ import sys
 
 from .bench_approximate_nearest_neighbors import BenchmarkApproximateNearestNeighbors
 from .bench_dbscan import BenchmarkDBSCAN
+from .bench_ingest import BenchmarkIngest
 from .bench_kmeans import BenchmarkKMeans
 from .bench_linear_regression import BenchmarkLinearRegression
 from .bench_logistic_regression import BenchmarkLogisticRegression
@@ -25,6 +26,7 @@ from .bench_umap import BenchmarkUMAP
 from .utils import log
 
 ALGORITHMS = {
+    "ingest": BenchmarkIngest,
     "pca": BenchmarkPCA,
     "kmeans": BenchmarkKMeans,
     "linear_regression": BenchmarkLinearRegression,

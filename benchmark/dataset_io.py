@@ -17,6 +17,26 @@ from typing import Optional, Tuple
 import numpy as np
 
 
+def write_parquet_part(
+    file_path: str,
+    X: np.ndarray,
+    y: Optional[np.ndarray] = None,
+    *,
+    features_col: str = "features",
+    label_col: str = "label",
+) -> None:
+    """Write one part-*.parquet file (list<float> features + optional label) —
+    the per-partition unit shared by `write_parquet_dataset` and the
+    partition-parallel generators (gen_data_distributed)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    cols = {features_col: pa.array(list(np.asarray(X).astype(np.float32)))}
+    if y is not None:
+        cols[label_col] = pa.array(np.asarray(y).astype(np.float64))
+    pq.write_table(pa.table(cols), file_path)
+
+
 def write_parquet_dataset(
     path: str,
     X: np.ndarray,
@@ -28,20 +48,19 @@ def write_parquet_dataset(
 ) -> int:
     """Write [n, d] features (+ labels) as `n_files` part-*.parquet files under
     `path` (the reference protocol's 50-file layout). Returns files written."""
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
     os.makedirs(path, exist_ok=True)
     n = len(X)
     n_files = max(1, min(n_files, n))
     bounds = np.linspace(0, n, n_files + 1).astype(np.int64)
     for f in range(n_files):
         lo, hi = int(bounds[f]), int(bounds[f + 1])
-        cols = {features_col: pa.array(list(X[lo:hi].astype(np.float32)))}
-        if y is not None:
-            cols[label_col] = pa.array(np.asarray(y[lo:hi]).astype(np.float64))
-        table = pa.table(cols)
-        pq.write_table(table, os.path.join(path, f"part-{f:05d}.parquet"))
+        write_parquet_part(
+            os.path.join(path, f"part-{f:05d}.parquet"),
+            X[lo:hi],
+            None if y is None else y[lo:hi],
+            features_col=features_col,
+            label_col=label_col,
+        )
     return n_files
 
 

@@ -43,6 +43,12 @@ config: Dict[str, Any] = {
     # whole mesh (model state replicated) instead of running on one device —
     # the reference's transform is parallel across all GPUs (core.py:1531-1635)
     "distributed_transform_min_rows": 1 << 15,
+    # host-side ingest chunking: per-row feature columns are converted
+    # column -> contiguous block (and CSR -> ELL) in row chunks of at most
+    # this many bytes, so ingest temporaries stay bounded instead of scaling
+    # with the dataset (the streaming analog of the reference's Arrow
+    # maxRecordsPerBatch-bounded batch loop, reference core.py:698-760)
+    "ingest_chunk_bytes": 128 << 20,
 }
 
 # Output-column naming contract shared by all predictive models

@@ -71,12 +71,37 @@ def as_pandas(dataset: Any):
     raise TypeError(f"Unsupported dataset type {type(dataset)}; expected pandas/pyarrow/dict")
 
 
+def ingest_chunk_rows(row_bytes: int) -> int:
+    """Rows per ingest chunk under ``core.config["ingest_chunk_bytes"]``."""
+    from .core import config  # lazy: core imports this module at load time
+
+    chunk_bytes = int(config.get("ingest_chunk_bytes", 128 << 20))
+    return max(1, chunk_bytes // max(1, int(row_bytes)))
+
+
+def _fill_dense_chunked(values, n_cols: int, dtype, to_row) -> np.ndarray:
+    """Object column of per-row vectors -> preallocated [n, n_cols] block,
+    converted one row-chunk at a time (chunk size bounded by
+    ``core.config["ingest_chunk_bytes"]``) so the per-row temporaries never
+    exceed one chunk — the old whole-column ``np.stack`` held a full second
+    copy of the dataset in flight."""
+    n = len(values)
+    out = np.empty((n, n_cols), dtype=dtype)
+    step = ingest_chunk_rows(n_cols * np.dtype(dtype).itemsize)
+    for lo in range(0, n, step):
+        hi = min(lo + step, n)
+        out[lo:hi] = [to_row(v) for v in values[lo:hi]]
+    return out
+
+
 def _column_to_matrix(col, dtype) -> Tuple[Any, str]:
     """Convert a single feature column (vectors / arrays / lists) to a 2-D block.
 
     Returns (matrix, kind) where kind is 'vector' when the column held
     Dense/SparseVector objects (so transform can emit vectors back) else 'array'.
-    Sparse rows produce a scipy CSR matrix.
+    Sparse rows produce a scipy CSR matrix. Dense conversion runs row-chunk by
+    row-chunk (``ingest_chunk_bytes``); the sparse path counts nnz first and
+    fills preallocated CSR arrays in place (no second full-nnz copy).
     """
     values = col.to_numpy() if hasattr(col, "to_numpy") else np.asarray(col, dtype=object)
     if len(values) == 0:
@@ -90,35 +115,44 @@ def _column_to_matrix(col, dtype) -> Tuple[Any, str]:
         )
         if any_sparse:
             size = first.size if isinstance(first, (DenseVector, SparseVector)) else first.shape[1]
-            indptr = [0]
-            indices: List[np.ndarray] = []
-            data: List[np.ndarray] = []
-            for v in values:
+            n = len(values)
+
+            def _row_parts(v):
                 if isinstance(v, SparseVector):
-                    idx, val = v.indices, v.values
-                elif isinstance(v, DenseVector):
+                    return v.indices, v.values
+                if isinstance(v, DenseVector):
                     idx = np.nonzero(v.values)[0].astype(np.int32)
-                    val = v.values[idx]
-                else:  # scipy sparse row
-                    v = v.tocsr()
-                    idx, val = v.indices, v.data
-                indices.append(idx)
-                data.append(val.astype(dtype, copy=False))
-                indptr.append(indptr[-1] + len(idx))
+                    return idx, v.values[idx]
+                v = v.tocsr()  # scipy sparse row
+                return v.indices, v.data
+
+            # decode each row ONCE (SparseVector rows contribute pure
+            # references to their own index/value arrays — no copy), size the
+            # CSR arrays from the decoded lengths, then fill in place, freeing
+            # the decoded Dense/scipy-row copies as they are consumed — no
+            # second full-nnz concatenate copy ever exists
+            parts = [_row_parts(v) for v in values]
+            indptr = np.zeros(n + 1, dtype=np.int64)
+            for i, (idx, _) in enumerate(parts):
+                indptr[i + 1] = indptr[i] + len(idx)
+            data = np.empty(int(indptr[-1]), dtype=dtype)
+            indices = np.empty(int(indptr[-1]), dtype=np.int32)
+            for i in range(n):
+                idx, val = parts[i]
+                parts[i] = None  # free decode copies as they are copied in
+                lo, hi = indptr[i], indptr[i + 1]
+                indices[lo:hi] = idx
+                data[lo:hi] = val  # cast to dtype on assignment
             mat = _sp.csr_matrix(
-                (np.concatenate(data) if data else np.zeros(0, dtype),
-                 np.concatenate(indices) if indices else np.zeros(0, np.int32),
-                 np.asarray(indptr, dtype=np.int64)),
-                shape=(len(values), size),
-                dtype=dtype,
+                (data, indices, indptr), shape=(n, size), dtype=dtype
             )
             return mat, "vector"
-        return np.stack([v.toArray() for v in values]).astype(dtype, copy=False), "vector"
+        return _fill_dense_chunked(values, first.size, dtype, lambda v: v.toArray()), "vector"
     # plain array/list rows
     if isinstance(first, np.ndarray) and first.ndim == 1:
-        return np.stack(list(values)).astype(dtype, copy=False), "array"
+        return _fill_dense_chunked(values, len(first), dtype, lambda v: v), "array"
     if isinstance(first, (list, tuple)):
-        return np.asarray([np.asarray(v) for v in values], dtype=dtype), "array"
+        return _fill_dense_chunked(values, len(first), dtype, np.asarray), "array"
     raise TypeError(f"Unsupported feature cell type {type(first)} in feature column")
 
 
@@ -188,9 +222,18 @@ def extract_dataset(
         missing = [c for c in input_cols if c not in pdf.columns]
         if missing:
             raise ValueError(f"feature columns not in dataset: {missing}")
-        features = np.ascontiguousarray(pdf[list(input_cols)].to_numpy(dtype=dtype))
-        kind = "multi_cols"
         names = list(input_cols)
+        # chunked column->block conversion: the whole-frame to_numpy holds a
+        # second full copy in flight; filling a preallocated block per
+        # row-chunk bounds the temporary at one chunk
+        n = len(pdf)
+        features = np.empty((n, len(names)), dtype=dtype)
+        step = ingest_chunk_rows(len(names) * np.dtype(dtype).itemsize)
+        sub = pdf[names]
+        for lo in range(0, n, step):
+            hi = min(lo + step, n)
+            features[lo:hi] = sub.iloc[lo:hi].to_numpy(dtype=dtype)
+        kind = "multi_cols"
     else:
         assert input_col is not None
         if input_col not in pdf.columns:

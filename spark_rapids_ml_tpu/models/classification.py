@@ -335,6 +335,11 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 )
             else:
                 state = logistic_fit(inputs.X, y_idx, inputs.w, **common)
+            from ..ops.logistic import warn_if_early_stall
+
+            warn_if_early_stall(
+                state, standardize=common["standardize"], max_iter=common["max_iter"]
+            )
             return {
                 "coef_": np.asarray(state["coef_"], dtype=np.float64),
                 "intercept_": np.asarray(state["intercept_"], dtype=np.float64),

@@ -18,7 +18,7 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import ROWS_AXIS
@@ -80,7 +80,9 @@ def _tile_assign_accumulate(
         # carry must be typed as varying over the mesh axis to match the
         # per-shard accumulators (JAX shard_map vma typing); the meshless
         # 1-device program (_lloyd_step_fused_1dev) has no axis to cast over
-        init = jax.tree.map(lambda t: jax.lax.pcast(t, ROWS_AXIS, to="varying"), init)
+        from ..parallel.mesh import pcast_varying
+
+        init = jax.tree.map(lambda t: pcast_varying(t, ROWS_AXIS), init)
     batch_rows = min(batch_rows, nl)
     n_full = (nl // batch_rows) * batch_rows
 

@@ -23,7 +23,7 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import ROWS_AXIS

@@ -28,6 +28,8 @@ from __future__ import annotations
 from functools import partial
 from typing import Dict, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -73,7 +75,9 @@ def _glm_qn_minimize(
       grad_from_z(flat_p, z) -> flat grad [F]            (incl. penalty grad)
       penalty_terms(flat_p, flat_d) -> (p0, p1, p2)      (penalty(p + a·d) =
                                                           p0 + a·p1 + a²·p2)
-    Returns (flat_params, objective, n_iter).
+    Returns (flat_params, objective, n_iter, stalled) — `stalled` is True when
+    the run ended because the batched Armijo check found NO acceptable step
+    (see the KNOWN LIMIT note below), not because tol/maxIter was reached.
     """
     m = memory
     # step candidates: one growth step, unit step, then geometric backtracking.
@@ -144,8 +148,34 @@ def _glm_qn_minimize(
         (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
         jnp.asarray(jnp.inf, x0.dtype), f0, jnp.asarray(0, jnp.int32), jnp.asarray(False),
     )
-    x, _, _, _, _, _, _, _, obj, n_iter, _ = jax.lax.while_loop(cond, body, state0)
-    return x, obj, n_iter
+    x, _, _, _, _, _, _, _, obj, n_iter, stalled = jax.lax.while_loop(cond, body, state0)
+    return x, obj, n_iter, stalled
+
+
+def warn_if_early_stall(state: Dict, *, standardize: bool, max_iter: int, logger=None) -> bool:
+    """Host-side signal for the KNOWN LIMIT above: when the Armijo stall check
+    ended an UNSTANDARDIZED fit well before maxIter/tol, the returned model is
+    silently under-converged — warn and point at standardization=True (the
+    sparse path's scale-only standardization restores conditioning without
+    densifying). Returns whether the warning fired; shared by the dense and
+    ELL fit wrappers' callers (models/classification.py)."""
+    stalled = bool(np.asarray(state.get("stalled_", False)))
+    n_iter = int(np.asarray(state.get("n_iter_", 0)))
+    if not stalled or standardize or n_iter >= max_iter:
+        return False
+    if logger is None:
+        from ..utils import get_logger
+
+        logger = get_logger("LogisticRegression")
+    logger.warning(
+        "L-BFGS line search stalled after %d/%d iterations on an "
+        "unstandardized fit — the model may be under-converged. Badly scaled "
+        "features shrink per-step objective improvements below f32 noise; "
+        "set standardization=True (sparse fits standardize scale-only, "
+        "preserving sparsity).",
+        n_iter, max_iter,
+    )
+    return True
 
 
 def _lbfgs_minimize(loss, params0, max_iter: int, tol: float, memory: int = 10):
@@ -354,8 +384,9 @@ def _fit_common(
             flat_loss, x0, l1_mask, lam_l1,
             max_iter=max_iter, tol=tol, memory=lbfgs_memory,
         )
+        stalled = jnp.asarray(False)
     else:
-        xf, obj, n_iter = _glm_qn_minimize(
+        xf, obj, n_iter, stalled = _glm_qn_minimize(
             z_of, rowloss, rowloss_alphas, grad_from_z, (n_rows, k_out), n_flat,
             dtype, penalty_terms, max_iter=max_iter, tol=tol, memory=lbfgs_memory,
         )
@@ -367,7 +398,10 @@ def _fit_common(
         # softmax shift invariance: center intercepts (Spark parity,
         # reference classification.py:1077-1089)
         intercept = intercept - jnp.mean(intercept)
-    return {"coef_": coef, "intercept_": intercept, "objective_": obj, "n_iter_": n_iter}
+    return {
+        "coef_": coef, "intercept_": intercept, "objective_": obj,
+        "n_iter_": n_iter, "stalled_": stalled,
+    }
 
 
 @partial(jax.jit, static_argnames=("multinomial",))

@@ -54,12 +54,25 @@ def csr_to_ell(
     dtype = dtype or csr.dtype
     indices = np.zeros((n, max(k_max, 1)), dtype=np.int32)
     values = np.zeros((n, max(k_max, 1)), dtype=dtype)
-    # vectorized fill: position of each nnz within its row
+    # vectorized fill, one row-chunk at a time: the whole-matrix scatter needs
+    # (rows, offsets) index temporaries of 16 bytes/nnz — at the 1e7 x 2200
+    # scale shape that is more memory than the data itself. Chunking bounds
+    # the temporaries by core.config["ingest_chunk_bytes"].
     if csr.nnz:
-        rows = np.repeat(np.arange(n), row_nnz)
-        offsets = np.arange(csr.nnz) - np.repeat(csr.indptr[:-1], row_nnz)
-        indices[rows, offsets] = csr.indices.astype(np.int32)
-        values[rows, offsets] = csr.data.astype(dtype, copy=False)
+        from ..data import ingest_chunk_rows
+
+        step = ingest_chunk_rows(max(k_max, 1) * (4 + np.dtype(dtype).itemsize))
+        indptr = csr.indptr
+        for lo in range(0, n, step):
+            hi = min(lo + step, n)
+            nnz_lo, nnz_hi = int(indptr[lo]), int(indptr[hi])
+            if nnz_hi == nnz_lo:
+                continue
+            cnt = row_nnz[lo:hi]
+            rows = np.repeat(np.arange(hi - lo), cnt)
+            offsets = np.arange(nnz_hi - nnz_lo) - np.repeat(indptr[lo:hi] - nnz_lo, cnt)
+            indices[lo:hi][rows, offsets] = csr.indices[nnz_lo:nnz_hi].astype(np.int32)
+            values[lo:hi][rows, offsets] = csr.data[nnz_lo:nnz_hi].astype(dtype, copy=False)
     return indices, values, max(k_max, 1)
 
 

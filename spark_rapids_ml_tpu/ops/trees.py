@@ -250,12 +250,12 @@ def _tree_level(
                     for s_i in range(S)
                 )
 
-            from ..parallel.mesh import ROWS_AXIS
+            from ..parallel.mesh import ROWS_AXIS, pcast_varying
 
             # the carry accumulates per-shard values: type it as varying over
             # the mesh axis (shard_map vma typing, like the KMeans carry)
             hist_cols0 = tuple(
-                jax.lax.pcast(jnp.zeros((n_seg,), stats_row.dtype), ROWS_AXIS, to="varying")
+                pcast_varying(jnp.zeros((n_seg,), stats_row.dtype), ROWS_AXIS)
                 for _ in range(S)
             )
             if n_row_tiles == 1:
@@ -381,7 +381,7 @@ def forest_fit(
     """Ensemble-split forest fit: device i grows trees [i*t0, (i+1)*t0) on its
     row shard. Returns stacked (feature [T, M], split_bin [T, M],
     node_stats [T, M, S])."""
-    from jax import shard_map
+    from ..parallel.mesh import shard_map
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 

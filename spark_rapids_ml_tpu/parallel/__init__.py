@@ -16,9 +16,12 @@ from .mesh import (  # noqa: F401
     get_mesh,
     make_global_rows,
     pad_rows,
+    place_row_shards,
+    place_rows,
     replicated,
     row_sharding,
     set_devices,
+    shard_row_slices,
 )
 from .partition import PartitionDescriptor  # noqa: F401
 from .context import (  # noqa: F401

@@ -59,7 +59,14 @@ class Pipeline(Params):
             name = getattr(p, "name", str(p))
             owners = [i for i, s in enumerate(routable) if s is not None and s.hasParam(name)]
             if not owners:
-                continue
+                # silently dropping a no-owner param would let a typo'd key in
+                # a CV/TVS grid train identical models — as loud as the
+                # ambiguous-owner case below
+                raise ValueError(
+                    f"param {name!r} is carried by no stage of this Pipeline — "
+                    "a typo'd or wrong-estimator key in a tuning grid would "
+                    "otherwise be silently ignored"
+                )
             if len(owners) > 1:
                 raise ValueError(
                     f"param {name!r} is carried by stages {owners}; tuning it through "

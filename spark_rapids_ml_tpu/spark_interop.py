@@ -125,6 +125,30 @@ def to_spark_vector(value):
     return SparkVectors.dense([float(v) for v in np.asarray(value).ravel()])
 
 
+def _first_non_null(col):
+    """First non-null cell of a pandas Series (None when all-null/empty).
+    Column-kind probing must skip leading None/NaN rows: deciding off row 0
+    alone leaves a vector column unconverted whenever its first cell is null,
+    and `spark.createDataFrame` then dies in the MLSerDe pickle branch."""
+    non_null = col.dropna()
+    return non_null.iloc[0] if len(non_null) else None
+
+
+def _vector_cell_or_none(v):
+    """Cell converter for a vector-typed column: null cells (None, float NaN,
+    pd.NA/NaT — everything `Series.dropna` skips) become None — a bare null
+    scalar in a VectorUDT column breaks Spark's serializer — everything else
+    goes through `to_spark_vector`."""
+    if v is None:
+        return None
+    if not isinstance(v, (list, tuple, np.ndarray)) and not hasattr(v, "toArray"):
+        import pandas as pd
+
+        if pd.isna(v):  # scalar here, so isna returns a scalar bool
+            return None
+    return to_spark_vector(v)
+
+
 def as_spark_df(dataset):
     """Any framework dataset (pandas DataFrame, pyarrow Table, dict, or an
     actual Spark DataFrame) -> Spark DataFrame, with array/Vector cells
@@ -138,9 +162,9 @@ def as_spark_df(dataset):
     spark, _ = _require_spark()
     pdf = as_pandas(dataset).copy(deep=False)
     for col in pdf.columns:
-        first = pdf[col].iloc[0] if len(pdf) else None
+        first = _first_non_null(pdf[col])
         if isinstance(first, (list, tuple, np.ndarray)) or hasattr(first, "toArray"):
-            pdf[col] = pdf[col].map(to_spark_vector)
+            pdf[col] = pdf[col].map(_vector_cell_or_none)
     return spark.createDataFrame(pdf)
 
 

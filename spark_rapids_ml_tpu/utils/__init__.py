@@ -33,9 +33,12 @@ def get_logger(cls_or_name, level: str = "INFO") -> logging.Logger:
 def unit_rows(x: np.ndarray, eps: float = 1e-12) -> np.ndarray:
     """Row-normalize to unit L2 norm with a zero-norm guard — THE cosine
     convention shared by every cosine path (ANN index/query/refine, UMAP
-    fit/transform): zero rows stay zero (distance 1 to everything through
-    the 1 − cosθ formula, matching sklearn's handling closely enough for
-    ranking)."""
+    fit/transform): zero rows stay zero. Against unit index vectors a zero
+    row's squared euclidean distance is 1, so the kernels' d²/2 conversion
+    reports cosine distance 0.5 to EVERYTHING — equidistant, hence
+    ranking-neutral, but NOT sklearn's 1.0 convention for zero vectors
+    (sklearn defines cos(0, v) = 0). Documented deviation, pinned by
+    tests/test_ingest.py::test_unit_rows_zero_row_convention."""
     x = np.asarray(x, np.float32)
     return x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), eps)
 

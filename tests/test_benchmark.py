@@ -12,6 +12,7 @@ from benchmark.benchmark_runner import ALGORITHMS, PROTOCOL
 
 
 SMOKE = {
+    "ingest": ["--num_rows", "4000", "--num_cols", "64"],
     "pca": ["--num_rows", "2000", "--num_cols", "32"],
     "kmeans": ["--num_rows", "2000", "--num_cols", "16", "--k", "8", "--maxIter", "3"],
     "linear_regression": ["--num_rows", "2000", "--num_cols", "16"],
@@ -167,3 +168,26 @@ def test_benchmark_cagra_smoke(tmp_path):
     )
     assert row["recall"] >= 0.8
     assert row["build_sec"] > 0 and row["search_sec"] > 0
+
+
+def test_benchmark_sparse_logistic_lane(tmp_path):
+    # --density > 0: the padded-ELL lane over the partition-parallel generator
+    # (benchmark/gen_data_distributed.py), streamed into ELL without full-CSR
+    # materialization; quality = accuracy of the binarized-target fit
+    report = str(tmp_path / "report.csv")
+    row = ALGORITHMS["logistic_regression"]().run(
+        ["--num_rows", "4000", "--num_cols", "100", "--density", "0.02",
+         "--maxIter", "25", "--report", report]
+    )
+    assert row["fit_sec"] > 0
+    assert row["accuracy"] > 0.75
+    assert os.path.exists(report)
+
+
+def test_benchmark_ingest_records_chunked_vs_monolithic(tmp_path):
+    # tentpole acceptance: the suite records chunked vs monolithic ingest wall
+    # time side by side
+    row = ALGORITHMS["ingest"]().run(["--num_rows", "20000", "--num_cols", "128"])
+    assert row["fit_sec"] > 0  # chunked placement
+    assert row["monolithic_place_sec"] > 0
+    assert row["extract_sec"] > 0

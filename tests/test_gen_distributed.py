@@ -1,0 +1,141 @@
+#
+# Partition-parallel data generation tests (reference gen_data_distributed.py
+# analog): per-partition seed determinism, bit-identical output for any
+# process count, streaming ELL assembly equality, and the scaled-down
+# 1e7x2200 sparse scale-shape lane (slow).
+#
+import os
+
+import numpy as np
+import pytest
+
+from benchmark.gen_data_distributed import (
+    GENERATORS,
+    BlobsDataGen,
+    ClassificationDataGen,
+    RegressionDataGen,
+    SparseRegressionDataGen,
+    iter_sparse_npz_dataset,
+    partitions_to_ell,
+    read_sparse_npz_dataset,
+)
+
+
+def test_partition_content_is_pure_function_of_seed_and_index():
+    # two independent instances, any order of partition generation: identical
+    a = SparseRegressionDataGen(5_003, 64, seed=11, n_partitions=4, density=0.05)
+    b = SparseRegressionDataGen(5_003, 64, seed=11, n_partitions=4, density=0.05)
+    xb, yb = b.gen_partition(2)  # b generates ONLY partition 2
+    for i in [0, 3, 2, 1]:
+        a.gen_partition(i)
+    xa, ya = a.gen_partition(2)
+    assert (xa != xb).nnz == 0
+    np.testing.assert_array_equal(ya, yb)
+    # different seed / different partition => different bytes
+    c = SparseRegressionDataGen(5_003, 64, seed=12, n_partitions=4, density=0.05)
+    xc, _ = c.gen_partition(2)
+    assert (xa != xc).nnz > 0
+
+
+def test_partition_bounds_cover_rows_exactly():
+    g = RegressionDataGen(1000, 8, seed=0, n_partitions=7)
+    bounds = [g.partition_bounds(i) for i in range(7)]
+    assert bounds[0][0] == 0 and bounds[-1][1] == 1000
+    for (lo, hi), (lo2, _) in zip(bounds, bounds[1:]):
+        assert hi == lo2 and hi > lo
+
+
+@pytest.mark.parametrize("kind", sorted(GENERATORS))
+def test_write_bit_identical_across_process_counts(kind, tmp_path):
+    gen = GENERATORS[kind](2_001, 12, seed=5, n_partitions=5)
+    d1, d3 = str(tmp_path / "p1"), str(tmp_path / "p3")
+    assert gen.write(d1, n_processes=1) == 5
+    assert gen.write(d3, n_processes=3) == 5
+    files1 = sorted(os.listdir(d1))
+    files3 = sorted(os.listdir(d3))
+    assert files1 == files3 and len(files1) == 5
+    for f in files1:
+        with open(os.path.join(d1, f), "rb") as fa, open(os.path.join(d3, f), "rb") as fb:
+            assert fa.read() == fb.read(), f"part file {f} differs across process counts"
+
+
+def test_generate_matches_written_partitions(tmp_path):
+    from benchmark.dataset_io import read_parquet_dataset
+
+    g = ClassificationDataGen(1_234, 10, seed=2, n_partitions=3, n_classes=3)
+    X, y = g.generate()
+    assert X.shape == (1_234, 10) and set(np.unique(y)) <= {0, 1, 2}
+    out = str(tmp_path / "ds")
+    g.write(out, n_processes=2)
+    X2, y2 = read_parquet_dataset(out)
+    np.testing.assert_array_equal(X2, X)
+    np.testing.assert_array_equal(y2.astype(np.int64), y)
+
+    gs = SparseRegressionDataGen(999, 40, seed=3, n_partitions=4, density=0.05)
+    Xs, ys = gs.generate()
+    outs = str(tmp_path / "sp")
+    gs.write(outs, n_processes=2)
+    Xr, yr = read_sparse_npz_dataset(outs)
+    assert (Xs != Xr).nnz == 0
+    np.testing.assert_array_equal(ys, yr)
+    # streaming reader yields partitions in order with the same total
+    n_stream = sum(x.shape[0] for x, _ in iter_sparse_npz_dataset(outs))
+    assert n_stream == 999
+
+
+def test_partitions_to_ell_matches_whole_csr_conversion():
+    from spark_rapids_ml_tpu.ops.sparse import csr_to_ell
+
+    g = SparseRegressionDataGen(3_000, 80, seed=9, n_partitions=6, density=0.03)
+    idx_s, val_s, k_s, y_s = partitions_to_ell(g)
+    X, y = g.generate()
+    idx_w, val_w, k_w = csr_to_ell(X, k_max=k_s, dtype=np.float32)
+    np.testing.assert_array_equal(idx_s, idx_w)
+    np.testing.assert_array_equal(val_s, val_w)
+    np.testing.assert_array_equal(y_s, y)
+
+
+def test_blobs_labels_match_centers():
+    g = BlobsDataGen(800, 6, seed=1, n_partitions=2, centers=4)
+    X, y = g.generate()
+    C = g.shared["C"]
+    d2 = ((X[:, None, :] - C[None, :, :]) ** 2).sum(-1)
+    # cluster_std=1 around well-separated (10x) centers: labels = nearest center
+    assert (np.argmin(d2, axis=1) == y).mean() > 0.99
+
+
+def test_cli_writes_parts(tmp_path):
+    from benchmark.gen_data_distributed import main as gen_main
+
+    out = str(tmp_path / "cli")
+    gen_main([
+        "sparse_regression", "--num_rows", "400", "--num_cols", "30",
+        "--density", "0.1", "--n_partitions", "3", "--n_processes", "2",
+        "--output", out,
+    ])
+    X, y = read_sparse_npz_dataset(out)
+    assert X.shape == (400, 30) and y.shape == (400,)
+    assert 0.05 < X.nnz / (400 * 30) < 0.2
+
+
+@pytest.mark.slow
+def test_scale_shape_partition_parallel(tmp_path):
+    # the 1e7 x 2200 sparse regression scale shape, scaled down 25x in rows
+    # (same width/density => same per-row statistics): partition-parallel
+    # write, per-partition seed determinism, and the streaming ELL budget
+    n, d, density = 400_000, 2200, 0.001
+    g = SparseRegressionDataGen(n, d, seed=0, density=density, n_partitions=8)
+    out = str(tmp_path / "scale")
+    g.write(out, n_processes=2)
+    # an independent instance generating ONLY partition 5 reproduces the
+    # written file's content bit-exactly
+    solo = SparseRegressionDataGen(n, d, seed=0, density=density, n_partitions=8)
+    x5, y5 = solo.gen_partition(5)
+    parts = list(iter_sparse_npz_dataset(out))
+    assert len(parts) == 8
+    assert (parts[5][0] != x5).nnz == 0
+    np.testing.assert_array_equal(parts[5][1], y5)
+    # streaming ELL ingest: k_max stays in the padded-ELL design budget
+    idx, val, k_max, y = partitions_to_ell(g)
+    assert idx.shape[0] == n and k_max <= 64
+    assert abs(val.astype(bool).sum() / (n * d) - density) / density < 0.05

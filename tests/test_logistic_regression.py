@@ -427,3 +427,59 @@ def test_sparse_logistic_large_scale(rng):
     strong = np.abs(coef[:50]) > 1
     agree = (np.sign(m.coef_[0][:50]) == np.sign(coef[:50]))[strong].mean()
     assert agree > 0.9
+
+
+def test_early_stall_warning_on_unstandardized_fit(rng):
+    # ADVICE round 5: when the Armijo stall check ends an UNSTANDARDIZED fit
+    # well before maxIter/tol, the user gets a warning pointing at
+    # standardization=True instead of a silently under-converged model.
+    # (The framework logger sets propagate=False, so capture with a handler.)
+    import logging
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    n, d = 4000, 6
+    x = rng.normal(size=(n, d)) * 1e4  # badly scaled: minimizer |coef| >> 1
+    y = (x[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    handler = _Capture(level=logging.WARNING)
+    logger = logging.getLogger("spark_rapids_ml_tpu.LogisticRegression")
+    logger.addHandler(handler)
+    try:
+        m = LogisticRegression(maxIter=200, standardization=False).setFeaturesCol(
+            "features"
+        ).fit(df)
+        assert m.n_iter_ < 200
+        assert any("stalled" in r for r in records)
+
+        # the standardized fit must NOT warn
+        records.clear()
+        LogisticRegression(maxIter=50, standardization=True).setFeaturesCol(
+            "features"
+        ).fit(df)
+        assert not any("stalled" in r for r in records)
+    finally:
+        logger.removeHandler(handler)
+
+
+def test_warn_if_early_stall_helper():
+    # host-side decision table of the warning helper (ops/logistic.py)
+    import logging
+
+    from spark_rapids_ml_tpu.ops.logistic import warn_if_early_stall
+
+    logger = logging.getLogger("srml-test-stall")
+    stalled_early = {"stalled_": np.asarray(True), "n_iter_": np.asarray(3)}
+    assert warn_if_early_stall(stalled_early, standardize=False, max_iter=100, logger=logger)
+    # standardized fits never warn (the stall limit is an unstandardized-
+    # conditioning failure mode)
+    assert not warn_if_early_stall(stalled_early, standardize=True, max_iter=100, logger=logger)
+    # running to maxIter is not a stall termination
+    at_max = {"stalled_": np.asarray(True), "n_iter_": np.asarray(100)}
+    assert not warn_if_early_stall(at_max, standardize=False, max_iter=100, logger=logger)
+    clean = {"stalled_": np.asarray(False), "n_iter_": np.asarray(40)}
+    assert not warn_if_early_stall(clean, standardize=False, max_iter=100, logger=logger)

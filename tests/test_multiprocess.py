@@ -41,6 +41,14 @@ def _launch_workers(nranks, tmp_path, local_devices=2):
         for r in range(nranks)
     ]
     outputs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    if any(
+        "Multiprocess computations aren't implemented on the CPU backend" in out
+        for out in outputs
+    ):
+        # older jax/XLA CPU backends cannot execute cross-process SPMD
+        # programs at all — the capability this harness exists to test is
+        # absent from the environment, not broken in the framework
+        pytest.skip("CPU backend lacks multi-process SPMD execution (jax/XLA too old)")
     for r, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
     return out_dir

@@ -47,7 +47,7 @@ def test_make_global_rows_weights_mask_padding(mesh8):
 
 
 def test_live_psum_over_mesh(mesh8):
-    from jax import shard_map
+    from spark_rapids_ml_tpu.parallel.mesh import shard_map
 
     x = np.arange(16, dtype=np.float32).reshape(16, 1)
     X, w, _ = make_global_rows(mesh8, x)

@@ -106,6 +106,17 @@ def test_pipeline_copy_ambiguous_param_raises(rng):
     assert out.getStages()[0].getOrDefault("k") == 2
 
 
+def test_pipeline_copy_unmatched_param_raises():
+    # a typo'd / wrong-estimator key owned by NO stage must be as loud as the
+    # ambiguous case — silently dropping it would train identical models for
+    # every point of a CV/TVS grid (ADVICE round 5)
+    pipe = Pipeline(stages=[PCA(k=2), LogisticRegression()])
+    with pytest.raises(ValueError, match="no stage"):
+        pipe.copy({"regParamm": 0.5})  # typo'd name
+    with pytest.raises(ValueError, match="no stage"):
+        pipe.copy({"maxDepth": 3})  # wrong-estimator key (RF param)
+
+
 def test_pipeline_validation():
     with pytest.raises(ValueError, match="stages"):
         Pipeline().fit(pd.DataFrame({"features": []}))
