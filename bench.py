@@ -37,6 +37,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -101,11 +102,13 @@ def _time_fit(run, fetch, repeats=2) -> float:
 def bench_pca(X, w, mesh) -> float:
     import jax
 
-    from spark_rapids_ml_tpu.ops.pca import pca_fit
+    from spark_rapids_ml_tpu.ops.pca import pca_fit, record_pca_fit
 
     fit = jax.jit(lambda X, w: pca_fit(X, w, k=3))
-    np.asarray(fit(X, w)["components_"])  # compile + warm
+    state = fit(X, w)
+    np.asarray(state["components_"])  # compile + warm
     fit_s = _time_fit(lambda: fit(X, w), lambda s: s["components_"])
+    record_pca_fit(state, k=3)  # outside the timer
     _log(f"pca: {fit_s:.2f}s fit")
     return N_ROWS / fit_s
 
@@ -146,14 +149,19 @@ def bench_kmeans(X, w, mesh) -> float:
 
 
 def bench_logreg(X, w, y_idx) -> float:
+    from spark_rapids_ml_tpu import telemetry
     from spark_rapids_ml_tpu.ops.logistic import logistic_fit
 
     run = lambda: logistic_fit(  # noqa: E731
         X, y_idx, w, k=2, multinomial=False, lam_l2=1e-5,
         fit_intercept=True, standardize=True, max_iter=200, tol=1e-30,
     )
-    np.asarray(run()["coef_"])  # compile + warm
+    state = run()
+    np.asarray(state["coef_"])  # compile + warm
     fit_s = _time_fit(lambda: run(), lambda s: s["coef_"], repeats=1)
+    telemetry.record_solver_result(  # outside the timer
+        "logistic", n_iter=int(state["n_iter_"]), objective=float(state["objective_"])
+    )
     _log(f"logreg: {fit_s:.2f}s fit (maxIter=200, tol=1e-30)")
     return N_ROWS / fit_s
 
@@ -175,8 +183,14 @@ def bench_sparse_logreg(mesh) -> float:
         d=SPARSE_COLS, k=2, multinomial=False, lam_l2=1e-6,
         fit_intercept=True, standardize=True, max_iter=60, tol=1e-12,
     )
-    np.asarray(run()["coef_"])  # compile + warm
+    state = run()
+    np.asarray(state["coef_"])  # compile + warm
     fit_s = _time_fit(run, lambda s: s["coef_"], repeats=1)
+    from spark_rapids_ml_tpu import telemetry
+
+    telemetry.record_solver_result(  # outside the timer
+        "sparse_logistic", n_iter=int(state["n_iter_"]), objective=float(state["objective_"])
+    )
     _log(f"sparse_logreg: {fit_s:.2f}s fit ({SPARSE_ROWS}x{SPARSE_COLS} @ {SPARSE_DENSITY})")
     return SPARSE_ROWS / fit_s
 
@@ -186,12 +200,20 @@ def run_child() -> int:
     import jax
 
     from benchmark.gen_data import gen_classification_device
+    from spark_rapids_ml_tpu import telemetry
     from spark_rapids_ml_tpu.parallel import get_mesh
 
     skip = set(filter(None, os.environ.get("BENCH_SKIP", "").split(",")))
     pending = [a for a in bench_algos() if a not in skip]
     if not pending:
         return 0
+
+    # Registry telemetry (counters/gauges/span aggregates) is host-side and
+    # cheap — enable it so the BENCH emission carries the per-stage snapshot.
+    # Per-iteration convergence tracing stays OFF unless the env asks: a host
+    # callback per solver iteration is a dispatch round-trip through the
+    # tunnel and would poison the timings.
+    telemetry.enable()
 
     mesh = get_mesh()
     print("@READY", flush=True)  # backend init survived — parent relaxes its watchdog
@@ -234,6 +256,10 @@ def run_child() -> int:
         except Exception as e:  # fail-soft: one dead section keeps the rest
             n_fail += 1
             _log(f"bench[{name}] FAILED: {type(e).__name__}: {e}")
+    # per-stage telemetry snapshot (HBM watermark, solver iterations, span
+    # aggregates) for the parent to embed in the BENCH JSON line
+    telemetry.record_device_memory()
+    print("@TELEMETRY " + json.dumps(telemetry.snapshot()), flush=True)
     return 1 if n_fail else 0
 
 
@@ -285,10 +311,13 @@ def _run_child_watched(env: dict, attempt_timeout: float):
     return "".join(lines), (proc.returncode if killed is None else -1), init_hang
 
 
-def emit(results: dict) -> None:
+def emit(results: dict, telemetry_snap: Optional[dict] = None) -> None:
     """The one stdout JSON line. Degrades to value 0.0 when nothing ran.
     Only the three headline BASELINES algos enter the geomean; extra lanes
-    (sparse_logreg) are logged to stderr."""
+    (sparse_logreg) are logged to stderr. When the child reported a telemetry
+    snapshot (@TELEMETRY line), it is embedded under "telemetry" — the same
+    counters/gauges/span-aggregate dict `telemetry.snapshot()` returns
+    in-process (docs/observability.md)."""
     for name, v in results.items():
         if name not in BASELINES and v and np.isfinite(v):
             _log(f"{name}: {v:,.0f} rows/sec/chip (no baseline; excluded from geomean)")
@@ -307,29 +336,28 @@ def emit(results: dict) -> None:
     )
     for name, v in ok.items():
         _log(f"{name}: {v:,.0f} rows/sec/chip (baseline {BASELINES[name]:,.0f}; {v / BASELINES[name]:.1f}x)")
-    print(
-        json.dumps(
-            {
-                "metric": "classical_ml_fit_throughput_geomean",
-                "value": round(geo, 1),
-                "unit": unit,
-                "vs_baseline": round(geo_vs, 3),
-            }
-        ),
-        flush=True,
-    )
+    record = {
+        "metric": "classical_ml_fit_throughput_geomean",
+        "value": round(geo, 1),
+        "unit": unit,
+        "vs_baseline": round(geo_vs, 3),
+    }
+    if telemetry_snap:
+        record["telemetry"] = telemetry_snap
+    print(json.dumps(record), flush=True)
 
 
 def main() -> None:
     results: dict = {}
+    telemetry_snap: dict = {}
     try:
-        _attempt_loop(results)
+        _attempt_loop(results, telemetry_snap)
     except Exception as e:  # the JSON line is a CONTRACT: never die before emit
         _log(f"bench driver error: {type(e).__name__}: {e}")
-    emit(results)
+    emit(results, telemetry_snap)
 
 
-def _attempt_loop(results: dict) -> None:
+def _attempt_loop(results: dict, telemetry_snap: Optional[dict] = None) -> None:
     # total budget DEFAULTS BELOW any plausible driver timeout: if the caller
     # kills this process before emit(), the JSON contract is lost — 45 min
     # fits ~4 full attempts at the protocol scale with backoff. A run of
@@ -359,6 +387,14 @@ def _attempt_loop(results: dict) -> None:
                     rec = json.loads(line[len("@RESULT "):])
                     results[rec["algo"]] = float(rec["rows_per_sec_chip"])
                 except (ValueError, KeyError, TypeError):
+                    pass
+            elif line.startswith("@TELEMETRY ") and telemetry_snap is not None:
+                try:  # last reporting child wins (one snapshot per attempt)
+                    snap = json.loads(line[len("@TELEMETRY "):])
+                    if isinstance(snap, dict):
+                        telemetry_snap.clear()
+                        telemetry_snap.update(snap)
+                except ValueError:
                     pass
         if all(a in results for a in bench_algos()):
             break

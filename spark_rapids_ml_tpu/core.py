@@ -280,15 +280,53 @@ class _TpuCaller(_TpuCommon):
         the feature block is placed in HBM once; each param-map's solver call
         reuses it. Returns one model-attribute dict per param map (or a single
         one when param_maps is None).
+
+        Stage timing rides on `telemetry.span` (ingest/layout/solve): spans
+        feed the metrics registry + JSONL sink when telemetry is on and log
+        the old ``stage <name>: <t>s`` lines when `verbose` is set — one
+        mechanism instead of parallel hand-rolled timing. The per-fit
+        registry delta lands on models as ``_fit_metrics``
+        (see `_TpuEstimator._fit_internal`).
         """
-        import time
+        import contextlib
+
+        from . import telemetry
 
         logger = get_logger(type(self))
         verbose = bool(self._solver_params.get("verbose"))
-        t_start = time.perf_counter()
-        extracted = self._pre_process_data(dataset, for_fit=True)
-        if verbose:
-            logger.info("stage ingest: %.3fs", time.perf_counter() - t_start)
+        stage_logger = logger if verbose else None
+        # Opt-in tracing (the NVTX/xprof analog, SURVEY.md §5): when
+        # SRML_PROFILE_DIR is set, the whole fit runs under a jax.profiler
+        # trace viewable in xprof/tensorboard. The trace must begin BEFORE the
+        # fit/ingest spans open — a TraceAnnotation entered outside an active
+        # trace is not captured, and docs/observability.md promises every
+        # stage span as an xprof annotation.
+        profile_dir = os.environ.get("SRML_PROFILE_DIR")
+        profile_cm: Any = contextlib.nullcontext()
+        if profile_dir:
+            import jax
+
+            profile_cm = jax.profiler.trace(profile_dir)
+        with profile_cm, telemetry.fit_scope(
+            type(self).__name__
+        ) as tele_scope, telemetry.span(
+            "fit", logger=stage_logger, estimator=type(self).__name__
+        ):
+            rows = self._call_fit_func_traced(dataset, param_maps, logger, stage_logger)
+        self._last_fit_metrics = tele_scope["metrics"]
+        return rows
+
+    def _call_fit_func_traced(
+        self,
+        dataset: Any,
+        param_maps: Optional[List[Dict[Param, Any]]],
+        logger: Any,
+        stage_logger: Any,
+    ) -> List[Dict[str, Any]]:
+        from . import telemetry
+
+        with telemetry.span("ingest", logger=stage_logger):
+            extracted = self._pre_process_data(dataset, for_fit=True)
         fit_func = self._get_tpu_fit_func(extracted)
 
         import contextlib
@@ -314,23 +352,12 @@ class _TpuCaller(_TpuCommon):
                 0, 1, num_devices=min(self.num_workers, len(default_devices()))
             )
 
-        # Opt-in tracing (the NVTX/xprof analog, SURVEY.md §5): when
-        # SRML_PROFILE_DIR is set, the whole fit runs under a jax.profiler
-        # trace viewable in xprof/tensorboard.
-        profile_dir = os.environ.get("SRML_PROFILE_DIR")
-        profile_cm: Any = contextlib.nullcontext()
-        if profile_dir:
-            import jax
-
-            profile_cm = jax.profiler.trace(profile_dir)
-
-        with profile_cm, ctx_mgr as ctx, dtype_scope(
+        with ctx_mgr as ctx, dtype_scope(
             np.float32 if self._float32_inputs else np.float64, self._matmul_precision
         ):
-            t_layout = time.perf_counter()
-            inputs = self._build_fit_inputs(extracted, ctx)
-            if verbose:
-                logger.info("stage device layout: %.3fs", time.perf_counter() - t_layout)
+            with telemetry.span("layout", logger=stage_logger):
+                inputs = self._build_fit_inputs(extracted, ctx)
+            telemetry.record_device_memory()  # HBM watermark after placement
             logger.info(
                 "fit: %d rows x %d cols on %d-device mesh (%s)%s",
                 inputs.n_valid, inputs.n_cols, inputs.mesh.devices.size,
@@ -352,16 +379,26 @@ class _TpuCaller(_TpuCommon):
                             est._set_solver_param(mapped, v, silent=True)
                     solver_param_sets.append(dict(est._solver_params))
             rows = []
+            solve_times: List[float] = []
             for i, sp in enumerate(solver_param_sets):
-                t_solve = time.perf_counter()
-                rows.append(fit_func(inputs, sp))
-                if verbose:
-                    logger.info(
-                        "stage solve[%d/%d]: %.3fs", i + 1, len(solver_param_sets),
-                        time.perf_counter() - t_solve,
-                    )
-            if verbose:
-                logger.info("stage total fit: %.3fs", time.perf_counter() - t_start)
+                with telemetry.span(
+                    "solve", logger=stage_logger, index=i, of=len(solver_param_sets)
+                ) as solve_span:
+                    rows.append(fit_func(inputs, sp))
+                if solve_span.wall_s is not None:
+                    solve_times.append(solve_span.wall_s)
+            # compile-vs-execute first-call probe: valid ONLY when the solver
+            # param sets are identical re-runs of one program — different
+            # maps change the work itself (e.g. a maxIter grid), so "first
+            # minus fastest repeat" would report execute-time differences as
+            # compile overhead (and can go negative)
+            if len(solve_times) > 1 and all(
+                sp == solver_param_sets[0] for sp in solver_param_sets[1:]
+            ):
+                telemetry.registry().gauge(
+                    "fit.compile_overhead_s_est", solve_times[0] - min(solve_times[1:])
+                )
+            telemetry.record_device_memory()  # HBM watermark after solve
         return rows
 
 
@@ -386,10 +423,12 @@ class _TpuEstimator(_TpuCaller):
 
     def _fit_internal(self, dataset: Any, paramMaps: Optional[List[Dict[Param, Any]]]) -> List["_TpuModel"]:
         attr_rows = self._call_fit_func(dataset, paramMaps)
+        fit_metrics = getattr(self, "_last_fit_metrics", {})
         models = []
         for i, attrs in enumerate(attr_rows):
             model = self._create_model(attrs)
             model._model_attributes = attrs
+            model._fit_metrics = fit_metrics
             self._copyValues(model, paramMaps[i] if paramMaps else None)
             self._copy_solver_params(model)
             if paramMaps:
@@ -465,6 +504,9 @@ class _TpuModel(_TpuCommon):
     def __init__(self, **model_attrs: Any) -> None:
         super().__init__()
         self._model_attributes: Dict[str, Any] = model_attrs
+        # per-fit telemetry delta (counters/spans/gauges captured during the
+        # fit that produced this model); {} when telemetry was disabled
+        self._fit_metrics: Dict[str, Any] = {}
 
     @property
     def hasSummary(self) -> bool:
@@ -550,6 +592,7 @@ class _TpuModelWithColumns(_TpuModel):
         all-GPU parallel transform, core.py:1531-1635)."""
         import jax
 
+        from . import telemetry
         from .parallel.mesh import (
             default_devices,
             dtype_scope,
@@ -559,7 +602,9 @@ class _TpuModelWithColumns(_TpuModel):
             row_sharding,
         )
 
-        with dtype_scope(
+        with telemetry.span(
+            "transform", model=type(self).__name__, rows=int(features.shape[0])
+        ), dtype_scope(
             np.float32 if self._float32_inputs else np.float64, self._matmul_precision
         ):
             construct, predict, _ = self._get_transform_func()
@@ -585,6 +630,10 @@ class _TpuModelWithColumns(_TpuModel):
                     state,
                 )
                 batch *= n_dev  # per-device batch budget stays constant
+            if telemetry.enabled():
+                reg = telemetry.registry()
+                reg.inc("transform.rows", n)
+                reg.inc("transform.batches", -(-n // batch) if n else 0)
             outs: List[Any] = []
             for start in range(0, n, batch):
                 stop = min(start + batch, n)

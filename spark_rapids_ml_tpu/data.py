@@ -79,18 +79,43 @@ def ingest_chunk_rows(row_bytes: int) -> int:
     return max(1, chunk_bytes // max(1, int(row_bytes)))
 
 
+def _record_ingest(extracted: "ExtractedData") -> "ExtractedData":
+    """Telemetry counters for a completed extraction: rows and host bytes
+    staged (CSR counts its data+index arrays). Flag-checked no-op when
+    telemetry is disabled."""
+    from . import telemetry
+
+    if telemetry.enabled():
+        feats = extracted.features
+        if extracted.is_sparse:
+            nbytes = feats.data.nbytes + feats.indices.nbytes + feats.indptr.nbytes
+        else:
+            nbytes = feats.nbytes
+        for aux in (extracted.label, extracted.weight, extracted.row_id):
+            if aux is not None:
+                nbytes += aux.nbytes
+        reg = telemetry.registry()
+        reg.inc("ingest.rows", extracted.n_rows)
+        reg.inc("ingest.bytes", nbytes)
+        reg.inc("ingest.datasets")
+    return extracted
+
+
 def _fill_dense_chunked(values, n_cols: int, dtype, to_row) -> np.ndarray:
     """Object column of per-row vectors -> preallocated [n, n_cols] block,
     converted one row-chunk at a time (chunk size bounded by
     ``core.config["ingest_chunk_bytes"]``) so the per-row temporaries never
     exceed one chunk — the old whole-column ``np.stack`` held a full second
     copy of the dataset in flight."""
+    from . import telemetry
+
     n = len(values)
     out = np.empty((n, n_cols), dtype=dtype)
     step = ingest_chunk_rows(n_cols * np.dtype(dtype).itemsize)
     for lo in range(0, n, step):
         hi = min(lo + step, n)
         out[lo:hi] = [to_row(v) for v in values[lo:hi]]
+        telemetry.registry().inc("ingest.chunks")
     return out
 
 
@@ -207,14 +232,14 @@ def extract_dataset(
                 raise ValueError(f"column {colname!r} not in dataset")
             return np.asarray(dataset[colname], dtype=dt)
 
-        return ExtractedData(
+        return _record_ingest(ExtractedData(
             features=features,
             label=_dict_scalar(label_col, dtype),
             weight=_dict_scalar(weight_col, dtype),
             row_id=_dict_scalar(id_col, np.int64),
             feature_kind=kind,
             feature_names=[input_col],
-        )
+        ))
 
     pdf = as_pandas(dataset)
 
@@ -254,14 +279,14 @@ def extract_dataset(
             raise ValueError(f"column {colname!r} not in dataset")
         return pdf[colname].to_numpy(dtype=dt)
 
-    return ExtractedData(
+    return _record_ingest(ExtractedData(
         features=features,
         label=_scalar(label_col, dtype),
         weight=_scalar(weight_col, dtype),
         row_id=_scalar(id_col, np.int64),
         feature_kind=kind,
         feature_names=names,
-    )
+    ))
 
 
 def vectors_to_pandas_column(matrix: np.ndarray) -> list:

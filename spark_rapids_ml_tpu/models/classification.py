@@ -340,6 +340,15 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             warn_if_early_stall(
                 state, standardize=common["standardize"], max_iter=common["max_iter"]
             )
+            from .. import telemetry
+
+            if telemetry.enabled():  # gate: the arg fetches sync with the device
+                telemetry.record_solver_result(
+                    "logistic",
+                    n_iter=int(state["n_iter_"]),
+                    objective=float(state["objective_"]),
+                    stalled=bool(np.asarray(state.get("stalled_", False))),
+                )
             return {
                 "coef_": np.asarray(state["coef_"], dtype=np.float64),
                 "intercept_": np.asarray(state["intercept_"], dtype=np.float64),

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .. import telemetry
 from ..parallel.mesh import ROWS_AXIS
 
 
@@ -267,13 +268,22 @@ def kmeans_fit(
     # 1.5s of the protocol fit); checking the PREVIOUS iteration's shift
     # overlaps the fetch with the current step's compute. At most one extra
     # Lloyd iteration runs after the tol crossing (same fixpoint).
+    # Convergence trace: the shift scalar for iteration i-1 is fetched here
+    # ANYWAY (the deferred check), so recording it into the telemetry registry
+    # costs no extra device synchronization.
     prev_shift = None
     for _ in range(max_iter):
         centers, inertia, shift = step(centers, fast)
         n_iter += 1
-        if prev_shift is not None and float(prev_shift) <= tol:
-            break
+        if prev_shift is not None:
+            shift_host = float(prev_shift)
+            if telemetry.enabled():
+                telemetry.record_convergence_point("kmeans.shift", n_iter - 1, shift_host)
+            if shift_host <= tol:
+                break
         prev_shift = shift
+    if telemetry.enabled():
+        telemetry.record_solver_result("kmeans", n_iter=n_iter)
     # inertia reported is one iteration stale; recompute once with final
     # centers — always at high precision. Callers that don't consume inertia
     # (e.g. the IVF coarse quantizer) skip the pass: the high-precision

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .. import telemetry
 from .linalg import weighted_moments
 
 
@@ -92,6 +93,12 @@ def _glm_qn_minimize(
 
     from .owlqn import lbfgs_two_loop
 
+    # Per-iteration convergence trace (telemetry): gated at TRACE time — the
+    # host callback is free on CPU but a dispatch round-trip through a remote
+    # TPU tunnel per L-BFGS iteration, so it only exists in programs traced
+    # while SRML_TRACE_CONVERGENCE / enable(convergence=True) was active.
+    trace_convergence = telemetry.convergence_trace_enabled()
+
     def cond(state):
         _, _, _, _, _, _, _, f_prev, f_cur, it, stalled = state
         rel = jnp.abs(f_prev - f_cur) / jnp.maximum(jnp.abs(f_cur), 1.0)
@@ -134,6 +141,10 @@ def _glm_qn_minimize(
         z_p = jnp.where(ok, z_n, z_p)
         g = jnp.where(ok, gn, g)
         f_out = jnp.where(ok, f_new, f_cur)
+        if trace_convergence:
+            jax.debug.callback(
+                partial(telemetry.record_convergence_point, "glm_qn"), it, f_out
+            )
         return x, z_p, g, S, Y, rho, (count, pos), f_cur, f_out, it + 1, ~ok
 
     x0 = jnp.zeros((n_flat,), dtype)

@@ -14,10 +14,13 @@
 #
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .. import telemetry
 
 
 def lbfgs_two_loop(pg, S, Y, rho, count, pos, m):
@@ -70,6 +73,8 @@ def owlqn_minimize(
     m = memory
     lam = lam1 * l1_mask
     grad_f = jax.grad(smooth_f)
+    # per-iteration convergence trace — gated at TRACE time (see ops/logistic.py)
+    trace_convergence = telemetry.convergence_trace_enabled()
 
     def f_total(x):
         return smooth_f(x) + jnp.sum(lam * jnp.abs(x))
@@ -132,6 +137,10 @@ def owlqn_minimize(
         x = jnp.where(ok, xn, x)
         g = jnp.where(ok, gn, g)
         f_new = jnp.where(ok, fn, f_cur)
+        if trace_convergence:
+            jax.debug.callback(
+                partial(telemetry.record_convergence_point, "owlqn"), it, f_new
+            )
         return x, g, S, Y, rho, (count, pos), f_cur, f_new, it + 1, ~ok
 
     g0 = grad_f(x0)

@@ -18,7 +18,27 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from .linalg import sign_flip, topk_eigh_desc, weighted_cov
+
+
+def record_pca_fit(state: Dict[str, jax.Array], *, k: int) -> None:
+    """Host-side telemetry for a completed `pca_fit` (the solver itself is one
+    jitted program — no iterations to trace): fit counter plus the captured
+    variance ratio, the solver's single convergence-quality scalar. Callers
+    pass the state AFTER fetching it to host (model-attribute conversion), so
+    this forces no extra device sync."""
+    if not telemetry.enabled():
+        return
+    import numpy as np
+
+    reg = telemetry.registry()
+    reg.inc("pca.fits")
+    reg.gauge("pca.n_components", k)
+    reg.gauge(
+        "pca.explained_variance_ratio_sum",
+        float(np.sum(np.asarray(state["explained_variance_ratio_"]))),
+    )
 
 
 @partial(jax.jit, static_argnames=("k",))

@@ -32,6 +32,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
+
 
 def csr_to_ell(
     csr, k_max: int | None = None, dtype=None
@@ -73,6 +75,14 @@ def csr_to_ell(
             offsets = np.arange(nnz_hi - nnz_lo) - np.repeat(indptr[lo:hi] - nnz_lo, cnt)
             indices[lo:hi][rows, offsets] = csr.indices[nnz_lo:nnz_hi].astype(np.int32)
             values[lo:hi][rows, offsets] = csr.data[nnz_lo:nnz_hi].astype(dtype, copy=False)
+    if telemetry.enabled():
+        reg = telemetry.registry()
+        reg.inc("sparse.csr_to_ell_calls")
+        reg.inc("sparse.ell_rows", n)
+        reg.inc("sparse.ell_bytes", values.nbytes + indices.nbytes)
+        # density bookkeeping: how many ELL cells are padding (value 0)
+        reg.inc("sparse.ell_pad_cells", n * max(k_max, 1) - int(csr.nnz))
+        reg.gauge("sparse.k_max", max(k_max, 1))
     return indices, values, max(k_max, 1)
 
 

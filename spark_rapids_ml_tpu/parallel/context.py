@@ -107,12 +107,29 @@ class Rendezvous:
     single-controller mode), and — when running under Spark barrier stages — a
     thin wrapper over `BarrierTaskContext` (see spark/integration module) whose
     `allGather` this API is shaped after.
+
+    In-tree implementations provide `_allgather_impl`; the base `allgather`
+    wraps it with telemetry (round-trip counter, payload bytes, latency
+    histogram — rank-tagged, no collectives of its own). Out-of-tree
+    subclasses overriding `allgather` directly keep working, minus telemetry.
     """
 
     rank: int
     nranks: int
 
     def allgather(self, payload: str) -> List[str]:
+        from .. import telemetry
+
+        if not telemetry.enabled():
+            return self._allgather_impl(payload)
+        with telemetry.span("rendezvous.allgather", nranks=self.nranks):
+            out = self._allgather_impl(payload)
+        reg = telemetry.registry()
+        reg.inc("rendezvous.rounds")
+        reg.inc("rendezvous.payload_bytes", len(payload))
+        return out
+
+    def _allgather_impl(self, payload: str) -> List[str]:
         raise NotImplementedError
 
     def barrier(self) -> None:
@@ -142,7 +159,7 @@ class LocalRendezvous(Rendezvous):
         shared = cls._Shared(nranks)
         return [cls(r, shared) for r in range(nranks)]
 
-    def allgather(self, payload: str) -> List[str]:
+    def _allgather_impl(self, payload: str) -> List[str]:
         self._shared.slots[self.rank] = payload
         self._shared.barrier.wait()
         out = list(self._shared.slots)  # type: ignore[arg-type]
@@ -168,7 +185,7 @@ class BarrierRendezvous(Rendezvous):
         self.rank = rank
         self.nranks = nranks
 
-    def allgather(self, payload: str) -> List[str]:
+    def _allgather_impl(self, payload: str) -> List[str]:
         return list(self._ctx.allGather(payload))
 
 
@@ -203,7 +220,7 @@ class FileRendezvous(Rendezvous):
         self._round = 0
         os.makedirs(self.root, exist_ok=True)
 
-    def allgather(self, payload: str) -> List[str]:
+    def _allgather_impl(self, payload: str) -> List[str]:
         round_dir = os.path.join(self.root, f"round_{self._round}")
         self._round += 1
         os.makedirs(round_dir, exist_ok=True)

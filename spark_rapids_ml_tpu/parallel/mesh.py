@@ -18,6 +18,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
+
 try:  # jax >= 0.6 exports shard_map at the top level
     from jax import shard_map  # type: ignore[attr-defined]
 except ImportError:  # jax 0.4.x: experimental home, same keyword signature
@@ -246,6 +248,12 @@ def place_row_shards(mesh: Mesh, x: np.ndarray) -> jax.Array:
     """
     devices = list(mesh.devices.flatten())
     pieces, n_pad = shard_row_slices(x, len(devices))
+    if telemetry.enabled():
+        reg = telemetry.registry()
+        reg.inc("placement.device_put_calls")
+        reg.inc("placement.shards", len(pieces))
+        reg.inc("placement.bytes", sum(p.nbytes for p in pieces))
+        reg.inc("placement.rows_padded", n_pad - x.shape[0])
     shards = jax.device_put(pieces, devices)
     return jax.make_array_from_single_device_arrays(
         (n_pad,) + x.shape[1:], row_sharding(mesh, x.ndim), shards
@@ -273,8 +281,19 @@ def place_rows(
         xp = np.pad(
             x, [(0, local_rows_target - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
         )
+        if telemetry.enabled():
+            reg = telemetry.registry()
+            # this branch performs no jax.device_put of its own — the
+            # multihost assembly owns the transfer, counted separately
+            reg.inc("placement.global_assembly_calls")
+            reg.inc("placement.bytes", xp.nbytes)
+            reg.inc("placement.rows_padded", local_rows_target - x.shape[0])
         return multihost_utils.host_local_array_to_global_array(xp, mesh, P(ROWS_AXIS))
     if mesh.devices.size == 1:
+        if telemetry.enabled():
+            reg = telemetry.registry()
+            reg.inc("placement.device_put_calls")
+            reg.inc("placement.bytes", x.nbytes)
         return jax.device_put(x, mesh.devices.flatten()[0])
     return place_row_shards(mesh, x)
 
@@ -316,6 +335,10 @@ def make_global_rows(
             # insert a full input-resharding copy of X in consumer programs
             # (measured 11 GiB at the 1M x 3k benchmark shape)
             dev = mesh.devices.flatten()[0]
+            if telemetry.enabled():
+                reg = telemetry.registry()
+                reg.inc("placement.device_put_calls", 2)
+                reg.inc("placement.bytes", x.nbytes + w_host.nbytes)
             X = jax.device_put(x, dev)
             w = jax.device_put(w_host, dev)
         else:

@@ -1,0 +1,554 @@
+#
+# Structured telemetry: spans, counters/gauges/histograms, and sinks.
+#
+# The observability substrate for the whole hot path (ingest -> layout ->
+# solve -> transform). The reference's story here is NVTX ranges in the Scala
+# plugin plus ad-hoc wall-clock logs in the Python tier (SURVEY.md §5); the
+# TPU-native answer is:
+#
+#   * `span("stage", **attrs)` — a nestable context manager that records wall
+#     time into the registry, emits a `jax.profiler.TraceAnnotation` so the
+#     stage lines up inside xprof traces (the NVTX-range analog), and logs the
+#     stage timing at a caller-provided logger (the old `verbose` prints).
+#   * `MetricsRegistry` — a process-global store of counters (bytes ingested,
+#     device_put calls, rendezvous rounds), gauges (HBM watermark, solver
+#     objective), histograms (rendezvous latency), span aggregates, and
+#     per-iteration solver convergence traces.
+#   * sinks — a JSONL file (`SRML_METRICS_PATH`) receiving one record per
+#     span plus one snapshot record per fit, and an in-process `snapshot()`
+#     dict that bench.py embeds into BENCH_* emission and `fit` attaches to
+#     models as `model._fit_metrics`.
+#
+# Contracts:
+#   * ZERO-COST WHEN DISABLED: `span()` returns a shared no-op object and
+#     every record method is behind one flag check — a disabled fit does no
+#     timing, no allocation, no I/O.
+#   * SPMD-SAFE: records are rank-tagged, the JSONL sink writes to a per-rank
+#     file (rank 0 owns the bare path), and nothing here performs a
+#     collective of its own.
+#   * Per-iteration convergence traces from jitted solvers use
+#     `jax.debug.callback` and are gated SEPARATELY (`SRML_TRACE_CONVERGENCE`
+#     / `enable(convergence=True)`): a host callback per L-BFGS iteration is
+#     free on CPU but a dispatch round-trip through a remote TPU tunnel, so
+#     it never rides along with plain counter telemetry. The gate is read at
+#     TRACE time — toggling it after a solver shape has compiled does not
+#     retrace that shape.
+#
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "enabled",
+    "enable",
+    "disable",
+    "convergence_trace_enabled",
+    "span",
+    "registry",
+    "MetricsRegistry",
+    "snapshot",
+    "summary",
+    "fit_scope",
+    "record_device_memory",
+    "record_solver_result",
+    "record_convergence_point",
+]
+
+# Span records kept in-process (the JSONL sink receives every record; the
+# in-memory list is for snapshot()/summary() and stays bounded).
+_MAX_SPAN_RECORDS = 4096
+_MAX_CONVERGENCE_POINTS = 10_000
+
+
+class _State:
+    __slots__ = ("on", "sink_path", "convergence")
+
+    def __init__(self) -> None:
+        self.sink_path: Optional[str] = os.environ.get("SRML_METRICS_PATH") or None
+        self.on: bool = bool(self.sink_path) or bool(os.environ.get("SRML_TELEMETRY"))
+        self.convergence: bool = bool(os.environ.get("SRML_TRACE_CONVERGENCE"))
+
+
+_STATE = _State()
+_LOCAL = threading.local()  # per-thread span stack (nesting -> paths)
+
+
+def enabled() -> bool:
+    """Whether telemetry recording is on (one branch — THE hot-path check)."""
+    return _STATE.on
+
+
+def convergence_trace_enabled() -> bool:
+    """Whether jitted solvers should bake per-iteration host callbacks in.
+    Read at trace time; see the module header for the compile-cache caveat."""
+    return _STATE.on and _STATE.convergence
+
+
+def enable(sink_path: Optional[str] = None, *, convergence: Optional[bool] = None) -> None:
+    """Turn telemetry on, optionally pointing the JSONL sink at `sink_path`
+    and/or toggling per-iteration convergence tracing. Re-pointing the sink
+    closes the previous file handles (no fd accumulation across jobs)."""
+    _STATE.on = True
+    if sink_path is not None:
+        if sink_path != _STATE.sink_path:
+            _close_sinks()
+        _STATE.sink_path = sink_path
+    if convergence is not None:
+        _STATE.convergence = bool(convergence)
+
+
+def disable() -> None:
+    """Turn telemetry off (records already taken stay in the registry) and
+    close any open sink files."""
+    _STATE.on = False
+    _close_sinks()
+
+
+def _rank() -> int:
+    """This process's rank for record tagging. Control-plane only — never
+    touches the XLA backend (jax.process_index() would initialize it)."""
+    try:
+        from .parallel.context import TpuContext
+
+        ctx = TpuContext.current()
+        if ctx is not None:
+            return ctx.rank
+    except Exception:  # pragma: no cover - import cycles during teardown
+        pass
+    return 0
+
+
+# ---------------------------------------------------------------- registry --
+
+
+class MetricsRegistry:
+    """Process-global metrics store. All methods are thread-safe; all record
+    methods are no-ops while telemetry is disabled (callers may skip the call
+    entirely with `enabled()` — both layers check)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Dict[str, float]] = {}
+        self._spans: List[Dict[str, Any]] = []
+        # monotone count of ALL spans ever recorded — `_spans` is trimmed to a
+        # bound, so marks must not be absolute list indices
+        self._spans_total: int = 0
+        self._convergence: Dict[str, List[List[float]]] = {}
+
+    # -- record ------------------------------------------------------------
+    def inc(self, name: str, value: float = 1.0) -> None:
+        if not _STATE.on:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        if not _STATE.on:
+            return
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge_max(self, name: str, value: float) -> None:
+        """Watermark gauge: keep the maximum ever seen (HBM peaks)."""
+        if not _STATE.on:
+            return
+        with self._lock:
+            self._gauges[name] = max(self._gauges.get(name, float("-inf")), float(value))
+
+    def observe(self, name: str, value: float) -> None:
+        """Histogram observation (count/sum/min/max summary, not buckets)."""
+        if not _STATE.on:
+            return
+        with self._lock:
+            h = self._hists.setdefault(
+                name, {"count": 0.0, "sum": 0.0, "min": float("inf"), "max": float("-inf")}
+            )
+            h["count"] += 1.0
+            h["sum"] += value
+            h["min"] = min(h["min"], value)
+            h["max"] = max(h["max"], value)
+
+    def record_span(self, name: str, path: str, wall_s: float, attrs: Dict[str, Any]) -> None:
+        if not _STATE.on:
+            return
+        rec = {"kind": "span", "name": name, "path": path, "wall_s": wall_s,
+               "rank": _rank(), **attrs}
+        with self._lock:
+            self._spans.append(rec)
+            self._spans_total += 1
+            if len(self._spans) > _MAX_SPAN_RECORDS:
+                del self._spans[: -_MAX_SPAN_RECORDS // 2]
+        self.observe(f"span.{path}", wall_s)
+        _sink_write(rec)
+
+    def record_convergence(self, solver: str, iteration: int, value: float) -> None:
+        if not _STATE.on:
+            return
+        with self._lock:
+            pts = self._convergence.setdefault(solver, [])
+            if len(pts) >= _MAX_CONVERGENCE_POINTS:
+                # ring-buffer semantics: drop the OLDEST point so `last` (and
+                # the tail a long-lived process cares about) stays current;
+                # surface the truncation instead of silently losing data
+                pts.pop(0)
+                self._counters[f"{solver}.convergence_points_dropped"] = (
+                    self._counters.get(f"{solver}.convergence_points_dropped", 0.0) + 1.0
+                )
+            pts.append([int(iteration), float(value)])
+
+    # -- read --------------------------------------------------------------
+    def convergence_trace(self, solver: str) -> List[List[float]]:
+        """[(iteration, value), ...] points recorded for `solver`."""
+        with self._lock:
+            return [list(p) for p in self._convergence.get(solver, [])]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Machine-readable state: counters, gauges, histogram summaries, and
+        per-path span aggregates. Safe to json.dumps. Span aggregates come
+        from the `span.<path>` histograms, which see EVERY span — the raw
+        record list is trimmed to a bound and would under-count."""
+        with self._lock:
+            spans: Dict[str, Dict[str, float]] = {}
+            for hname, h in self._hists.items():
+                if hname.startswith("span."):
+                    spans[hname[len("span."):]] = {
+                        "count": h["count"],
+                        "total_s": h["sum"],
+                        "min_s": h["min"],
+                        "max_s": h["max"],
+                    }
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: dict(v) for k, v in self._hists.items()},
+                "spans": spans,
+                "convergence": {
+                    k: {"points": len(v), "last": v[-1] if v else None}
+                    for k, v in self._convergence.items()
+                },
+            }
+
+    class _Mark:
+        __slots__ = ("counters", "hists", "spans_total")
+
+    def mark(self) -> "MetricsRegistry._Mark":
+        """Cheap position marker for `delta()` (fit-scoped metrics)."""
+        m = MetricsRegistry._Mark()
+        with self._lock:
+            m.counters = dict(self._counters)
+            m.hists = {k: dict(v) for k, v in self._hists.items()}
+            m.spans_total = self._spans_total
+        return m
+
+    def delta(self, m: "MetricsRegistry._Mark") -> Dict[str, Any]:
+        """Counters/histograms accumulated SINCE `m`, spans recorded since
+        `m`, and current gauges — the per-fit view attached to models."""
+        with self._lock:
+            counters = {
+                k: v - m.counters.get(k, 0.0)
+                for k, v in self._counters.items()
+                if v != m.counters.get(k, 0.0)
+            }
+            hists = {}
+            for k, v in self._hists.items():
+                prev = m.hists.get(k)
+                count = v["count"] - (prev["count"] if prev else 0.0)
+                if count:
+                    hists[k] = {
+                        "count": count,
+                        "sum": v["sum"] - (prev["sum"] if prev else 0.0),
+                    }
+            # spans recorded since the mark, bounded by what the trim kept:
+            # the count since the mark is exact (monotone counter); if more
+            # than the retained window were recorded, only the tail survives
+            since = max(0, self._spans_total - m.spans_total)
+            spans = [dict(r) for r in self._spans[len(self._spans) - min(since, len(self._spans)):]] if since else []
+        return {
+            "counters": counters,
+            "gauges": dict(self._gauges),
+            "histograms": hists,
+            "spans": spans,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._spans.clear()
+            self._convergence.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def snapshot() -> Dict[str, Any]:
+    return _REGISTRY.snapshot()
+
+
+def summary() -> str:
+    """One-line-per-stage human summary of the current registry state:
+    ``print(telemetry.summary())`` after any fit."""
+    snap = _REGISTRY.snapshot()
+    lines = []
+    for path, agg in sorted(snap["spans"].items()):
+        lines.append(
+            f"{path}: {agg['total_s']:.3f}s total / {int(agg['count'])} call(s)"
+        )
+    for name, v in sorted(snap["counters"].items()):
+        lines.append(f"{name}: {v:,.0f}")
+    for name, v in sorted(snap["gauges"].items()):
+        lines.append(f"{name}: {v:,.6g}")
+    return "\n".join(lines) if lines else "telemetry: no records"
+
+
+# ------------------------------------------------------------------- sinks --
+
+_SINK_LOCK = threading.Lock()
+_SINK_FILES: Dict[str, Any] = {}
+
+
+def _close_sinks() -> None:
+    """Close every cached sink handle (disable() and interpreter exit) so
+    re-pointing the sink per job never accumulates open fds."""
+    with _SINK_LOCK:
+        for f in _SINK_FILES.values():
+            try:
+                f.close()
+            except OSError:  # pragma: no cover
+                pass
+        _SINK_FILES.clear()
+
+
+atexit.register(_close_sinks)
+
+
+def _sink_path() -> Optional[str]:
+    """Per-rank JSONL path: rank 0 owns the configured path, other ranks get
+    `<path>.rank<r>` so SPMD processes on a shared filesystem never interleave
+    writes in one file."""
+    path = _STATE.sink_path
+    if not path:
+        return None
+    r = _rank()
+    return path if r == 0 else f"{path}.rank{r}"
+
+
+def _sink_write(rec: Dict[str, Any]) -> None:
+    path = _sink_path()
+    if path is None:
+        return
+    line = json.dumps(rec, default=_json_default) + "\n"
+    with _SINK_LOCK:
+        f = _SINK_FILES.get(path)
+        if f is None or f.closed:
+            try:
+                f = open(path, "a")
+            except OSError:
+                return
+            _SINK_FILES[path] = f
+        f.write(line)
+        f.flush()
+
+
+def _json_default(o: Any):
+    try:
+        import numpy as np
+
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:  # pragma: no cover
+        pass
+    return str(o)
+
+
+# ------------------------------------------------------------------- spans --
+
+
+class _NoopSpan:
+    """Shared do-nothing span — what `span()` returns while disabled."""
+
+    __slots__ = ()
+    wall_s: Optional[float] = None
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "logger", "path", "wall_s", "_t0", "_ta")
+
+    def __init__(self, name: str, logger: Any, attrs: Dict[str, Any]) -> None:
+        self.name = name
+        self.logger = logger
+        self.attrs = attrs
+        self.wall_s: Optional[float] = None
+
+    def __enter__(self) -> "_Span":
+        stack = getattr(_LOCAL, "stack", None)
+        if stack is None:
+            stack = _LOCAL.stack = []
+        stack.append(self.name)
+        self.path = "/".join(stack)
+        # xprof alignment: TraceAnnotation is the NVTX-range analog — it tags
+        # this wall-clock interval in any ACTIVE jax.profiler trace and is
+        # near-free when no trace is running. Spans must never break when the
+        # profiler is inactive, so failures here are swallowed.
+        self._ta = None
+        try:
+            import jax
+
+            self._ta = jax.profiler.TraceAnnotation(self.path)
+            self._ta.__enter__()
+        except Exception:
+            self._ta = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type: Any, exc_val: Any, exc_tb: Any) -> bool:
+        self.wall_s = time.perf_counter() - self._t0
+        if self._ta is not None:
+            try:
+                self._ta.__exit__(exc_type, exc_val, exc_tb)
+            except Exception:
+                pass
+        stack = _LOCAL.stack
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        if exc_type is None:
+            _REGISTRY.record_span(self.name, self.path, self.wall_s, self.attrs)
+            if self.logger is not None:
+                self.logger.info("stage %s: %.3fs", self.path, self.wall_s)
+        return False
+
+
+def span(name: str, *, logger: Any = None, **attrs: Any):
+    """Nestable timing span.
+
+    ``with telemetry.span("solve", index=0): ...`` records wall time (and the
+    nesting path, e.g. ``fit/solve``) into the registry + JSONL sink, tags the
+    interval in any active `jax.profiler` trace, and — when `logger` is passed
+    (the estimator `verbose` path) — logs ``stage <path>: <t>s``. Returns a
+    shared no-op object when telemetry is disabled and no logger wants the
+    timing, so the disabled cost is one branch."""
+    if not _STATE.on and logger is None:
+        return _NOOP_SPAN
+    return _Span(name, logger, attrs)
+
+
+# ------------------------------------------------------- derived recorders --
+
+
+def record_device_memory() -> None:
+    """Sample per-device memory stats into HBM watermark gauges, where the
+    backend exposes them (`Device.memory_stats()` — TPU/GPU yes, CPU None).
+    Callers invoke this only where the backend is already live (inside fit);
+    it never initializes a backend on its own."""
+    if not _STATE.on:
+        return
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return
+    peak = in_use = 0
+    seen = False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        seen = True
+        peak = max(peak, int(stats.get("peak_bytes_in_use", 0)))
+        in_use = max(in_use, int(stats.get("bytes_in_use", 0)))
+    if seen:
+        _REGISTRY.gauge_max("device.peak_bytes_in_use", peak)
+        _REGISTRY.gauge("device.bytes_in_use", in_use)
+
+
+def record_solver_result(
+    solver: str,
+    *,
+    n_iter: int,
+    objective: Optional[float] = None,
+    stalled: bool = False,
+) -> None:
+    """Host-side record of a completed iterative solve: iteration counter,
+    final objective gauge, and a final convergence point."""
+    if not _STATE.on:
+        return
+    _REGISTRY.inc(f"{solver}.fits")
+    _REGISTRY.inc(f"{solver}.iterations", float(n_iter))
+    if stalled:
+        _REGISTRY.inc(f"{solver}.line_search_stalls")
+    if objective is not None:
+        _REGISTRY.gauge(f"{solver}.objective", float(objective))
+        _REGISTRY.record_convergence(solver, int(n_iter), float(objective))
+
+
+def record_convergence_point(solver: str, iteration: Any, value: Any) -> None:
+    """Per-iteration convergence sample. Shaped for `jax.debug.callback`
+    (iteration/value arrive as device scalars); also callable from host loops
+    (KMeans passes plain floats)."""
+    if not _STATE.on:
+        return
+    import numpy as np
+
+    _REGISTRY.record_convergence(
+        solver, int(np.asarray(iteration)), float(np.asarray(value))
+    )
+
+
+# --------------------------------------------------------------- fit scope --
+
+
+@contextlib.contextmanager
+def fit_scope(label: str):
+    """Fit-scoped metrics view. Yields a dict whose ``metrics`` key is filled
+    at exit with the registry DELTA accumulated during the fit (counters,
+    per-fit spans, histogram deltas, current gauges) — what `core` attaches
+    to models as ``_fit_metrics`` — and writes one ``{"kind": "fit"}``
+    snapshot record to the JSONL sink."""
+    scope: Dict[str, Any] = {"metrics": {}}
+    if not _STATE.on:
+        yield scope
+        return
+    m = _REGISTRY.mark()
+    try:
+        yield scope
+    finally:
+        delta = _REGISTRY.delta(m)
+        scope["metrics"] = delta
+        _sink_write(
+            {
+                "kind": "fit",
+                "estimator": label,
+                "rank": _rank(),
+                "counters": delta["counters"],
+                "gauges": delta["gauges"],
+                "histograms": delta["histograms"],
+            }
+        )

@@ -5,6 +5,7 @@
 from __future__ import annotations
 
 import logging
+import os
 import sys
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
@@ -13,14 +14,26 @@ import numpy as np
 _LOGGERS: Dict[str, logging.Logger] = {}
 
 
-def get_logger(cls_or_name, level: str = "INFO") -> logging.Logger:
-    """Per-class stderr logger (reference utils.py:281-302)."""
+def get_logger(cls_or_name, level: Optional[str] = None) -> logging.Logger:
+    """Per-class stderr logger (reference utils.py:281-302).
+
+    The level is resolved ONCE, at logger creation: an explicit `level`
+    argument wins, else the `SRML_LOG_LEVEL` env var, else INFO. Cached
+    loggers are returned as-is (no per-call level re-derivation), and the
+    handler guard makes repeated calls — even across a cleared cache —
+    attach at most one stream handler per logger."""
     name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
     name = f"spark_rapids_ml_tpu.{name}"
     if name in _LOGGERS:
         return _LOGGERS[name]
     logger = logging.getLogger(name)
-    logger.setLevel(level)
+    # tolerate lowercase / invalid values ("SRML_LOG_LEVEL=debug" is the
+    # common way users type it): normalize, fall back to INFO rather than
+    # letting setLevel's ValueError crash every fit
+    resolved = (level or os.environ.get("SRML_LOG_LEVEL") or "INFO").upper()
+    if not isinstance(logging.getLevelName(resolved), int):
+        resolved = "INFO"
+    logger.setLevel(resolved)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s"))

@@ -199,8 +199,10 @@ def test_empty_dataset_raises():
 
 def test_verbose_stage_timing_logs(rng, caplog):
     # verbose solver param produces per-stage timing lines (reference cuML
-    # verbosity plumbing, core.py:394-417 analog). The framework logger writes
-    # to its own stderr handler (propagate=False), so hook caplog's handler in.
+    # verbosity plumbing, core.py:394-417 analog), emitted by telemetry spans
+    # with their nesting path (fit/ingest, fit/layout, fit/solve, fit) — see
+    # docs/observability.md. The framework logger writes to its own stderr
+    # handler (propagate=False), so hook caplog's handler in.
     import logging
 
     import pandas as pd
@@ -220,10 +222,10 @@ def test_verbose_stage_timing_logs(rng, caplog):
     finally:
         logger.removeHandler(caplog.handler)
     text = caplog.text
-    assert "stage ingest" in text
-    assert "stage device layout" in text
-    assert "stage solve" in text
-    assert "stage total fit" in text
+    assert "stage fit/ingest" in text
+    assert "stage fit/layout" in text
+    assert "stage fit/solve" in text
+    assert "stage fit:" in text  # the enclosing whole-fit span
 
 
 def test_profile_trace_dir(rng, tmp_path, monkeypatch):
