@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import pathlib
 import py_compile
+import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
@@ -22,6 +23,16 @@ TARGETS = ["spark_rapids_ml_tpu", "benchmark", "tests"]
 # non-telemetry uses, e.g. a future jitter probe).
 _PERF_COUNTER_TREE = "spark_rapids_ml_tpu"
 _PERF_COUNTER_EXEMPT_FILES = {"telemetry.py"}
+
+# Unbounded blocking waits (`while True` poll loops, bare `Barrier.wait()` /
+# `Event.wait()` with no timeout) are how a dead peer becomes a HUNG process
+# instead of a typed RankFailedError/RendezvousTimeoutError (docs/
+# robustness.md). All bounded waiting lives in parallel/context.py — the one
+# deadline owner; anywhere else in the framework a blocking wait must carry a
+# `# blocking-ok` waiver explaining its bound.
+_BLOCKING_TREE = "spark_rapids_ml_tpu"
+_BLOCKING_EXEMPT_FILES = {"context.py"}
+_BLOCKING_RE = re.compile(r"while\s+True\b|\.wait\(\s*\)")
 
 failures: list[str] = []
 for target in TARGETS:
@@ -42,6 +53,17 @@ for target in TARGETS:
                 failures.append(
                     f"{path}:{lineno}: bare perf_counter timing in the framework — "
                     "use telemetry.span()/registry (or mark `# telemetry-ok`)"
+                )
+            if (
+                target == _BLOCKING_TREE
+                and path.name not in _BLOCKING_EXEMPT_FILES
+                and _BLOCKING_RE.search(line)
+                and "# blocking-ok" not in line
+            ):
+                failures.append(
+                    f"{path}:{lineno}: unbounded blocking wait in the framework — "
+                    "a dead peer must raise a typed error, not hang; bound it with "
+                    "a deadline (see parallel/context.py) or mark `# blocking-ok`"
                 )
 
 import importlib

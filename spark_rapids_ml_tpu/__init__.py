@@ -9,12 +9,24 @@
 #
 __version__ = "0.1.0"
 
+from .errors import (  # noqa: F401
+    IngestValidationError,
+    RankFailedError,
+    RendezvousTimeoutError,
+    SolverDivergedError,
+    SrmlError,
+)
 from .linalg import DenseVector, SparseVector, Vectors  # noqa: F401
 
 __all__ = [
     "DenseVector",
     "SparseVector",
     "Vectors",
+    "SrmlError",
+    "RankFailedError",
+    "RendezvousTimeoutError",
+    "SolverDivergedError",
+    "IngestValidationError",
     "__version__",
 ]
 

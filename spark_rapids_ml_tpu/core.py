@@ -22,6 +22,7 @@ import json
 import os
 import shutil
 import threading
+import time
 from abc import abstractmethod
 from collections import namedtuple
 from dataclasses import dataclass, field
@@ -49,6 +50,27 @@ config: Dict[str, Any] = {
     # with the dataset (the streaming analog of the reference's Arrow
     # maxRecordsPerBatch-bounded batch loop, reference core.py:698-760)
     "ingest_chunk_bytes": 128 << 20,
+    # --- fault-tolerant control plane (docs/robustness.md) ---------------
+    # per-round rendezvous deadline: a round with ranks still missing raises
+    # RendezvousTimeoutError (transient, retryable) when this elapses —
+    # Spark's spark.barrier.sync.timeout analog
+    "rendezvous_timeout_s": 300.0,
+    # liveness-file cadence for FileRendezvous; a peer whose heartbeat goes
+    # stale by 1.5x this raises RankFailedError on survivors, so a killed
+    # rank surfaces within 2x the interval instead of the full round deadline
+    "heartbeat_interval_s": 5.0,
+    # success-path TpuContext teardown barrier bound: a peer that already
+    # exited must not hang teardown — timing out here logs a warning only
+    "teardown_timeout_s": 15.0,
+    # retryable_stage policy: transient failures (rendezvous timeout,
+    # distributed-init race — errors.is_transient) are retried up to this
+    # many times with exponential backoff from this base
+    "fit_max_retries": 2,
+    "fit_retry_backoff_s": 0.5,
+    # opt-in NaN/Inf scan over ingested feature/label/weight columns
+    # (chunked under ingest_chunk_bytes); raises IngestValidationError
+    # naming the column instead of feeding NaNs to a solver
+    "validate_ingest": False,
 }
 
 # Output-column naming contract shared by all predictive models
@@ -135,6 +157,61 @@ class FitInputs:
         return np.concatenate(
             allgather_ndarray(self.ctx.rendezvous, arr), axis=0
         )
+
+
+def retryable_stage(
+    fn: Callable[[int], Any],
+    *,
+    stage: str,
+    rendezvous: Any = None,
+    logger: Any = None,
+    max_retries: Optional[int] = None,
+    backoff_s: Optional[float] = None,
+) -> Any:
+    """Run ``fn(attempt)`` with bounded retries on TRANSIENT failures — the
+    in-process analog of Spark's lineage-based stage re-execution (the crash
+    recovery the reference inherits for free; Zaharia et al., NSDI 2012).
+
+    Transient means `errors.is_transient`: rendezvous round timeouts (which
+    fire symmetrically, so every SPMD rank unwinds and re-enters together)
+    and the distributed-init race. Permanent failures — RankFailedError (a
+    peer is dead), SolverDivergedError, user errors — propagate immediately.
+
+    Before each retry: exponential backoff from ``config["fit_retry_backoff_s"]``
+    (attempt N sleeps base * 2^(N-1)), and `rendezvous.begin_epoch(attempt)`
+    re-namespaces the control plane so the retry never reads the failed
+    attempt's stale rounds. Every retry increments the ``fit.retries``
+    telemetry counter, which lands in ``model._fit_metrics`` and the bench
+    snapshot. The chaos hook (`parallel.chaos.maybe_fail_stage`) runs at the
+    top of every attempt so fault plans can inject the transient path."""
+    from . import telemetry
+    from .errors import is_transient
+    from .parallel import chaos
+
+    if max_retries is None:
+        max_retries = int(config.get("fit_max_retries", 2))
+    if backoff_s is None:
+        backoff_s = float(config.get("fit_retry_backoff_s", 0.5))
+    if logger is None:
+        logger = get_logger("retryable_stage")
+    for attempt in range(max_retries + 1):
+        try:
+            chaos.maybe_fail_stage(stage, attempt)
+            return fn(attempt)
+        except Exception as e:
+            if not is_transient(e) or attempt >= max_retries:
+                raise
+            telemetry.registry().inc("fit.retries")
+            sleep_s = backoff_s * (2 ** attempt)
+            logger.warning(
+                "stage %s attempt %d/%d failed transiently (%s: %s); "
+                "retrying in %.2fs",
+                stage, attempt + 1, max_retries + 1, type(e).__name__, e, sleep_s,
+            )
+            time.sleep(sleep_s)
+            if rendezvous is not None:
+                rendezvous.begin_epoch(attempt + 1)
+    raise AssertionError("unreachable")  # pragma: no cover
 
 
 # A fit function maps (inputs, solver_params) -> model-attribute dict.
@@ -307,12 +384,26 @@ class _TpuCaller(_TpuCommon):
             import jax
 
             profile_cm = jax.profiler.trace(profile_dir)
+        from .parallel import TpuContext
+
+        active = TpuContext.current()
         with profile_cm, telemetry.fit_scope(
             type(self).__name__
         ) as tele_scope, telemetry.span(
             "fit", logger=stage_logger, estimator=type(self).__name__
         ):
-            rows = self._call_fit_func_traced(dataset, param_maps, logger, stage_logger)
+            # the whole traced fit (ingest -> layout -> solve) is ONE
+            # retryable stage: every attempt re-derives its state from the
+            # immutable dataset, so a retried fit is bit-identical to an
+            # unfaulted one (pinned by tests/test_chaos.py)
+            rows = retryable_stage(
+                lambda attempt: self._call_fit_func_traced(
+                    dataset, param_maps, logger, stage_logger
+                ),
+                stage="fit",
+                rendezvous=active.rendezvous if active is not None else None,
+                logger=logger,
+            )
         self._last_fit_metrics = tele_scope["metrics"]
         return rows
 

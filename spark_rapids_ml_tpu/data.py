@@ -79,12 +79,75 @@ def ingest_chunk_rows(row_bytes: int) -> int:
     return max(1, chunk_bytes // max(1, int(row_bytes)))
 
 
-def _record_ingest(extracted: "ExtractedData") -> "ExtractedData":
-    """Telemetry counters for a completed extraction: rows and host bytes
-    staged (CSR counts its data+index arrays). Flag-checked no-op when
-    telemetry is disabled."""
+def _first_nonfinite_row(block: np.ndarray, lo: int) -> int:
+    """Row index (absolute, given chunk offset `lo`) of the first non-finite
+    entry in a dense chunk."""
+    finite_rows = np.isfinite(block).all(axis=tuple(range(1, block.ndim)))
+    return lo + int(np.argmin(finite_rows))
+
+
+def _validate_ingest(
+    extracted: "ExtractedData", label_col=None, weight_col=None
+) -> None:
+    """Opt-in NaN/Inf scan over the ingested blocks (``config["validate_ingest"]``).
+
+    Chunked under the same ``ingest_chunk_bytes`` bound as the ingest itself,
+    so validation temporaries (the per-chunk finite mask) never scale with
+    the dataset. Raises `IngestValidationError` NAMING the offending column
+    (and first bad row) — the alternative is a NaN surfacing iterations later
+    inside a solver as a divergence with no pointer back to the data."""
+    from .core import config
+    from .errors import IngestValidationError
+
+    if not config.get("validate_ingest", False):
+        return
+
+    feats = extracted.features
+    if extracted.is_sparse:
+        # CSR: only the stored values can be non-finite; chunk the data array
+        # and map the first bad element back to its row through indptr
+        data = feats.data
+        step = max(1, int(config.get("ingest_chunk_bytes", 128 << 20)) // max(1, data.itemsize))
+        for lo in range(0, len(data), step):
+            chunk = data[lo : lo + step]
+            if not np.isfinite(chunk).all():
+                elem = lo + int(np.argmin(np.isfinite(chunk)))
+                row = int(np.searchsorted(feats.indptr, elem, side="right") - 1)
+                raise IngestValidationError(extracted.feature_names[0], row)
+    else:
+        row_bytes = feats.shape[1] * feats.itemsize if feats.ndim > 1 else feats.itemsize
+        step = ingest_chunk_rows(row_bytes)
+        for lo in range(0, feats.shape[0], step):
+            chunk = np.asarray(feats[lo : lo + step])
+            if np.isfinite(chunk).all():
+                continue
+            if extracted.feature_kind == "multi_cols" and chunk.ndim > 1:
+                # name the exact offending source column, not the block
+                bad_cols = ~np.isfinite(chunk).all(axis=0)
+                name = extracted.feature_names[int(np.argmax(bad_cols))]
+                col = chunk[:, int(np.argmax(bad_cols))]
+                raise IngestValidationError(name, lo + int(np.argmin(np.isfinite(col))))
+            raise IngestValidationError(
+                extracted.feature_names[0], _first_nonfinite_row(chunk, lo)
+            )
+    for name, arr in ((label_col, extracted.label), (weight_col, extracted.weight)):
+        if arr is None:
+            continue
+        if not np.isfinite(arr).all():
+            raise IngestValidationError(
+                str(name), int(np.argmin(np.isfinite(arr)))
+            )
+
+
+def _record_ingest(
+    extracted: "ExtractedData", label_col=None, weight_col=None
+) -> "ExtractedData":
+    """Validation (opt-in) + telemetry counters for a completed extraction:
+    rows and host bytes staged (CSR counts its data+index arrays). The
+    telemetry half is a flag-checked no-op when disabled."""
     from . import telemetry
 
+    _validate_ingest(extracted, label_col=label_col, weight_col=weight_col)
     if telemetry.enabled():
         feats = extracted.features
         if extracted.is_sparse:
@@ -239,7 +302,7 @@ def extract_dataset(
             row_id=_dict_scalar(id_col, np.int64),
             feature_kind=kind,
             feature_names=[input_col],
-        ))
+        ), label_col=label_col, weight_col=weight_col)
 
     pdf = as_pandas(dataset)
 
@@ -286,7 +349,7 @@ def extract_dataset(
         row_id=_scalar(id_col, np.int64),
         feature_kind=kind,
         feature_names=names,
-    ))
+    ), label_col=label_col, weight_col=weight_col)
 
 
 def vectors_to_pandas_column(matrix: np.ndarray) -> list:

@@ -1,0 +1,145 @@
+#
+# Typed exception hierarchy for the fault-tolerant control plane.
+#
+# The reference gets crash recovery for free from Spark: a dead barrier task
+# fails the stage and lineage-based re-execution retries it (Zaharia et al.,
+# NSDI 2012). The TPU-native rendezvous has no such supervisor, so failures
+# must become PROMPT, TYPED errors that the fit driver (core.retryable_stage)
+# can classify as transient (retry the stage) or permanent (propagate):
+#
+#   SrmlError
+#   ├── RendezvousTimeoutError   transient — a round's deadline elapsed with
+#   │                            ranks missing; symmetric (every waiting rank
+#   │                            raises it), so a coordinated retry is safe
+#   ├── RankFailedError          permanent — a peer PUBLISHED its failure
+#   │                            (abort sentinel) or stopped heartbeating;
+#   │                            its work is gone, a plain retry cannot help
+#   ├── SolverDivergedError      permanent — a solver produced non-finite
+#   │                            state; carries the last-good iterate so
+#   │                            callers can resume/diagnose
+#   └── IngestValidationError    permanent — NaN/Inf found in an input column
+#                                (config["validate_ingest"]); names the column
+#
+# Multiple inheritance keeps old call sites working: RendezvousTimeoutError
+# IS-A TimeoutError (FileRendezvous raised bare TimeoutError before),
+# IngestValidationError IS-A ValueError.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+__all__ = [
+    "SrmlError",
+    "RendezvousTimeoutError",
+    "RankFailedError",
+    "SolverDivergedError",
+    "IngestValidationError",
+    "is_transient",
+]
+
+
+class SrmlError(Exception):
+    """Base class for every framework-raised error."""
+
+
+class RendezvousTimeoutError(SrmlError, TimeoutError):
+    """A control-plane round's deadline elapsed with ranks still missing.
+
+    TRANSIENT: the deadline fires symmetrically on every rank still waiting,
+    so all survivors unwind to the fit driver together and a coordinated
+    retry (new rendezvous epoch) is safe. Distinguish from `RankFailedError`,
+    where a peer is KNOWN dead."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        round_index: Optional[int] = None,
+        missing_ranks: Optional[Sequence[int]] = None,
+        timeout_s: Optional[float] = None,
+    ):
+        super().__init__(message)
+        self.round_index = round_index
+        self.missing_ranks = list(missing_ranks) if missing_ranks is not None else None
+        self.timeout_s = timeout_s
+
+
+class RankFailedError(SrmlError, RuntimeError):
+    """A peer rank failed mid-fit: it published an ``ABORT:<rank>:<reason>``
+    sentinel through the rendezvous, or its heartbeat went stale (killed
+    process). PERMANENT for this attempt — the peer's partition state is gone;
+    an external supervisor (not an in-process retry) must relaunch the rank."""
+
+    def __init__(
+        self,
+        failed_rank: int,
+        reason: str = "",
+        *,
+        round_index: Optional[int] = None,
+    ):
+        self.failed_rank = int(failed_rank)
+        self.reason = reason
+        self.round_index = round_index
+        where = f" at round {round_index}" if round_index is not None else ""
+        super().__init__(
+            f"rank {failed_rank} failed{where}: {reason or 'no reason published'}"
+        )
+
+
+class SolverDivergedError(SrmlError, ArithmeticError):
+    """An iterative solver produced non-finite state (NaN/Inf objective,
+    shift, or coefficients). Carries the last iterate known finite and the
+    iteration at which divergence was detected, so callers can warm-restart
+    or report precisely where the numerics broke."""
+
+    def __init__(
+        self,
+        solver: str,
+        iteration: int,
+        *,
+        last_good: Optional[Dict[str, Any]] = None,
+        detail: str = "",
+    ):
+        self.solver = solver
+        self.iteration = int(iteration)
+        self.last_good: Dict[str, Any] = dict(last_good) if last_good else {}
+        msg = f"{solver} diverged at iteration {self.iteration}"
+        if detail:
+            msg += f": {detail}"
+        if self.last_good:
+            msg += f" (last-good iterate keys: {sorted(self.last_good)})"
+        super().__init__(msg)
+
+
+class IngestValidationError(SrmlError, ValueError):
+    """``config["validate_ingest"]`` found a non-finite value in an input
+    column. Names the column (and the first offending row) so the fix points
+    at the data, not at a NaN surfacing iterations later inside a solver."""
+
+    def __init__(self, column: str, row: Optional[int] = None, kind: str = "non-finite"):
+        self.column = column
+        self.row = row
+        at = f" (first at row {row})" if row is not None else ""
+        super().__init__(
+            f"input column {column!r} contains {kind} values{at}; "
+            "clean the data or disable config['validate_ingest']"
+        )
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether the fit driver may retry the stage after this error.
+
+    Transient today: rendezvous round timeouts (symmetric — every rank
+    unwinds together) and the distributed-init race (two fits standing up
+    `jax.distributed` concurrently; the loser sees an 'already initialized'
+    RuntimeError and succeeds on retry). `RankFailedError` and
+    `SolverDivergedError` are deliberately NOT transient."""
+    if isinstance(exc, RendezvousTimeoutError):
+        return True
+    if isinstance(exc, RuntimeError) and not isinstance(exc, SrmlError):
+        # ONLY the already-initialized loser race — a broader 'initialize'
+        # match would retry deterministic config errors for minutes
+        msg = str(exc).lower()
+        if "distributed" in msg and "already initialized" in msg:
+            return True
+    return False

@@ -335,8 +335,12 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 )
             else:
                 state = logistic_fit(inputs.X, y_idx, inputs.w, **common)
-            from ..ops.logistic import warn_if_early_stall
+            from ..ops.logistic import check_glm_result, warn_if_early_stall
 
+            # ONE device->host fetch of the whole result, then the divergence
+            # guard runs on the already-fetched scalars (no extra sync)
+            state = {k: np.asarray(v) for k, v in state.items()}
+            check_glm_result(state)
             warn_if_early_stall(
                 state, standardize=common["standardize"], max_iter=common["max_iter"]
             )

@@ -80,7 +80,7 @@ class PCA(_PCAParams, _TpuEstimator):
         return self._set_params(outputCol=value)
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
-        from ..ops.pca import pca_fit, record_pca_fit
+        from ..ops.pca import check_pca_state, pca_fit, record_pca_fit
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             k = int(params["n_components"])
@@ -90,6 +90,7 @@ class PCA(_PCAParams, _TpuEstimator):
                 raise ValueError(f"k={k} exceeds the number of features {inputs.n_cols}")
             state = pca_fit(inputs.X, inputs.w, k=k)
             out = {name: np.asarray(v) for name, v in state.items()}
+            check_pca_state(out, k=k)  # guard on the host-fetched attributes
             record_pca_fit(out, k=k)
             out["n_cols"] = inputs.n_cols
             out["dtype"] = np.dtype(inputs.dtype).name

@@ -13,6 +13,7 @@
 #
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Dict, Tuple
 
@@ -217,6 +218,23 @@ def _lloyd_step_1dev(X, w, centers, batch_rows, fast=False):
 _ONE_DISPATCH_MAX_BYTES = 2 << 30
 
 
+def _raise_diverged(iteration: int, last_good_centers, detail: str) -> None:
+    """Typed divergence error off the already-fetched per-iteration shift:
+    carries the iterate that ENTERED the diverging update (still finite)."""
+    import numpy as np
+
+    from ..errors import SolverDivergedError
+
+    telemetry.registry().inc("solver.divergence")
+    telemetry.registry().inc("kmeans.divergence")
+    raise SolverDivergedError(
+        "kmeans",
+        iteration,
+        last_good={"cluster_centers_": np.asarray(last_good_centers)},
+        detail=detail,
+    )
+
+
 def kmeans_fit(
     X: jax.Array,
     w: jax.Array,
@@ -268,20 +286,25 @@ def kmeans_fit(
     # 1.5s of the protocol fit); checking the PREVIOUS iteration's shift
     # overlaps the fetch with the current step's compute. At most one extra
     # Lloyd iteration runs after the tol crossing (same fixpoint).
-    # Convergence trace: the shift scalar for iteration i-1 is fetched here
-    # ANYWAY (the deferred check), so recording it into the telemetry registry
-    # costs no extra device synchronization.
+    # Convergence trace + divergence guard: the shift scalar for iteration
+    # i-1 is fetched here ANYWAY (the deferred check), so both the telemetry
+    # point and the NaN/Inf check cost no extra device synchronization.
     prev_shift = None
+    last_good = centers  # iterate entering the step that produced prev_shift
     for _ in range(max_iter):
+        step_in = centers
         centers, inertia, shift = step(centers, fast)
         n_iter += 1
         if prev_shift is not None:
             shift_host = float(prev_shift)
+            if not math.isfinite(shift_host):
+                _raise_diverged(n_iter - 1, last_good, f"center shift = {shift_host}")
             if telemetry.enabled():
                 telemetry.record_convergence_point("kmeans.shift", n_iter - 1, shift_host)
             if shift_host <= tol:
                 break
         prev_shift = shift
+        last_good = step_in
     if telemetry.enabled():
         telemetry.record_solver_result("kmeans", n_iter=n_iter)
     # inertia reported is one iteration stale; recompute once with final
@@ -292,6 +315,12 @@ def kmeans_fit(
     # loud instead of subtly wrong.
     if final_inertia:
         _, inertia, _ = step(centers, False)
+        inertia_host = float(inertia)
+        if not math.isfinite(inertia_host):
+            # the loop's deferred check trails by one fetch: a divergence on
+            # the FINAL step (or a 1-iteration fit) is caught here, on the
+            # inertia scalar the caller fetches anyway
+            _raise_diverged(n_iter, last_good, f"final inertia = {inertia_host}")
     else:
         inertia = jnp.full((), jnp.nan, X.dtype)
     return {

@@ -163,6 +163,18 @@ def _glm_qn_minimize(
     return x, obj, n_iter, stalled
 
 
+def check_glm_result(state: Dict, *, solver: str = "logistic") -> Dict:
+    """Divergence guard for a fetched GLM fit state: piggybacks on the final
+    objective/coef scalars the model layer converts to host anyway (the
+    jitted while_loop exposes no per-iteration scalar to watch). Raises
+    `SolverDivergedError` (with iteration count and the finite remainder of
+    the state as last-good) on NaN/Inf; returns `state` otherwise. Shared by
+    the dense and ELL fit call sites (models/classification.py)."""
+    from .owlqn import check_solver_state
+
+    return check_solver_state(solver, state)
+
+
 def warn_if_early_stall(state: Dict, *, standardize: bool, max_iter: int, logger=None) -> bool:
     """Host-side signal for the KNOWN LIMIT above: when the Armijo stall check
     ended an UNSTANDARDIZED fit well before maxIter/tol, the returned model is

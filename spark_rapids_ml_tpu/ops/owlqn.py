@@ -23,6 +23,50 @@ import jax.numpy as jnp
 from .. import telemetry
 
 
+def check_solver_state(
+    solver: str,
+    state: dict,
+    *,
+    scalars: Tuple[str, ...] = ("objective_",),
+    arrays: Tuple[str, ...] = ("coef_", "intercept_"),
+) -> dict:
+    """Host-side divergence guard shared by the quasi-Newton family (OWL-QN,
+    the GLM L-BFGS in ops/logistic.py, and the linear solvers that reuse this
+    module's recursion).
+
+    These solvers run as ONE jitted while_loop — there is no per-iteration
+    host scalar to watch, so the guard piggybacks on the values the model
+    layer fetches ANYWAY (final objective + coefficients; zero extra device
+    sync). Non-finite state raises `SolverDivergedError` carrying the
+    iteration count and whatever parts of the state are still finite as the
+    last-good iterate. Returns `state` unchanged so call sites can wrap."""
+    import numpy as np
+
+    from ..errors import SolverDivergedError
+
+    bad = []
+    for key in scalars:
+        if key in state and not np.isfinite(np.asarray(state[key])).all():
+            bad.append(key)
+    for key in arrays:
+        if key in state and not np.isfinite(np.asarray(state[key])).all():
+            bad.append(key)
+    if not bad:
+        return state
+    n_iter = int(np.asarray(state.get("n_iter_", 0)))
+    last_good = {
+        k: np.asarray(v)
+        for k, v in state.items()
+        if k not in bad and isinstance(v, (np.ndarray, jax.Array))
+        and np.isfinite(np.asarray(v)).all()
+    }
+    telemetry.registry().inc("solver.divergence")
+    telemetry.registry().inc(f"{solver}.divergence")
+    raise SolverDivergedError(
+        solver, n_iter, last_good=last_good, detail=f"non-finite {', '.join(bad)}"
+    )
+
+
 def lbfgs_two_loop(pg, S, Y, rho, count, pos, m):
     """Shared L-BFGS two-loop recursion over circular (s, y) history buffers:
     returns the descent direction −H·pg. Used by OWL-QN below and by the

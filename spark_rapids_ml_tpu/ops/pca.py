@@ -22,6 +22,24 @@ from .. import telemetry
 from .linalg import sign_flip, topk_eigh_desc, weighted_cov
 
 
+def check_pca_state(state: Dict, *, k: int) -> Dict:
+    """Divergence guard on a HOST-fetched PCA state (callers pass the state
+    after model-attribute conversion, so no extra device sync): the one-shot
+    eigendecomposition has no iterations, but non-finite input rows surface
+    as NaN covariance -> NaN components/variances. Raises SolverDivergedError
+    (iteration 0 — `n_iter_` is absent from a single-shot solver's state)
+    keeping the finite attributes as the last-good payload; returns `state`
+    untouched otherwise. One shared guard implementation for every solver
+    family (ops/owlqn.check_solver_state)."""
+    from .owlqn import check_solver_state
+
+    return check_solver_state(
+        "pca", state,
+        scalars=(),
+        arrays=("components_", "explained_variance_", "mean_"),
+    )
+
+
 def record_pca_fit(state: Dict[str, jax.Array], *, k: int) -> None:
     """Host-side telemetry for a completed `pca_fit` (the solver itself is one
     jitted program — no iterations to trace): fit counter plus the captured

@@ -32,3 +32,4 @@ from .context import (  # noqa: F401
     TpuContext,
     allgather_ndarray,
 )
+from .chaos import ChaosRendezvous  # noqa: F401

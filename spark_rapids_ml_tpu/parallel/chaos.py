@@ -1,0 +1,223 @@
+#
+# Fault injection for the control plane — the test substrate that PROVES the
+# resilience claims in docs/robustness.md instead of asserting them.
+#
+# A fault plan is a compact spec string (the `SRML_FAULT_PLAN` env var, or
+# `set_fault_plan()` in-process): semicolon-separated entries, each
+# `kind:key=value:key=value...`:
+#
+#   kill:rank=1:round=3            SIGKILL the process entering round 3 on
+#                                  rank 1 — no abort file, no atexit: the
+#                                  hard-death case heartbeats exist for
+#   abort:rank=1:round=3           publish the abort sentinel then raise (the
+#                                  graceful-failure case: an exception that
+#                                  reaches TpuContext.__exit__)
+#   delay:rank=0:round=2:seconds=0.5   sleep before joining the round
+#   drop:rank=1:round=2            lose this rank's message: never join the
+#                                  round, so every rank (dropper included)
+#                                  raises the symmetric RendezvousTimeoutError
+#   fail:stage=fit:times=1         raise a transient error at the START of a
+#                                  retryable stage attempt (core.retryable_stage
+#                                  consults `maybe_fail_stage`) — the injected
+#                                  "transient rendezvous fault" of the
+#                                  retry-to-bit-identical acceptance test
+#
+# Every entry fires at most `times` times (default 1), so a retried attempt
+# runs clean — exactly the transient-fault shape the fit driver retries.
+#
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..errors import RendezvousTimeoutError
+from .context import Rendezvous
+
+__all__ = [
+    "Fault",
+    "parse_fault_plan",
+    "set_fault_plan",
+    "clear_fault_plan",
+    "active_plan",
+    "maybe_fail_stage",
+    "ChaosRendezvous",
+]
+
+_KINDS = {"kill", "abort", "delay", "drop", "fail"}
+
+
+@dataclass
+class Fault:
+    kind: str  # kill | abort | delay | drop | fail
+    rank: Optional[int] = None  # rendezvous faults: which rank misbehaves
+    round: Optional[int] = None  # rendezvous faults: at which round index
+    stage: Optional[str] = None  # `fail` faults: which retryable stage
+    seconds: float = 0.0  # `delay` faults: how long
+    reason: str = "chaos"  # `abort` faults: published reason
+    times: int = 1  # how many firings remain
+    fired: int = field(default=0)
+
+    def spent(self) -> bool:
+        return self.fired >= self.times
+
+
+def parse_fault_plan(spec: str) -> List[Fault]:
+    """Parse a plan spec; raises ValueError on malformed entries so a typo'd
+    `SRML_FAULT_PLAN` fails loudly instead of silently injecting nothing."""
+    faults: List[Fault] = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        kind = parts[0].strip()
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in plan entry {entry!r}")
+        kwargs: Dict[str, str] = {}
+        for kv in parts[1:]:
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"malformed fault field {kv!r} in plan entry {entry!r}")
+            kwargs[k.strip()] = v.strip()
+        fault = Fault(kind=kind)
+        for k, v in kwargs.items():
+            if k == "rank":
+                fault.rank = int(v)
+            elif k == "round":
+                fault.round = int(v)
+            elif k == "stage":
+                fault.stage = v
+            elif k == "seconds":
+                fault.seconds = float(v)
+            elif k == "reason":
+                fault.reason = v
+            elif k == "times":
+                fault.times = int(v)
+            else:
+                raise ValueError(f"unknown fault field {k!r} in plan entry {entry!r}")
+        if fault.kind == "fail":
+            if fault.stage is None:
+                raise ValueError(f"fail fault needs stage=<name>: {entry!r}")
+        elif fault.rank is None or fault.round is None:
+            raise ValueError(f"{fault.kind} fault needs rank= and round=: {entry!r}")
+        faults.append(fault)
+    return faults
+
+
+# The process-level plan: loaded once from SRML_FAULT_PLAN (so subprocess
+# harness ranks inherit it through the environment), overridable in-process
+# for tests. Firing state lives on the Fault objects — `times` is per-process.
+_PLAN: Optional[List[Fault]] = None
+_PLAN_LOADED = False
+
+
+def active_plan() -> List[Fault]:
+    global _PLAN, _PLAN_LOADED
+    if not _PLAN_LOADED:
+        spec = os.environ.get("SRML_FAULT_PLAN", "")
+        _PLAN = parse_fault_plan(spec) if spec else []
+        _PLAN_LOADED = True
+    return _PLAN or []
+
+
+def set_fault_plan(spec: str) -> List[Fault]:
+    """Install a plan in-process (tests); returns the parsed faults."""
+    global _PLAN, _PLAN_LOADED
+    _PLAN = parse_fault_plan(spec)
+    _PLAN_LOADED = True
+    return _PLAN
+
+
+def clear_fault_plan() -> None:
+    global _PLAN, _PLAN_LOADED
+    _PLAN = []
+    _PLAN_LOADED = True
+
+
+def maybe_fail_stage(stage: str, attempt: int) -> None:
+    """Hook consulted by `core.retryable_stage` at the start of every attempt:
+    a matching un-spent `fail` fault raises a transient RendezvousTimeoutError
+    (the retryable class), consuming one firing."""
+    for f in active_plan():
+        if f.kind == "fail" and f.stage == stage and not f.spent():
+            f.fired += 1
+            raise RendezvousTimeoutError(
+                f"chaos: injected transient failure at stage {stage!r} attempt {attempt}",
+                timeout_s=0.0,
+            )
+
+
+class ChaosRendezvous(Rendezvous):
+    """Wrapper that applies the active fault plan to an inner rendezvous.
+
+    Tracks its own round counter (reset on `begin_epoch`, like the inner's);
+    faults fire when (rank, round) match this wrapper's view of the round
+    sequence — i.e. "the Nth control-plane round of this attempt"."""
+
+    def __init__(self, inner: Rendezvous, plan: Optional[List[Fault]] = None):
+        self.inner = inner
+        self.rank = inner.rank
+        self.nranks = inner.nranks
+        self.plan = plan if plan is not None else active_plan()
+        self._round = 0
+
+    def _apply_faults(self, round_index: int) -> None:
+        for f in self.plan:
+            if (
+                f.kind == "fail"
+                or f.spent()
+                or f.rank != self.rank
+                or f.round != round_index
+            ):
+                continue
+            f.fired += 1
+            if f.kind == "delay":
+                time.sleep(f.seconds)
+            elif f.kind == "abort":
+                self.inner.abort(f.reason)
+                raise RuntimeError(
+                    f"chaos: rank {self.rank} aborted at round {round_index} ({f.reason})"
+                )
+            elif f.kind == "drop":
+                # the message is "lost": never join the round; wait out our
+                # own deadline so the failure is the same symmetric timeout
+                # the peers raise
+                timeout_s = self.inner._round_timeout_s()
+                time.sleep(timeout_s)
+                self._raise_timeout(round_index, None, timeout_s)
+            elif f.kind == "kill":
+                # the hard-death case: no abort file, no atexit, no flush —
+                # exactly what a preempted/OOM-killed TPU host looks like
+                os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(60)  # pragma: no cover - SIGKILL delivery race
+
+    def _allgather_impl(self, payload: str) -> List[str]:
+        round_index = self._round
+        self._round += 1
+        self._apply_faults(round_index)
+        return self.inner._allgather_impl(payload)
+
+    def abort(self, reason: str) -> None:
+        self.inner.abort(reason)
+
+    def begin_epoch(self, epoch: int) -> None:
+        self.inner.begin_epoch(epoch)
+        self._round = 0
+
+    def close(self) -> None:
+        self.inner.close()
+
+    # the one-round override must land on the INNER instance: its
+    # _allgather_impl reads its own attribute (base barrier() routes through
+    # these hooks)
+    def _get_timeout_override(self) -> Optional[float]:
+        return self.inner._get_timeout_override()
+
+    def _set_timeout_override(self, value: Optional[float]) -> None:
+        self.inner._set_timeout_override(value)
+
+    def _round_timeout_s(self) -> float:
+        return self.inner._round_timeout_s()

@@ -19,7 +19,9 @@ import os
 import socket
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+from ..errors import RankFailedError, RendezvousTimeoutError
 
 __all__ = [
     "Rendezvous",
@@ -27,7 +29,48 @@ __all__ = [
     "FileRendezvous",
     "TpuContext",
     "allgather_ndarray",
+    "ABORT_PREFIX",
 ]
+
+# --------------------------------------------------------------------------
+# Abort channel: a failing rank PUBLISHES its failure so survivors raise a
+# typed RankFailedError within ~one heartbeat interval instead of blocking
+# until (or past) the round deadline. The sentinel is a plain string so it
+# travels over whatever substrate the rendezvous uses (slot write in
+# LocalRendezvous, `abort_rank_<r>` file in FileRendezvous).
+# --------------------------------------------------------------------------
+
+ABORT_PREFIX = "ABORT:"
+
+# A dead rank is declared failed when its heartbeat file is staler than
+# MISS_FACTOR x heartbeat_interval_s: 1.5 gives half an interval of scheduler
+# slack against false positives while keeping worst-case detection at
+# 1.5 x interval after the last touch — inside the 2 x interval budget the
+# fault-injection suite asserts.
+_HEARTBEAT_MISS_FACTOR = 1.5
+
+# FileRendezvous polls its round files every 5ms, but the failure scan (abort
+# files + heartbeat mtimes — O(nranks) stat calls against a possibly-shared
+# filesystem) runs at this coarser cadence: detection budgets are "promptly,
+# well before the deadline", which ~50ms meets without a stat storm.
+_FAILURE_SCAN_INTERVAL_S = 0.05
+
+
+def format_abort(rank: int, reason: str) -> str:
+    """``ABORT:<rank>:<reason>`` sentinel (reason newline-flattened)."""
+    return f"{ABORT_PREFIX}{int(rank)}:{' '.join(str(reason).split())}"
+
+
+def parse_abort(payload: str) -> Optional[Tuple[int, str]]:
+    """(rank, reason) when `payload` is an abort sentinel, else None."""
+    if not payload.startswith(ABORT_PREFIX):
+        return None
+    body = payload[len(ABORT_PREFIX):]
+    rank_s, _, reason = body.partition(":")
+    try:
+        return int(rank_s), reason
+    except ValueError:  # malformed — treat as unknown-rank abort
+        return -1, body
 
 
 def allgather_ndarray(rendezvous: "Rendezvous", arr, chunk_bytes: Optional[int] = None) -> List:
@@ -112,6 +155,14 @@ class Rendezvous:
     wraps it with telemetry (round-trip counter, payload bytes, latency
     histogram — rank-tagged, no collectives of its own). Out-of-tree
     subclasses overriding `allgather` directly keep working, minus telemetry.
+
+    Failure contract (docs/robustness.md): every round is bounded by a
+    deadline (``config["rendezvous_timeout_s"]`` unless the instance sets its
+    own) and raises `RendezvousTimeoutError` when it elapses; a failing rank
+    calls `abort(reason)` so survivors raise `RankFailedError` promptly
+    instead of waiting the deadline out. `begin_epoch(n)` re-namespaces the
+    round state so the fit driver's retries never read a failed attempt's
+    stale rounds.
     """
 
     rank: int
@@ -132,8 +183,73 @@ class Rendezvous:
     def _allgather_impl(self, payload: str) -> List[str]:
         raise NotImplementedError
 
-    def barrier(self) -> None:
-        self.allgather("")
+    def barrier(self, timeout_s: Optional[float] = None) -> None:
+        """Barrier = empty-payload allgather. `timeout_s` overrides this one
+        round's deadline (bounded teardown — TpuContext.__exit__)."""
+        if timeout_s is None:
+            self.allgather("")
+            return
+        prev = self._get_timeout_override()
+        self._set_timeout_override(timeout_s)
+        try:
+            self.allgather("")
+        finally:
+            self._set_timeout_override(prev)
+
+    # the override lives behind a hook pair so WRAPPERS (ChaosRendezvous, any
+    # future decorator) can forward it to the inner instance whose
+    # _allgather_impl actually reads it
+    def _get_timeout_override(self) -> Optional[float]:
+        return getattr(self, "_timeout_override", None)
+
+    def _set_timeout_override(self, value: Optional[float]) -> None:
+        self._timeout_override = value
+
+    def abort(self, reason: str) -> None:
+        """Publish this rank's failure so peers stop waiting. Default no-op:
+        substrates with their own supervisor (Spark barrier stages fail the
+        whole stage when a task dies) need no in-band abort channel."""
+
+    def begin_epoch(self, epoch: int) -> None:
+        """Re-namespace round state for retry attempt `epoch` (fit driver
+        resync): implementations reset round counters and clear the previous
+        epoch's abort markers so a coordinated retry starts clean."""
+
+    def close(self) -> None:
+        """Release background resources (heartbeat threads, file handles)."""
+
+    def _round_timeout_s(self) -> float:
+        """Effective per-round deadline: a one-round override (bounded
+        teardown) > the instance's own timeout > the framework config knob."""
+        override = getattr(self, "_timeout_override", None)
+        if override is not None:
+            return float(override)
+        own = getattr(self, "timeout_s", None)
+        if own is not None:
+            return float(own)
+        from ..core import config
+
+        return float(config.get("rendezvous_timeout_s", 300.0))
+
+    def _raise_rank_failed(self, rank: int, reason: str, round_index: Optional[int]) -> None:
+        from .. import telemetry
+
+        telemetry.registry().inc("rendezvous.rank_failures")
+        raise RankFailedError(rank, reason, round_index=round_index)
+
+    def _raise_timeout(
+        self, round_index: int, missing: Optional[List[int]], timeout_s: float
+    ) -> None:
+        from .. import telemetry
+
+        telemetry.registry().inc("rendezvous.timeouts")
+        who = f"ranks {missing} " if missing else ""
+        raise RendezvousTimeoutError(
+            f"rendezvous round {round_index}: {who}missing after {timeout_s}s",
+            round_index=round_index,
+            missing_ranks=missing,
+            timeout_s=timeout_s,
+        )
 
 
 class LocalRendezvous(Rendezvous):
@@ -148,23 +264,116 @@ class LocalRendezvous(Rendezvous):
             self.barrier = threading.Barrier(nranks)
             self.slots: List[Optional[str]] = [None] * nranks
             self.lock = threading.Lock()
+            self.abort_info: Optional[Tuple[int, str]] = None
+            self.epoch = 0
 
-    def __init__(self, rank: int, shared: "_Shared"):
+    def __init__(self, rank: int, shared: "_Shared", timeout_s: Optional[float] = None):
         self.rank = rank
         self.nranks = shared.barrier.parties
+        self.timeout_s = timeout_s  # None -> config["rendezvous_timeout_s"]
         self._shared = shared
+        self._round = 0
+        self._epoch = 0
 
     @classmethod
-    def create(cls, nranks: int) -> List["LocalRendezvous"]:
+    def create(cls, nranks: int, timeout_s: Optional[float] = None) -> List["LocalRendezvous"]:
         shared = cls._Shared(nranks)
-        return [cls(r, shared) for r in range(nranks)]
+        return [cls(r, shared, timeout_s) for r in range(nranks)]
+
+    def abort(self, reason: str) -> None:
+        """Publish ``ABORT:<rank>:<reason>`` (extra slot write) and break the
+        barrier so every peer blocked in `barrier.wait` wakes immediately
+        with a typed RankFailedError instead of its raw BrokenBarrierError."""
+        from .. import telemetry
+
+        shared = self._shared
+        with shared.lock:
+            if shared.abort_info is None:
+                shared.abort_info = (self.rank, str(reason))
+                shared.slots[self.rank] = format_abort(self.rank, reason)
+        telemetry.registry().inc("rendezvous.aborts_published")
+        shared.barrier.abort()
+
+    def begin_epoch(self, epoch: int) -> None:
+        # idempotent per epoch: only the FIRST rank to request it performs the
+        # barrier reset + state clear. A later rank repeating the reset would
+        # break peers that already re-entered the new epoch's round 0 wait —
+        # spuriously burning their bounded retry budget.
+        shared = self._shared
+        with shared.lock:
+            if shared.epoch >= epoch > 0:
+                # another rank already reset for this epoch — adopt it (the
+                # slot tags compare against the INSTANCE epoch, so it must
+                # advance on the idempotent path too)
+                self._round = 0
+                self._epoch = int(epoch)
+                return
+            shared.epoch = epoch
+            shared.abort_info = None
+            for i in range(self.nranks):
+                shared.slots[i] = None
+            # reset INSIDE the lock: no peer can observe the new epoch (and
+            # re-enter round 0's wait) until the lock is released, so the
+            # reset can never break a waiter of the epoch it is creating;
+            # reset() does not block when nobody waits
+            shared.barrier.reset()
+        self._round = 0
+        self._epoch = int(epoch)
+
+    def _wait(self, round_index: int, timeout_s: float) -> None:
+        """`barrier.wait` bounded by the round deadline; BrokenBarrierError
+        (a peer aborted, a peer timed out, or WE timed out — `wait(timeout)`
+        breaks the barrier for everyone) never leaks to callers: it converts
+        to RankFailedError when an abort was published, else the symmetric
+        RendezvousTimeoutError."""
+        try:
+            self._shared.barrier.wait(timeout=timeout_s)  # blocking-ok: deadline-bounded
+        except threading.BrokenBarrierError:
+            info = self._shared.abort_info
+            if info is not None:
+                self._raise_rank_failed(info[0], info[1], round_index)
+            self._raise_timeout(round_index, None, timeout_s)
 
     def _allgather_impl(self, payload: str) -> List[str]:
-        self._shared.slots[self.rank] = payload
-        self._shared.barrier.wait()
-        out = list(self._shared.slots)  # type: ignore[arg-type]
-        self._shared.barrier.wait()  # don't let a fast rank overwrite slots early
-        return out  # type: ignore[return-value]
+        shared = self._shared
+        round_index = self._round
+        self._round += 1
+        info = shared.abort_info
+        if info is not None:  # a peer failed in an earlier round — fail fast
+            self._raise_rank_failed(info[0], info[1], round_index)
+        timeout_s = self._round_timeout_s()
+        # slots carry an (epoch, round, payload) tag: a straggler still in a
+        # FAILED epoch that only now reaches its old round must not silently
+        # exchange payloads with a retried epoch's round on the same barrier —
+        # the tag mismatch surfaces as the transient desync error below (the
+        # file substrate gets the same protection from e<N>_round_<i> naming)
+        shared.slots[self.rank] = (self._epoch, round_index, payload)  # type: ignore[assignment]
+        self._wait(round_index, timeout_s)
+        out_tagged = list(shared.slots)
+        self._wait(round_index, timeout_s)  # don't let a fast rank overwrite slots early
+        out: List[str] = []
+        for r, item in enumerate(out_tagged):
+            aborted = parse_abort(item) if isinstance(item, str) else None
+            if aborted is not None:
+                self._raise_rank_failed(aborted[0], aborted[1], round_index)
+            if (
+                not isinstance(item, tuple)
+                or item[0] != self._epoch
+                or item[1] != round_index
+            ):
+                from .. import telemetry
+
+                telemetry.registry().inc("rendezvous.timeouts")
+                raise RendezvousTimeoutError(
+                    f"rendezvous round {round_index}: rank {r} delivered a "
+                    "payload from a different epoch/round (desync after a "
+                    "failed attempt)",
+                    round_index=round_index,
+                    missing_ranks=[r],
+                    timeout_s=timeout_s,
+                )
+            out.append(item[2])
+        return out
 
 
 class BarrierRendezvous(Rendezvous):
@@ -206,32 +415,171 @@ class FileRendezvous(Rendezvous):
         rank: int,
         nranks: int,
         root: str,
-        timeout_s: float = 300.0,
+        timeout_s: Optional[float] = None,
         run_id: Optional[str] = None,
+        heartbeat_interval_s: Optional[float] = None,
     ):
         """`run_id` should be a fresh nonce minted by the LAUNCHER and passed to
         every rank — it namespaces this run's rounds so stale files from a
         previous run in the same root can never be read as current. Without it,
-        the caller must guarantee `root` is a fresh directory per run."""
+        the caller must guarantee `root` is a fresh directory per run.
+
+        `timeout_s` is the per-round deadline (None -> the framework's
+        ``config["rendezvous_timeout_s"]``). `heartbeat_interval_s` (None ->
+        ``config["heartbeat_interval_s"]``) paces the liveness file each rank
+        touches from a daemon thread; survivors declare a pending rank dead —
+        and raise RankFailedError — when its heartbeat is staler than
+        1.5x the interval, so a SIGKILLed peer surfaces within 2x the
+        interval instead of after the full round deadline. All ranks must be
+        configured with the SAME interval."""
         self.rank = rank
         self.nranks = nranks
         self.root = os.path.join(root, run_id) if run_id else root
         self.timeout_s = timeout_s
         self._round = 0
+        self._epoch = 0
+        if heartbeat_interval_s is None:
+            from ..core import config
+
+            heartbeat_interval_s = float(config.get("heartbeat_interval_s", 5.0))
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        # per-peer (last observed mtime, local monotonic when first observed):
+        # staleness is measured as LACK OF MTIME PROGRESS on our own monotonic
+        # clock, never writer-clock vs reader-clock — cross-host skew on a
+        # shared FS must not kill healthy ranks
+        self._hb_seen: dict = {}
         os.makedirs(self.root, exist_ok=True)
 
+    # -- file layout -------------------------------------------------------
+    def _eprefix(self) -> str:
+        """Epoch namespace for round/abort files ('' for the first attempt —
+        the historical layout — so single-attempt runs keep their file names)."""
+        return "" if self._epoch == 0 else f"e{self._epoch}_"
+
+    def _abort_path(self, rank: int) -> str:
+        return os.path.join(self.root, f"{self._eprefix()}abort_rank_{rank}")
+
+    def _heartbeat_path(self, rank: int) -> str:
+        return os.path.join(self.root, f"heartbeat_rank_{rank}")
+
+    # -- heartbeat ---------------------------------------------------------
+    def _touch_heartbeat(self) -> None:
+        path = self._heartbeat_path(self.rank)
+        try:
+            with open(path, "a"):
+                pass
+            os.utime(path, None)
+        except OSError:  # pragma: no cover - transient FS hiccup; next beat retries
+            pass
+
+    def _ensure_heartbeat(self) -> None:
+        if self.heartbeat_interval_s <= 0:  # escape hatch: liveness via deadline only
+            return
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._touch_heartbeat()
+
+        def beat() -> None:
+            # Event.wait(interval) is the pacing AND the stop signal; a
+            # SIGKILL stops the touches instantly — which is the point.
+            while not self._hb_stop.wait(self.heartbeat_interval_s):
+                self._touch_heartbeat()
+
+        self._hb_stop.clear()
+        self._hb_thread = threading.Thread(
+            target=beat, name=f"srml-heartbeat-rank{self.rank}", daemon=True
+        )
+        self._hb_thread.start()
+
+    def close(self) -> None:
+        """Stop the heartbeat thread (daemonized, so leaking one is harmless —
+        but long-lived launchers creating many rendezvous should close)."""
+        self._hb_stop.set()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self._hb_stop.set()
+        except Exception:
+            pass
+
+    # -- abort channel -----------------------------------------------------
+    def abort(self, reason: str) -> None:
+        """Publish ``abort_rank_<rank>`` (write-then-rename, atomic appearance)
+        carrying the ABORT sentinel; survivors' poll loops see it within one
+        poll tick and raise RankFailedError."""
+        from .. import telemetry
+
+        tmp = os.path.join(self.root, f".abort_rank_{self.rank}.tmp")
+        try:
+            with open(tmp, "w") as f:
+                f.write(format_abort(self.rank, reason))
+            os.replace(tmp, self._abort_path(self.rank))
+        except OSError:  # pragma: no cover - abort is best-effort by design
+            return
+        telemetry.registry().inc("rendezvous.aborts_published")
+
+    def begin_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+        self._round = 0
+
+    def _check_failures(self, pending, round_index: int) -> None:
+        """Raise RankFailedError when any rank published an abort for this
+        epoch, or a PENDING peer's heartbeat went stale (killed process —
+        it cannot publish anything)."""
+        for r in range(self.nranks):
+            if r == self.rank:
+                continue
+            path = self._abort_path(r)
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        parsed = parse_abort(f.read())
+                except OSError:
+                    parsed = None
+                rank, reason = parsed if parsed is not None else (r, "abort file unreadable")
+                self._raise_rank_failed(rank, reason, round_index)
+        if self.heartbeat_interval_s <= 0:
+            return
+        stale_after = _HEARTBEAT_MISS_FACTOR * self.heartbeat_interval_s
+        now_m = time.monotonic()
+        for r in pending:
+            if r == self.rank:
+                continue
+            try:
+                mtime = os.path.getmtime(self._heartbeat_path(r))
+            except OSError:
+                continue  # not started yet — only the round deadline applies
+            seen = self._hb_seen.get(r)
+            if seen is None or mtime != seen[0]:
+                self._hb_seen[r] = (mtime, now_m)  # progress observed — alive
+                continue
+            stale_for = now_m - seen[1]
+            if stale_for > stale_after:
+                self._raise_rank_failed(
+                    r,
+                    f"heartbeat stale for {stale_for:.2f}s "
+                    f"(interval {self.heartbeat_interval_s}s) — process presumed dead",
+                    round_index,
+                )
+
     def _allgather_impl(self, payload: str) -> List[str]:
-        round_dir = os.path.join(self.root, f"round_{self._round}")
+        self._ensure_heartbeat()
+        round_index = self._round
+        round_dir = os.path.join(self.root, f"{self._eprefix()}round_{round_index}")
         self._round += 1
         os.makedirs(round_dir, exist_ok=True)
         tmp = os.path.join(round_dir, f".rank_{self.rank}.tmp")
         with open(tmp, "w") as f:
             f.write(payload)
         os.replace(tmp, os.path.join(round_dir, f"rank_{self.rank}"))
-        deadline = time.monotonic() + self.timeout_s
+        timeout_s = self._round_timeout_s()
+        deadline = time.monotonic() + timeout_s
         out: List[Optional[str]] = [None] * self.nranks
         pending = set(range(self.nranks))
-        while pending:
+        next_failure_scan = 0.0  # first iteration scans immediately
+        while pending:  # blocking-ok: deadline- and heartbeat-bounded poll
             for r in list(pending):
                 path = os.path.join(round_dir, f"rank_{r}")
                 if os.path.exists(path):
@@ -239,12 +587,17 @@ class FileRendezvous(Rendezvous):
                         out[r] = f.read()
                     pending.discard(r)
             if pending:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"rendezvous round {self._round - 1}: ranks {sorted(pending)} "
-                        f"missing after {self.timeout_s}s"
-                    )
-                time.sleep(0.01)
+                now_m = time.monotonic()
+                # round files poll at 5ms, but the failure scan (abort files +
+                # heartbeat mtimes: O(nranks) stats against a possibly-shared
+                # FS) is throttled — ~50ms detection granularity meets every
+                # promised budget without a stat storm
+                if now_m >= next_failure_scan:
+                    self._check_failures(pending, round_index)
+                    next_failure_scan = now_m + _FAILURE_SCAN_INTERVAL_S
+                if now_m > deadline:
+                    self._raise_timeout(round_index, sorted(pending), timeout_s)
+                time.sleep(0.005)
         return out  # type: ignore[return-value]
 
 
@@ -365,6 +718,23 @@ class TpuContext:
         import jax
 
         _ACTIVE_CONTEXT = self._prev_active
+        if (
+            self.rendezvous is not None
+            and exc_type is not None
+            and not (isinstance(exc_val, RankFailedError) or issubclass(exc_type, RankFailedError))
+        ):
+            # propagate the failure FIRST (before any local teardown) so peers
+            # blocked in a rendezvous round unwind within one failure scan —
+            # the abort-on-exception side of the reference's destroy-on-
+            # success / abort-on-exception teardown (cuml_context.py:150-167).
+            # A RankFailedError is NOT re-published: we are relaying a peer's
+            # failure, and a cascade of abort files would let later scanners
+            # blame a healthy survivor instead of the root-cause rank. Abort
+            # is best-effort and must never mask the original exception.
+            try:
+                self.rendezvous.abort(f"{exc_type.__name__}: {exc_val}")
+            except Exception:
+                pass
         if self._initialized_distributed:
             # destroy on success, abort-equivalent on exception
             # (reference cuml_context.py:150-167)
@@ -373,5 +743,31 @@ class TpuContext:
             except Exception:
                 pass
         if self.rendezvous is not None and exc_type is None:
-            self.rendezvous.barrier()
+            # success-path sync is BOUNDED: a peer that already exited (or
+            # died without publishing) must not hang our teardown forever. A
+            # timeout here is a warning, not an error — our own work
+            # succeeded; a published peer failure still propagates.
+            from ..core import config
+            from ..utils import get_logger
+
+            teardown_s = min(
+                float(config.get("teardown_timeout_s", 15.0)),
+                self.rendezvous._round_timeout_s(),
+            )
+            try:
+                self.rendezvous.barrier(timeout_s=teardown_s)
+            except RendezvousTimeoutError:
+                get_logger("TpuContext").warning(
+                    "teardown barrier timed out after %.1fs (a peer already "
+                    "exited?); continuing — local results are complete",
+                    teardown_s,
+                )
+            except RankFailedError as e:
+                # a peer died between finishing its work and the teardown
+                # sync: OUR fit succeeded, so this is a warning, not an error
+                # — failing here would discard completed local results
+                get_logger("TpuContext").warning(
+                    "peer failure during teardown barrier (%s); continuing — "
+                    "local results are complete", e,
+                )
         return False

@@ -1,0 +1,89 @@
+#
+# Worker for the fault-injection harness (launched by tests/test_chaos.py;
+# the non-test prefix keeps pytest from collecting it).
+#
+# Each rank drives a fixed number of control-plane rounds through a
+# ChaosRendezvous(FileRendezvous) — pure rendezvous traffic, no fit, no XLA
+# backend — with the fault plan inherited from SRML_FAULT_PLAN. Before each
+# round it writes a timestamp mark (so the parent can date a SIGKILL to the
+# round that triggered it), and on exit it writes a JSON result: rounds
+# completed, the typed error class observed, which rank it blamed, and when.
+#
+# argv: rank nranks rdv_dir out_dir run_id rounds heartbeat_interval_s timeout_s
+#
+import json
+import os
+import sys
+import time
+
+
+def _write_json(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nranks = int(sys.argv[2])
+    rdv_dir = sys.argv[3]
+    out_dir = sys.argv[4]
+    run_id = sys.argv[5]
+    rounds = int(sys.argv[6])
+    heartbeat_interval_s = float(sys.argv[7])
+    timeout_s = float(sys.argv[8])
+
+    from spark_rapids_ml_tpu.errors import RankFailedError, RendezvousTimeoutError
+    from spark_rapids_ml_tpu.parallel.chaos import ChaosRendezvous
+    from spark_rapids_ml_tpu.parallel.context import FileRendezvous
+
+    rdv = ChaosRendezvous(
+        FileRendezvous(
+            rank,
+            nranks,
+            rdv_dir,
+            timeout_s=timeout_s,
+            run_id=run_id,
+            heartbeat_interval_s=heartbeat_interval_s,
+        )
+    )
+    result = {
+        "rank": rank,
+        "rounds_done": 0,
+        "error": None,
+        "failed_rank": None,
+        "round_index": None,
+        "detected_at": None,
+    }
+    marks = []
+    try:
+        for i in range(rounds):
+            # mark BEFORE joining the round: a kill fault fires on entry, so
+            # the victim's last mark timestamps the kill to within the write
+            marks.append({"round": i, "t": time.time()})
+            _write_json(os.path.join(out_dir, f"marks_rank{rank}.json"), marks)
+            out = rdv.allgather(f"r{rank}:{i}")
+            assert out == [f"r{r}:{i}" for r in range(nranks)], out
+            result["rounds_done"] = i + 1
+    except RankFailedError as e:
+        result["error"] = "RankFailedError"
+        result["failed_rank"] = e.failed_rank
+        result["reason"] = e.reason
+        result["round_index"] = e.round_index
+        result["detected_at"] = time.time()
+    except RendezvousTimeoutError as e:
+        result["error"] = "RendezvousTimeoutError"
+        result["round_index"] = e.round_index
+        result["detected_at"] = time.time()
+    except Exception as e:  # noqa: BLE001 - e.g. the chaos abort fault's own raise
+        result["error"] = type(e).__name__
+        result["detail"] = str(e)
+        result["detected_at"] = time.time()
+    finally:
+        rdv.close()
+    _write_json(os.path.join(out_dir, f"result_rank{rank}.json"), result)
+
+
+if __name__ == "__main__":
+    main()

@@ -303,3 +303,89 @@ def test_ingest_peak_host_memory_1gib():
     assert chunked <= 1.3 * dataset_bytes, (
         f"chunked ingest used {chunked / dataset_bytes:.2f}x dataset bytes"
     )
+
+
+# ------------------------------------------------- opt-in ingest validation --
+
+
+@pytest.fixture
+def validate_on():
+    saved = core_mod.config["validate_ingest"]
+    core_mod.config["validate_ingest"] = True
+    yield
+    core_mod.config["validate_ingest"] = saved
+
+
+def test_validate_ingest_names_the_feature_column(validate_on, tiny_chunks):
+    from spark_rapids_ml_tpu.data import extract_dataset
+    from spark_rapids_ml_tpu.errors import IngestValidationError
+
+    x = np.arange(400, dtype=np.float64).reshape(100, 4)
+    x[37, 2] = np.nan  # lands several 256-byte chunks in
+    with pytest.raises(IngestValidationError, match=r"'feat'.*row 37") as ei:
+        extract_dataset({"feat": x}, input_col="feat")
+    assert isinstance(ei.value, ValueError)  # satellite contract: a clear ValueError
+    assert ei.value.column == "feat" and ei.value.row == 37
+
+
+def test_validate_ingest_names_the_exact_multi_col(validate_on):
+    from spark_rapids_ml_tpu.data import extract_dataset
+    from spark_rapids_ml_tpu.errors import IngestValidationError
+
+    df = pd.DataFrame(
+        {"a": np.ones(50), "b": np.ones(50), "c": np.ones(50), "label": np.zeros(50)}
+    )
+    df.loc[11, "b"] = np.inf
+    with pytest.raises(IngestValidationError) as ei:
+        extract_dataset(df, input_cols=["a", "b", "c"], label_col="label")
+    assert ei.value.column == "b" and ei.value.row == 11
+
+
+def test_validate_ingest_checks_label_and_weight(validate_on):
+    from spark_rapids_ml_tpu.data import extract_dataset
+    from spark_rapids_ml_tpu.errors import IngestValidationError
+
+    x = np.ones((20, 3))
+    lab = np.zeros(20)
+    lab[4] = np.nan
+    with pytest.raises(IngestValidationError) as ei:
+        extract_dataset(
+            {"f": x, "y": lab}, input_col="f", label_col="y"
+        )
+    assert ei.value.column == "y" and ei.value.row == 4
+    w = np.ones(20)
+    w[9] = -np.inf
+    with pytest.raises(IngestValidationError) as ei:
+        extract_dataset(
+            {"f": x, "y": np.zeros(20), "w": w},
+            input_col="f", label_col="y", weight_col="w",
+        )
+    assert ei.value.column == "w" and ei.value.row == 9
+
+
+def test_validate_ingest_sparse_maps_back_to_the_row(validate_on):
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.data import extract_dataset
+    from spark_rapids_ml_tpu.errors import IngestValidationError
+
+    m = sp.random(60, 10, density=0.2, random_state=0, format="csr")
+    bad_row = 23
+    m[bad_row, m[bad_row].indices[0] if m[bad_row].nnz else 0] = np.nan
+    m = m.tocsr()
+    with pytest.raises(IngestValidationError) as ei:
+        extract_dataset({"f": m}, input_col="f")
+    assert ei.value.column == "f" and ei.value.row == bad_row
+
+
+def test_validate_ingest_off_by_default_and_clean_data_passes(validate_on):
+    from spark_rapids_ml_tpu.data import extract_dataset
+
+    x = np.ones((10, 2))
+    out = extract_dataset({"f": x}, input_col="f")
+    assert out.n_rows == 10  # clean data passes with validation ON
+    core_mod.config["validate_ingest"] = False
+    x_bad = x.copy()
+    x_bad[0, 0] = np.nan
+    out = extract_dataset({"f": x_bad}, input_col="f")  # default: no scan, no raise
+    assert np.isnan(out.features[0, 0])

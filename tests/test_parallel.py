@@ -211,6 +211,62 @@ def test_barrier_rendezvous_adapter():
     assert ctx.sent == ["hello", ""]
 
 
+@pytest.mark.parametrize("rows", [[5, 17, 2], [0, 3], [4, 0, 0]])
+def test_allgather_ndarray_ragged_row_counts(rows):
+    # ragged per-rank row counts force the chunk-count AGREEMENT round to do
+    # real work (every rank must adopt the max), and zero-row ranks must
+    # still complete every round — all under the new per-round deadline
+    # (timeout_s set, so a desynced rank would fail typed, not hang)
+    from spark_rapids_ml_tpu.parallel.context import allgather_ndarray
+
+    nranks = len(rows)
+    rvs = LocalRendezvous.create(nranks, timeout_s=30.0)
+    arrs = [
+        (np.arange(r * 4, dtype=np.float64).reshape(r, 4) + 1000.0 * i)
+        for i, r in enumerate(rows)
+    ]
+    results = [None] * nranks
+
+    def work(r):
+        # chunk_bytes=64 -> 2 rows per chunk: the 17-row rank needs 9 rounds
+        results[r] = allgather_ndarray(rvs[r], arrs[r], chunk_bytes=64)
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(nranks)]
+    [t.start() for t in threads]
+    [t.join(timeout=60) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+    for r in range(nranks):
+        assert results[r] is not None, f"rank {r} did not finish"
+        assert len(results[r]) == nranks
+        for i in range(nranks):
+            assert results[r][i].shape == (rows[i], 4)
+            np.testing.assert_array_equal(results[r][i], arrs[i])
+
+
+def test_allgather_ndarray_zero_row_rank_chunk_agreement():
+    # the zero-row rank's local chunk count is 1; it must still participate
+    # in all 5 of the big rank's chunk rounds or every peer would hang —
+    # regression pin for the chunk-count agreement round
+    from spark_rapids_ml_tpu.parallel.context import allgather_ndarray
+
+    rvs = LocalRendezvous.create(2, timeout_s=20.0)
+    arrs = [np.zeros((0, 8)), np.arange(80, dtype=np.float64).reshape(10, 8)]
+    results = [None, None]
+
+    def work(r):
+        results[r] = allgather_ndarray(rvs[r], arrs[r], chunk_bytes=128)  # 2 rows/chunk
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(2)]
+    [t.start() for t in threads]
+    [t.join(timeout=30) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+    for r in range(2):
+        assert results[r][0].shape == (0, 8)
+        np.testing.assert_array_equal(results[r][1], arrs[1])
+    # both ranks ran the same number of rounds (agreement + 5 chunk rounds each)
+    assert rvs[0]._round == rvs[1]._round
+
+
 def test_allgather_ndarray_chunked(tmp_path):
     # broadcast_chunk_bytes bounds each control-plane round's payload; the
     # reassembled arrays must be identical to the unchunked gather
