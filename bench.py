@@ -56,14 +56,28 @@ SPARSE_ROWS = int(os.environ.get("BENCH_SPARSE_ROWS", 10_000_000))
 SPARSE_COLS = int(os.environ.get("BENCH_SPARSE_COLS", 2200))
 SPARSE_DENSITY = float(os.environ.get("BENCH_SPARSE_DENSITY", 0.001))
 
+# Optional CV grid-sweep lane (BENCH_CV=1): a numFolds x grid CrossValidator
+# fit through the multi-fit engine (benchmark/bench_cv.py) — reports
+# solves/sec and ingest-count-per-CV-fit (1 under the engine). Own @RESULT
+# line; NOT part of the headline geomean (no BASELINES entry).
+CV_ALGO = "cv_sweep"
+CV_ROWS = int(os.environ.get("BENCH_CV_ROWS", 200_000))
+CV_COLS = int(os.environ.get("BENCH_CV_COLS", 500))
+CV_FOLDS = int(os.environ.get("BENCH_CV_FOLDS", 3))
+CV_GRID = int(os.environ.get("BENCH_CV_GRID", 4))
+
 
 def bench_algos() -> tuple:
+    extra: tuple = ()
     if os.environ.get("BENCH_SPARSE"):
         # sparse FIRST: its ELL tensors are freed when its runner returns,
         # BEFORE the ~12 GiB dense protocol block is generated — running it
         # last would stack both datasets on the chip and OOM a single v5e
-        return (SPARSE_ALGO,) + ALGOS
-    return ALGOS
+        extra += (SPARSE_ALGO,)
+    if os.environ.get("BENCH_CV"):
+        # CV lane also ahead of the dense block, for the same HBM reason
+        extra += (CV_ALGO,)
+    return extra + ALGOS
 
 # Parent retry policy (override for tests): attempts x per-attempt timeout,
 # with a longer sleep after fast failures (backend-init class) than slow ones
@@ -195,6 +209,23 @@ def bench_sparse_logreg(mesh) -> float:
     return SPARSE_ROWS / fit_s
 
 
+def bench_cv_lane() -> float:
+    """CrossValidator grid sweep through the multi-fit engine: one ingest +
+    one layout for numFolds x grid solves (+ the refit). Reports rows
+    processed across all solves per second; the engine counters go to
+    stderr and ride the @TELEMETRY snapshot."""
+    from benchmark.bench_cv import run_cv_fit
+
+    out = run_cv_fit(CV_ROWS, CV_COLS, num_folds=CV_FOLDS, grid_size=CV_GRID)
+    _log(
+        f"cv_sweep: {out['fit']:.2f}s for {int(out['solves'])} solves "
+        f"({out['solves_per_sec']:.2f} solves/s, {int(out['ingests'])} ingest(s), "
+        f"{int(out['solves_batched'])} batched / "
+        f"{int(out['solves_sequential'])} sequential)"
+    )
+    return out["solves"] * CV_ROWS / out["fit"]
+
+
 def run_child() -> int:
     """Generate data once, run each pending algo fail-soft, emit @RESULT lines."""
     import jax
@@ -242,6 +273,7 @@ def run_child() -> int:
 
     runners = {
         SPARSE_ALGO: lambda: bench_sparse_logreg(mesh),
+        CV_ALGO: lambda: bench_cv_lane(),
         "pca": lambda: bench_pca(dense_data()["X"], dense_data()["w"], mesh),
         "logreg": lambda: bench_logreg(
             dense_data()["X"], dense_data()["w"], dense_data()["y_idx"]

@@ -1,0 +1,117 @@
+#
+# CrossValidator grid-sweep benchmark — the multi-fit engine's acceptance
+# lane (docs/performance.md "Multi-fit engine"). A numFolds x paramMaps CV
+# fit is the dominant production fit workload: this bench measures what the
+# engine claims to eliminate — per-fold ingest/layout and per-param-map
+# dispatch — by reporting solves/sec and the INGEST COUNT per CV fit
+# (1 under the engine, numFolds+1 without it) straight from the telemetry
+# registry, alongside the usual wall-clock row.
+#
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+from .base import BenchmarkBase
+
+
+def run_cv_fit(
+    n_rows: int,
+    n_cols: int,
+    *,
+    num_folds: int = 3,
+    grid_size: int = 4,
+    algo: str = "logistic",
+    max_iter: int = 30,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """One telemetry-instrumented CV grid fit over a host dataset (the dict
+    fast-ingest path); returns wall time plus the engine counters. Shared by
+    the BenchmarkBase lane below and bench.py's BENCH_CV lane."""
+    from spark_rapids_ml_tpu import telemetry
+    from spark_rapids_ml_tpu.evaluation import (
+        MulticlassClassificationEvaluator,
+        RegressionEvaluator,
+    )
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.models.regression import LinearRegression
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n_rows, n_cols), dtype=np.float32)
+    coef = rng.standard_normal(n_cols).astype(np.float32)
+    margin = x @ coef
+    if algo == "logistic":
+        est = LogisticRegression(maxIter=max_iter, tol=1e-12)
+        eva = MulticlassClassificationEvaluator(metricName="accuracy")
+        data = {"features": x, "label": (margin > 0).astype(np.float64)}
+    else:
+        est = LinearRegression()
+        eva = RegressionEvaluator(metricName="rmse")
+        data = {
+            "features": x,
+            "label": (margin + 0.1 * rng.standard_normal(n_rows)).astype(np.float64),
+        }
+    est.setFeaturesCol("features")
+    grid = (
+        ParamGridBuilder()
+        .addGrid(est.getParam("regParam"), list(np.logspace(-6, -3, grid_size)))
+        .build()
+    )
+    cv = CrossValidator(
+        estimator=est, estimatorParamMaps=grid, evaluator=eva,
+        numFolds=num_folds, seed=seed,
+    )
+
+    telemetry.enable()
+    mark = telemetry.registry().mark()
+    t0 = time.perf_counter()
+    cv.fit(data)
+    wall_s = time.perf_counter() - t0
+    counters = telemetry.registry().delta(mark)["counters"]
+
+    n_solves = num_folds * grid_size + 1  # + the best-model refit
+    return {
+        "fit": wall_s,
+        "solves": float(n_solves),
+        "solves_per_sec": n_solves / wall_s,
+        "ingests": counters.get("ingest.datasets", 0.0),
+        "placement_reuses": counters.get("fit.device_dataset_reuses", 0.0),
+        "solves_batched": counters.get("fit.solves_batched", 0.0),
+        "solves_sequential": counters.get("fit.solves_sequential", 0.0),
+    }
+
+
+class BenchmarkCV(BenchmarkBase):
+    name = "cv"
+    extra_args = {
+        "num_folds": (int, 3, "CV folds"),
+        "grid_size": (int, 4, "regParam grid points"),
+        "algo": (str, "logistic", "logistic | linear"),
+        "maxIter": (int, 30, "solver iterations (logistic)"),
+    }
+
+    def gen_dataset(self, args, mesh) -> Dict[str, Any]:
+        # data is generated inside run_cv_fit (host-side: CV ingests from the
+        # host exactly because ingest cost is what this lane measures)
+        return {}
+
+    def run_once(self, args, data, mesh) -> Dict[str, float]:
+        out = run_cv_fit(
+            args.num_rows, args.num_cols,
+            num_folds=args.num_folds, grid_size=args.grid_size,
+            algo=args.algo, max_iter=args.maxIter, seed=args.seed,
+        )
+        data["counters"] = {k: v for k, v in out.items() if k != "fit"}
+        return {"fit": out["fit"]}
+
+    def quality(self, args, data) -> Dict[str, float]:
+        # solves/sec + ingest-count-per-CV-fit: the engine's acceptance
+        # numbers (1 ingest under the engine vs numFolds+1 without it)
+        return data.get("counters", {})
+
+
+if __name__ == "__main__":
+    BenchmarkCV().run()

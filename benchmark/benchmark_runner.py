@@ -14,6 +14,7 @@ from __future__ import annotations
 import sys
 
 from .bench_approximate_nearest_neighbors import BenchmarkApproximateNearestNeighbors
+from .bench_cv import BenchmarkCV
 from .bench_dbscan import BenchmarkDBSCAN
 from .bench_ingest import BenchmarkIngest
 from .bench_kmeans import BenchmarkKMeans
@@ -26,6 +27,7 @@ from .bench_umap import BenchmarkUMAP
 from .utils import log
 
 ALGORITHMS = {
+    "cv": BenchmarkCV,
     "ingest": BenchmarkIngest,
     "pca": BenchmarkPCA,
     "kmeans": BenchmarkKMeans,

@@ -34,6 +34,18 @@ _BLOCKING_TREE = "spark_rapids_ml_tpu"
 _BLOCKING_EXEMPT_FILES = {"context.py"}
 _BLOCKING_RE = re.compile(r"while\s+True\b|\.wait\(\s*\)")
 
+# Transform/serving code pads batches through the bucket ladder
+# (parallel/mesh.py bucket_rows), never raw pad_rows: an exact-shape pad
+# mints one compiled `predict` program per distinct tail shape — tens of
+# seconds each on a TPU backend — where the ladder compiles once per bucket
+# (docs/performance.md "Multi-fit engine"). pad_rows stays legal inside
+# mesh.py itself (the ladder is built on it) and on lines carrying an
+# explicit `# bucket-ok` waiver (fit-side layout code, where every fit pads
+# to ONE shape anyway).
+_PAD_ROWS_TREE = "spark_rapids_ml_tpu"
+_PAD_ROWS_EXEMPT_FILES = {"mesh.py"}
+_PAD_ROWS_RE = re.compile(r"\bpad_rows\s*\(")
+
 failures: list[str] = []
 for target in TARGETS:
     for path in sorted((ROOT / target).rglob("*.py")):
@@ -64,6 +76,18 @@ for target in TARGETS:
                     f"{path}:{lineno}: unbounded blocking wait in the framework — "
                     "a dead peer must raise a typed error, not hang; bound it with "
                     "a deadline (see parallel/context.py) or mark `# blocking-ok`"
+                )
+            if (
+                target == _PAD_ROWS_TREE
+                and path.name not in _PAD_ROWS_EXEMPT_FILES
+                and _PAD_ROWS_RE.search(line)
+                and "# bucket-ok" not in line
+            ):
+                failures.append(
+                    f"{path}:{lineno}: raw pad_rows in the framework — serving "
+                    "batches pad through the bucket ladder (mesh.bucket_rows: one "
+                    "compile per bucket, not per tail shape); use it or mark "
+                    "`# bucket-ok`"
                 )
 
 import importlib

@@ -18,6 +18,16 @@ from .errors import (  # noqa: F401
 )
 from .linalg import DenseVector, SparseVector, Vectors  # noqa: F401
 
+
+def device_dataset_scope():
+    """Re-export of `core.device_dataset_scope` — enable DeviceDataset reuse
+    (one ingest+layout for every fit over the same dataset inside the scope;
+    docs/performance.md "Multi-fit engine")."""
+    from .core import device_dataset_scope as _scope
+
+    return _scope()
+
+
 __all__ = [
     "DenseVector",
     "SparseVector",
@@ -27,6 +37,7 @@ __all__ = [
     "RendezvousTimeoutError",
     "SolverDivergedError",
     "IngestValidationError",
+    "device_dataset_scope",
     "__version__",
 ]
 

@@ -71,6 +71,34 @@ def as_pandas(dataset: Any):
     raise TypeError(f"Unsupported dataset type {type(dataset)}; expected pandas/pyarrow/dict")
 
 
+def dataset_fingerprint(dataset: Any) -> tuple:
+    """Identity fingerprint of a dataset object, for DeviceDataset cache keys
+    (core.device_dataset_scope).
+
+    Identity-based BY DESIGN: it never hashes the data (a content hash of a
+    multi-GiB block would cost a full host pass per fit — more than the
+    ingest it is meant to skip), so it is exact for the reuse it serves —
+    repeated fits over the SAME object inside one scope (CV folds, sweep
+    refits). The id() is only stable while the object is alive, so every
+    cache entry PINS its source object (`DeviceDataset.source`) — without
+    that, a recycled id on a new same-shaped object would be a silent false
+    hit. Shape/columns ride along as defense in depth. An in-place mutation
+    of the same object between fits inside one scope is not detected
+    (documented in docs/performance.md)."""
+    if isinstance(dataset, dict):
+        shapes = tuple(
+            (str(k), tuple(getattr(v, "shape", ())) or (len(v) if hasattr(v, "__len__") else None))
+            for k, v in dataset.items()
+        )
+        return (id(dataset), type(dataset).__name__, shapes)
+    cols = getattr(dataset, "columns", None)
+    cols_t = tuple(map(str, cols)) if cols is not None else None
+    shape = getattr(dataset, "shape", None)
+    if shape is None and hasattr(dataset, "__len__"):
+        shape = (len(dataset),)
+    return (id(dataset), type(dataset).__name__, cols_t, tuple(shape) if shape else None)
+
+
 def ingest_chunk_rows(row_bytes: int) -> int:
     """Rows per ingest chunk under ``core.config["ingest_chunk_bytes"]``."""
     from .core import config  # lazy: core imports this module at load time

@@ -280,52 +280,106 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
     # densifying — the reference's sparse qn path (classification.py:975-1098)
     _supports_sparse_input = True
 
-    def _get_tpu_fit_func(self, extracted: ExtractedData):
+    def _resolve_classes(self, labels_host: np.ndarray, inputs: FitInputs) -> np.ndarray:
+        """Sorted global class values for THIS fit's rows. Honors a fold's
+        row mask (a weight-masked CV fold must discover classes from its
+        TRAIN rows only — physical-split parity) and merges across ranks
+        under SPMD (the reference gets this for free because cuML's qn fit
+        allgathers label cardinality internally)."""
         import json
 
+        lbl = labels_host if inputs.host_mask is None else labels_host[inputs.host_mask]
+        local_classes = np.unique(lbl).astype(np.float64)
+        gathered = inputs.allgather_host(json.dumps(local_classes.tolist()))
+        return np.unique(np.concatenate([np.asarray(json.loads(g)) for g in gathered]))
+
+    def _degenerate_single_class(self, classes: np.ndarray, inputs: FitInputs) -> Dict[str, Any]:
+        # degenerate single-class fit: P(class)=1 (Spark parity,
+        # reference classification.py:1122-1135)
+        return {
+            "coef_": np.zeros((1, inputs.n_cols)),
+            "intercept_": np.array([np.inf if classes[0] == 1.0 else -np.inf]),
+            "classes_": classes,
+            "n_iter_": 0,
+            "objective_": 0.0,
+            "n_cols": inputs.n_cols,
+            "dtype": np.dtype(inputs.dtype).name,
+        }
+
+    def _fit_geometry(self, classes: np.ndarray, labels_host: np.ndarray, inputs: FitInputs):
+        """(multinomial, y_idx device array) shared by the sequential and
+        batched solve paths."""
+        family = self.getOrDefault("family")
+        k = len(classes)
+        multinomial = family == "multinomial" or (family == "auto" and k > 2)
+        if family == "binomial" and k > 2:
+            raise ValueError(f"family='binomial' but found {k} classes")
+        # Under a fold mask, held-out rows may carry labels OUTSIDE the
+        # fold's class set; their weight is 0 so they contribute nothing,
+        # but the index must stay in [0, k) for the traced gather — clip
+        # (exact for every in-set label: classes is sorted unique)
+        y_idx_host = np.clip(
+            np.searchsorted(classes, labels_host), 0, k - 1
+        ).astype(np.int32)
+        return multinomial, inputs.put_rows(y_idx_host)
+
+    @staticmethod
+    def _finalize_state(state: Dict[str, Any], classes, inputs: FitInputs, common) -> Dict[str, Any]:
+        """Host-fetched solver state -> model attribute dict, running the
+        shared divergence guard / stall warning / telemetry record."""
+        from .. import telemetry
+        from ..ops.logistic import check_glm_result, warn_if_early_stall
+
+        check_glm_result(state)
+        warn_if_early_stall(
+            state, standardize=common["standardize"], max_iter=common["max_iter"]
+        )
+        if telemetry.enabled():  # gate: the arg fetches sync with the device
+            telemetry.record_solver_result(
+                "logistic",
+                n_iter=int(state["n_iter_"]),
+                objective=float(state["objective_"]),
+                stalled=bool(np.asarray(state.get("stalled_", False))),
+            )
+        return {
+            "coef_": np.asarray(state["coef_"], dtype=np.float64),
+            "intercept_": np.asarray(state["intercept_"], dtype=np.float64),
+            "classes_": classes,
+            "n_iter_": int(state["n_iter_"]),
+            "objective_": float(state["objective_"]),
+            "n_cols": inputs.n_cols,
+            "dtype": np.dtype(inputs.dtype).name,
+        }
+
+    @staticmethod
+    def _solver_statics(params: Dict[str, Any]) -> Dict[str, Any]:
+        return dict(
+            fit_intercept=bool(params["fit_intercept"]),
+            standardize=bool(params["standardization"]),
+            max_iter=int(params["max_iter"]),
+            tol=float(params["tol"]),
+            lbfgs_memory=int(params["lbfgs_memory"]),
+        )
+
+    def _get_tpu_fit_func(self, extracted: ExtractedData):
         from ..ops.logistic import logistic_fit, logistic_fit_ell
 
         labels_host = extracted.label
-        family = self.getOrDefault("family")
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             alpha = float(params["alpha"])
             l1_ratio = float(params["l1_ratio"])
-            # class set must be GLOBAL: merge each rank's local label values
-            # (the reference gets this for free because cuML's qn fit allgathers
-            # label cardinality internally)
-            local_classes = np.unique(labels_host).astype(np.float64)
-            gathered = inputs.allgather_host(json.dumps(local_classes.tolist()))
-            classes = np.unique(np.concatenate([np.asarray(json.loads(g)) for g in gathered]))
-            k = len(classes)
-            if k == 1:
-                # degenerate single-class fit: P(class)=1 (Spark parity,
-                # reference classification.py:1122-1135)
-                return {
-                    "coef_": np.zeros((1, inputs.n_cols)),
-                    "intercept_": np.array([np.inf if classes[0] == 1.0 else -np.inf]),
-                    "classes_": classes,
-                    "n_iter_": 0,
-                    "objective_": 0.0,
-                    "n_cols": inputs.n_cols,
-                    "dtype": np.dtype(inputs.dtype).name,
-                }
-            multinomial = family == "multinomial" or (family == "auto" and k > 2)
-            if family == "binomial" and k > 2:
-                raise ValueError(f"family='binomial' but found {k} classes")
-            y_idx_host = np.searchsorted(classes, labels_host).astype(np.int32)
-            y_idx = inputs.put_rows(y_idx_host)
+            classes = self._resolve_classes(labels_host, inputs)
+            if len(classes) == 1:
+                return self._degenerate_single_class(classes, inputs)
+            multinomial, y_idx = self._fit_geometry(classes, labels_host, inputs)
             common = dict(
-                k=k,
+                k=len(classes),
                 multinomial=multinomial,
                 lam_l2=alpha * (1.0 - l1_ratio),
                 lam_l1=alpha * l1_ratio,
                 use_l1=alpha * l1_ratio > 0,
-                fit_intercept=bool(params["fit_intercept"]),
-                standardize=bool(params["standardization"]),
-                max_iter=int(params["max_iter"]),
-                tol=float(params["tol"]),
-                lbfgs_memory=int(params["lbfgs_memory"]),
+                **self._solver_statics(params),
             )
             if inputs.X_sparse is not None:
                 ell_val, ell_idx = inputs.ell_rows()
@@ -335,35 +389,69 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 )
             else:
                 state = logistic_fit(inputs.X, y_idx, inputs.w, **common)
-            from ..ops.logistic import check_glm_result, warn_if_early_stall
-
             # ONE device->host fetch of the whole result, then the divergence
             # guard runs on the already-fetched scalars (no extra sync)
             state = {k: np.asarray(v) for k, v in state.items()}
-            check_glm_result(state)
-            warn_if_early_stall(
-                state, standardize=common["standardize"], max_iter=common["max_iter"]
-            )
-            from .. import telemetry
-
-            if telemetry.enabled():  # gate: the arg fetches sync with the device
-                telemetry.record_solver_result(
-                    "logistic",
-                    n_iter=int(state["n_iter_"]),
-                    objective=float(state["objective_"]),
-                    stalled=bool(np.asarray(state.get("stalled_", False))),
-                )
-            return {
-                "coef_": np.asarray(state["coef_"], dtype=np.float64),
-                "intercept_": np.asarray(state["intercept_"], dtype=np.float64),
-                "classes_": classes,
-                "n_iter_": int(state["n_iter_"]),
-                "objective_": float(state["objective_"]),
-                "n_cols": inputs.n_cols,
-                "dtype": np.dtype(inputs.dtype).name,
-            }
+            return self._finalize_state(state, classes, inputs, common)
 
         return _fit
+
+    def _batch_group_key(self, sp: Dict[str, Any]):
+        # regParam (alpha) and elasticNetParam (l1_ratio) are TRACED scalars
+        # of the solver — a grid over them is one compiled program. The L1
+        # solver choice is a derived STATIC (use_l1), so grids mixing
+        # L1-on/off split into one batched program per side. Everything else
+        # in the solver param dict changes program structure.
+        use_l1 = float(sp["alpha"]) * float(sp["l1_ratio"]) > 0
+        rest = tuple(sorted((k, repr(v)) for k, v in sp.items() if k not in ("alpha", "l1_ratio")))
+        return (use_l1, rest)
+
+    def _get_tpu_batched_fit_func(self, extracted: ExtractedData):
+        from .. import telemetry
+        from ..ops.logistic import logistic_fit_batched, logistic_fit_ell_batched
+
+        labels_host = extracted.label
+
+        def _fit_batch(inputs: FitInputs, param_sets) -> Optional[list]:
+            if telemetry.convergence_trace_enabled():
+                # per-iteration host callbacks receive per-grid-point scalars;
+                # under vmap they would see batched values — trace sequentially
+                return None
+            classes = self._resolve_classes(labels_host, inputs)
+            if len(classes) == 1:
+                return [self._degenerate_single_class(classes, inputs) for _ in param_sets]
+            multinomial, y_idx = self._fit_geometry(classes, labels_host, inputs)
+            alphas = np.asarray([float(sp["alpha"]) for sp in param_sets])
+            l1rs = np.asarray([float(sp["l1_ratio"]) for sp in param_sets])
+            lam_l2s = (alphas * (1.0 - l1rs)).astype(inputs.dtype)
+            lam_l1s = (alphas * l1rs).astype(inputs.dtype)
+            statics = self._solver_statics(param_sets[0])  # uniform per group key
+            common = dict(
+                k=len(classes),
+                multinomial=multinomial,
+                use_l1=bool((lam_l1s > 0).any()),
+                **statics,
+            )
+            if inputs.X_sparse is not None:
+                ell_val, ell_idx = inputs.ell_rows()
+                w_dev = inputs.put_rows(np.asarray(inputs.w, dtype=inputs.dtype))
+                stacked = logistic_fit_ell_batched(
+                    ell_val, ell_idx, y_idx, w_dev, lam_l2s, lam_l1s,
+                    d=inputs.n_cols, **common,
+                )
+            else:
+                stacked = logistic_fit_batched(
+                    inputs.X, y_idx, inputs.w, lam_l2s, lam_l1s, **common
+                )
+            stacked = {k: np.asarray(v) for k, v in stacked.items()}  # ONE fetch
+            return [
+                self._finalize_state(
+                    {k: v[i] for k, v in stacked.items()}, classes, inputs, common
+                )
+                for i in range(len(param_sets))
+            ]
+
+        return _fit_batch
 
     def _create_model(self, attrs: Dict[str, Any]) -> "LogisticRegressionModel":
         return LogisticRegressionModel(**attrs)
@@ -574,23 +662,29 @@ class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
         return combined
 
     def _transform_evaluate(self, dataset: Any, evaluator: Any) -> List[float]:
-        """Score ALL packed models in one pass over the data."""
+        """Score ALL packed models in one pass over a DATASET (extracts the
+        feature block, then delegates to `_transform_evaluate_arrays`)."""
+        from ..core import evaluator_label_column
+
+        pdf = as_pandas(dataset)
+        label = pdf[evaluator_label_column(self, evaluator)].to_numpy(dtype=np.float64)
+        extracted = self._pre_process_data(dataset, for_fit=False)
+        return self._transform_evaluate_arrays(extracted.features, label, evaluator)
+
+    def _transform_evaluate_arrays(
+        self, features: Any, label: np.ndarray, evaluator: Any
+    ) -> List[float]:
+        """Score ALL packed models over already-extracted blocks — the array
+        entry point CrossValidator uses to score held-out rows by slicing
+        the one ingested block (no pandas round-trip)."""
         from ..metrics import MulticlassMetrics
 
         assert hasattr(self, "_sub_models"), "call _combine first"
-        label_col = (
-            evaluator.getOrDefault("labelCol")
-            if hasattr(evaluator, "hasParam") and evaluator.hasParam("labelCol")
-            else self.getOrDefault("labelCol")
-        )
-        pdf = as_pandas(dataset)
-        label = pdf[label_col].to_numpy(dtype=np.float64)
-        extracted = self._pre_process_data(dataset, for_fit=False)
         want_logloss = evaluator.getMetricName() == "logLoss"
         eps = evaluator.getOrDefault("eps") if evaluator.hasParam("eps") else 1e-15
         scores = []
         for m in self._sub_models:
-            _, prob = m._raw_prob(extracted.features)
+            _, prob = m._raw_prob(features)
             prediction = m._predict_from_prob(prob)
             pairs = np.stack([label, prediction], axis=1)
             uniq, inverse = np.unique(pairs, axis=0, return_inverse=True)

@@ -235,6 +235,57 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
 
         return _fit
 
+    def _batch_group_key(self, sp: Dict[str, Any]):
+        # regParam (alpha) and elasticNetParam (l1_ratio) are TRACED scalars
+        # of the normal-equation / gram-CD solve; the solver choice use_cd is
+        # a derived STATIC, so grids mixing elastic-net and ridge/OLS points
+        # split into one batched program per solver. A whole batched grid
+        # costs ONE sufficient-statistics pass over the data.
+        use_cd = float(sp["alpha"]) > 0 and float(sp["l1_ratio"]) > 0
+        rest = tuple(sorted((k, repr(v)) for k, v in sp.items() if k not in ("alpha", "l1_ratio")))
+        return (use_cd, rest)
+
+    def _get_tpu_batched_fit_func(self, extracted: ExtractedData):
+        from ..ops.linear import linear_fit_batched, linear_fit_ell_batched
+
+        def _fit_batch(inputs: FitInputs, param_sets) -> Optional[list]:
+            alphas = np.asarray([float(sp["alpha"]) for sp in param_sets], dtype=inputs.dtype)
+            l1rs = np.asarray([float(sp["l1_ratio"]) for sp in param_sets], dtype=inputs.dtype)
+            p0 = param_sets[0]  # statics are uniform per group key
+            common = dict(
+                fit_intercept=bool(p0["fit_intercept"]),
+                standardize=bool(p0.get("normalize", False)),
+                use_cd=bool(alphas[0] > 0 and l1rs[0] > 0),
+                max_iter=int(p0["max_iter"]),
+                tol=float(p0["tol"]),
+            )
+            if inputs.X_sparse is not None:
+                ell_val, ell_idx = inputs.ell_rows()
+                stacked = linear_fit_ell_batched(
+                    ell_val,
+                    ell_idx,
+                    inputs.put_rows(np.asarray(inputs.y, dtype=inputs.dtype)),
+                    inputs.put_rows(np.asarray(inputs.w, dtype=inputs.dtype)),
+                    alphas, l1rs, d=inputs.n_cols, **common,
+                )
+            else:
+                stacked = linear_fit_batched(
+                    inputs.X, inputs.y, inputs.w, alphas, l1rs, **common
+                )
+            stacked = {k: np.asarray(v) for k, v in stacked.items()}  # ONE fetch
+            return [
+                {
+                    "coef_": stacked["coef_"][i],
+                    "intercept_": float(stacked["intercept_"][i]),
+                    "n_iter_": int(stacked["n_iter_"][i]),
+                    "n_cols": inputs.n_cols,
+                    "dtype": np.dtype(inputs.dtype).name,
+                }
+                for i in range(len(param_sets))
+            ]
+
+        return _fit_batch
+
     def _create_model(self, attrs: Dict[str, Any]) -> "LinearRegressionModel":
         return LinearRegressionModel(**attrs)
 
@@ -338,25 +389,33 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
         return combined
 
     def _transform_evaluate(self, dataset: Any, evaluator: Any) -> List[float]:
-        """Score ALL packed models in one pass: predictions [n, m] via a single
-        MXU matmul, then per-model regression sufficient stats."""
-        from ..metrics import RegressionMetrics
-
-        assert self.coef_.ndim == 2 and hasattr(self, "_intercepts"), "call _combine first"
+        """Score ALL packed models in one pass over a DATASET (extracts the
+        feature block, then delegates to `_transform_evaluate_arrays`)."""
+        from ..core import evaluator_label_column
         from ..data import as_pandas
 
         extracted = self._pre_process_data(dataset, for_fit=False)
         # the evaluator's labelCol governs scoring (it may differ from the model's)
-        label_col = (
-            evaluator.getOrDefault("labelCol")
-            if hasattr(evaluator, "hasParam") and evaluator.hasParam("labelCol")
-            else self.getOrDefault("labelCol")
+        label = as_pandas(dataset)[evaluator_label_column(self, evaluator)].to_numpy(
+            dtype=np.float64
         )
-        label = as_pandas(dataset)[label_col].to_numpy(dtype=np.float64)
-        feats = extracted.features
+        return self._transform_evaluate_arrays(extracted.features, label, evaluator)
+
+    def _transform_evaluate_arrays(
+        self, features: Any, label: np.ndarray, evaluator: Any
+    ) -> List[float]:
+        """Score ALL packed models over already-extracted blocks: predictions
+        [n, m] via a single MXU matmul, then per-model regression sufficient
+        stats. The array entry point exists so CrossValidator can score a
+        held-out fold by SLICING the one ingested block instead of
+        round-tripping the fold through pandas and re-extracting it."""
+        from ..metrics import RegressionMetrics
+
+        assert self.coef_.ndim == 2 and hasattr(self, "_intercepts"), "call _combine first"
+        feats = features
         if hasattr(feats, "todense"):
             feats = np.asarray(feats.todense())
-        preds = feats.astype(np.float64) @ self.coef_.T + self._intercepts[None, :]  # [n, m]
+        preds = np.asarray(feats, dtype=np.float64) @ self.coef_.T + self._intercepts[None, :]  # [n, m]
         return [
             RegressionMetrics.from_values(label, preds[:, j]).evaluate(evaluator)
             for j in range(preds.shape[1])

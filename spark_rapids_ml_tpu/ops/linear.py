@@ -74,10 +74,14 @@ def _cd_elastic_net(A, r, lam, l1_ratio, max_iter, tol):
         b_q, max_delta = sweep(b_q)
         return b_q, it + 1, max_delta
 
+    from .owlqn import freeze_when_done
+
     b0 = jnp.zeros((d,), A.dtype)
     q0 = jnp.zeros((d,), A.dtype)
+    # freeze_when_done: vmap-safe for batched (alpha, l1_ratio) grids — a
+    # converged grid element must stop sweeping while slower ones finish
     (b, _), n_iter, _ = jax.lax.while_loop(
-        cond, body, ((b0, q0), 0, jnp.array(jnp.inf, A.dtype))
+        cond, freeze_when_done(cond, body), ((b0, q0), 0, jnp.array(jnp.inf, A.dtype))
     )
     return b, n_iter
 
@@ -136,6 +140,15 @@ def linear_fit_ell(
     (d, d) solve runs. Centering/standardization operate on the statistics,
     never the data, so sparsity is preserved AND full dense-parity holds
     (unlike the logistic path, no scale-only compromise is needed)."""
+    return _solve_from_stats(
+        _ell_sufficient_stats(values, indices, y, w, d, tile), values.dtype,
+        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+    )
+
+
+def _ell_sufficient_stats(values, indices, y, w, d: int, tile: int):
+    """ELL-layout sufficient statistics (same tuple as `_sufficient_stats`)."""
     from .sparse import ell_rmatvec
 
     dtype = values.dtype
@@ -174,10 +187,84 @@ def linear_fit_ell(
         )
     if n - n_full:
         G, _ = add_tile(G, (values[n_full:], indices[n_full:], w[n_full:]))
-    return _solve_from_stats(
-        (sw, sx, sy, G, c, syy), dtype,
-        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
-        standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+    return sw, sx, sy, G, c, syy
+
+
+def _solve_grid_from_stats(
+    stats, dtype, alphas, l1_ratios, *, fit_intercept, standardize, use_cd, max_iter, tol
+) -> Dict[str, jax.Array]:
+    """vmap the replicated (d, d) solve over an (alpha, l1_ratio) grid. The
+    data-dependent sufficient statistics are shared — the WHOLE grid costs
+    one pass over X regardless of grid size. Converged CD elements freeze
+    exactly (`_cd_elastic_net`), so every grid point matches its sequential
+    counterpart."""
+
+    def solve(a, l1):
+        return _solve_from_stats(
+            stats, dtype,
+            alpha=a, l1_ratio=l1, fit_intercept=fit_intercept,
+            standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+        )
+
+    return jax.vmap(solve)(alphas, l1_ratios)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter", "use_cd"))
+def linear_fit_batched(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    alphas: jax.Array,  # [S] Spark regParam grid
+    l1_ratios: jax.Array,  # [S] elasticNetParam grid
+    *,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    use_cd: bool = False,
+    max_iter: int = 1000,
+    tol: float = 1e-6,
+) -> Dict[str, jax.Array]:
+    """ONE compiled program solving a whole (alpha, l1_ratio) grid: the
+    normal-equation sufficient statistics are computed in ONE distributed
+    pass and every grid point solves on the replicated (d, d) gram — grid
+    size adds zero passes over the data. `use_cd` is a static of the traced
+    program (it selects the solver), so the model layer groups grids by it.
+
+    Returns the `linear_fit` dict with a leading [S] axis on every entry."""
+    stats = _sufficient_stats(X, y, w)
+    return _solve_grid_from_stats(
+        stats, X.dtype, alphas, l1_ratios,
+        fit_intercept=fit_intercept, standardize=standardize, use_cd=use_cd,
+        max_iter=max_iter, tol=tol,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("d", "tile", "fit_intercept", "standardize", "max_iter", "use_cd"),
+)
+def linear_fit_ell_batched(
+    values: jax.Array,
+    indices: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    alphas: jax.Array,
+    l1_ratios: jax.Array,
+    *,
+    d: int,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    use_cd: bool = False,
+    max_iter: int = 1000,
+    tol: float = 1e-6,
+    tile: int = 8192,
+) -> Dict[str, jax.Array]:
+    """Sparse (padded-ELL) analog of `linear_fit_batched`: one tiled gram
+    accumulation feeds the whole grid's solves."""
+    stats = _ell_sufficient_stats(values, indices, y, w, d, tile)
+    return _solve_grid_from_stats(
+        stats, values.dtype, alphas, l1_ratios,
+        fit_intercept=fit_intercept, standardize=standardize, use_cd=use_cd,
+        max_iter=max_iter, tol=tol,
     )
 
 

@@ -91,7 +91,7 @@ def _glm_qn_minimize(
     # densifying — certified by tests/test_large_sparse.py at 1e7 x 2200.
     alphas = jnp.asarray([2.0] + [0.5 ** i for i in range(n_alphas - 1)], jnp.float32)
 
-    from .owlqn import lbfgs_two_loop
+    from .owlqn import freeze_when_done, lbfgs_two_loop
 
     # Per-iteration convergence trace (telemetry): gated at TRACE time — the
     # host callback is free on CPU but a dispatch round-trip through a remote
@@ -159,7 +159,12 @@ def _glm_qn_minimize(
         (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
         jnp.asarray(jnp.inf, x0.dtype), f0, jnp.asarray(0, jnp.int32), jnp.asarray(False),
     )
-    x, _, _, _, _, _, _, _, obj, n_iter, stalled = jax.lax.while_loop(cond, body, state0)
+    # freeze_when_done makes the loop vmap-safe: batched hyperparameter
+    # sweeps (vmap over lam_l2/lam_l1) step until the SLOWEST grid element
+    # converges, and converged elements must hold their iterate exactly
+    x, _, _, _, _, _, _, _, obj, n_iter, stalled = jax.lax.while_loop(
+        cond, freeze_when_done(cond, body), state0
+    )
     return x, obj, n_iter, stalled
 
 
@@ -299,7 +304,20 @@ def logistic_fit_ell(
     sparsity (the reference's sparse trick, classification.py:975-1098: cuML qn
     standardizes sparse input without mean subtraction). Coefficients return in
     original space; no mu offset is folded into the intercept."""
-    from .sparse import ell_col_moments, ell_matmul
+    mu, d_scale, total_w = _ell_scaling(values, indices, w, d, standardize)
+    matvec, rmat = _ell_ops(values, indices, d)
+    return _fit_common(
+        matvec, rmat, values.shape[0],
+        values.dtype, d, y_idx, w, mu, d_scale, total_w,
+        k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
+        fit_intercept=fit_intercept, max_iter=max_iter, tol=tol, lbfgs_memory=lbfgs_memory,
+    )
+
+
+def _ell_scaling(values, indices, w, d: int, standardize: bool):
+    """Scale-only standardization statistics for the padded-ELL layout:
+    returns (mu=0, d_scale [d], total_w) — sparse data is never centered."""
+    from .sparse import ell_col_moments
 
     if standardize:
         total_w, _, var = ell_col_moments(values, indices, w, d)
@@ -309,21 +327,112 @@ def logistic_fit_ell(
         total_w = jnp.sum(w)
         d_scale = jnp.ones((d,), values.dtype)
     mu = jnp.zeros((d,), values.dtype)  # scale-only: never centered
+    return mu, d_scale, total_w
+
+
+def _ell_ops(values, indices, d: int):
+    """(matvec, rmat) closures over the ELL layout for `_fit_common`."""
+    from .sparse import ell_matmul, ell_rmatvec
 
     def rmat(r):  # Xᵀ r via per-column ELL scatter
-        from .sparse import ell_rmatvec
-
         return jnp.stack(
             [ell_rmatvec(values, indices, r[:, j], d) for j in range(r.shape[1])],
             axis=1,
         )
 
-    return _fit_common(
-        lambda Beff: ell_matmul(values, indices, Beff), rmat, values.shape[0],
-        values.dtype, d, y_idx, w, mu, d_scale, total_w,
-        k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
-        fit_intercept=fit_intercept, max_iter=max_iter, tol=tol, lbfgs_memory=lbfgs_memory,
-    )
+    return (lambda Beff: ell_matmul(values, indices, Beff)), rmat
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "k", "fit_intercept", "standardize", "max_iter", "lbfgs_memory", "multinomial", "use_l1",
+    ),
+)
+def logistic_fit_batched(
+    X: jax.Array,
+    y_idx: jax.Array,
+    w: jax.Array,
+    lam_l2s: jax.Array,  # [S] per-grid-point L2 strengths
+    lam_l1s: jax.Array,  # [S] per-grid-point L1 strengths
+    *,
+    k: int,
+    multinomial: bool,
+    use_l1: bool = False,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    lbfgs_memory: int = 10,
+) -> Dict[str, jax.Array]:
+    """ONE compiled program that solves a whole (lam_l2, lam_l1) grid.
+
+    The regularization strengths are traced scalars of the objective, so the
+    grid vmaps over them: XLA fuses the S per-model logit matmuls into one
+    wider matmul per L-BFGS iteration — X is read TWICE PER ITERATION FOR THE
+    WHOLE GRID instead of twice per iteration per model, and the grid pays
+    max(iters) loop steps instead of sum(iters). Converged grid elements
+    freeze exactly (`freeze_when_done`), so each returned model matches its
+    sequential `logistic_fit` counterpart. Statics (use_l1, max_iter, ...)
+    must be uniform across the grid — the model layer groups param sets by
+    that signature and falls back to sequential solves otherwise.
+
+    Returns the `logistic_fit` dict with a leading [S] axis on every entry."""
+    d = X.shape[1]
+    mu, d_scale, total_w = _make_scaling(X, w, standardize, fit_intercept)
+
+    def fit_one(lam_l2, lam_l1):
+        return _fit_common(
+            lambda Beff: X @ Beff, lambda r: X.T @ r, X.shape[0],
+            X.dtype, d, y_idx, w, mu, d_scale, total_w,
+            k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
+            fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
+            lbfgs_memory=lbfgs_memory,
+        )
+
+    return jax.vmap(fit_one)(lam_l2s, lam_l1s)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "d", "k", "fit_intercept", "standardize", "max_iter", "lbfgs_memory", "multinomial",
+        "use_l1",
+    ),
+)
+def logistic_fit_ell_batched(
+    values: jax.Array,
+    indices: jax.Array,
+    y_idx: jax.Array,
+    w: jax.Array,
+    lam_l2s: jax.Array,
+    lam_l1s: jax.Array,
+    *,
+    d: int,
+    k: int,
+    multinomial: bool,
+    use_l1: bool = False,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    lbfgs_memory: int = 10,
+) -> Dict[str, jax.Array]:
+    """Sparse (padded-ELL) analog of `logistic_fit_batched`: one program for
+    the whole grid, scale-only standardization computed once and shared."""
+    mu, d_scale, total_w = _ell_scaling(values, indices, w, d, standardize)
+    matvec, rmat = _ell_ops(values, indices, d)
+
+    def fit_one(lam_l2, lam_l1):
+        return _fit_common(
+            matvec, rmat, values.shape[0],
+            values.dtype, d, y_idx, w, mu, d_scale, total_w,
+            k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
+            fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
+            lbfgs_memory=lbfgs_memory,
+        )
+
+    return jax.vmap(fit_one)(lam_l2s, lam_l1s)
 
 
 def _fit_common(

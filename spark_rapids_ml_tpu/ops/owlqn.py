@@ -67,6 +67,26 @@ def check_solver_state(
     )
 
 
+def freeze_when_done(cond_fn: Callable, body_fn: Callable) -> Callable:
+    """Make a `lax.while_loop` body vmap-safe for batched hyperparameter
+    sweeps: under `vmap` the loop steps until EVERY batch element's cond is
+    false, and already-converged elements keep executing the body — their
+    iterates would drift past the stopping point and a batched grid solve
+    would no longer match N sequential solves. The wrapped body re-evaluates
+    this element's own cond and, when it is already false, returns the state
+    UNCHANGED (frozen), so extra steps are exact no-ops.
+
+    Unbatched, `body` only ever runs while cond holds, so the guard selects
+    the new state every time — results are bit-identical to the bare body."""
+
+    def body(state):
+        new = body_fn(state)
+        done = ~cond_fn(state)
+        return jax.tree.map(lambda old, upd: jnp.where(done, old, upd), state, new)
+
+    return body
+
+
 def lbfgs_two_loop(pg, S, Y, rho, count, pos, m):
     """Shared L-BFGS two-loop recursion over circular (s, y) history buffers:
     returns the descent direction −H·pg. Used by OWL-QN below and by the
@@ -132,7 +152,10 @@ def owlqn_minimize(
         return lbfgs_two_loop(pg, S, Y, rho, count, pos, m)
 
     def line_search(x, d, f0, pg, xi):
-        # backtracking with orthant projection: candidate = pi(x + a*d; xi)
+        # backtracking with orthant projection: candidate = pi(x + a*d; xi).
+        # vmap-safe as written: once `ok` holds, the body recomputes the SAME
+        # accepted candidate (a is no longer halved), so batched extra steps
+        # are exact no-ops without a freeze_when_done wrapper
         def proj(z):
             return jnp.where(z * xi < 0, 0.0, z)
 
@@ -195,5 +218,7 @@ def owlqn_minimize(
         (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
         jnp.asarray(jnp.inf, x0.dtype), f0, jnp.asarray(0, jnp.int32), jnp.asarray(False),
     )
-    x, _, _, _, _, _, _, obj, n_iter, _ = jax.lax.while_loop(cond, body, state0)
+    x, _, _, _, _, _, _, obj, n_iter, _ = jax.lax.while_loop(
+        cond, freeze_when_done(cond, body), state0
+    )
     return x, obj, n_iter

@@ -12,7 +12,10 @@
 #
 from .mesh import (  # noqa: F401
     ROWS_AXIS,
+    bucket_rows,
+    bucket_size,
     default_devices,
+    ensure_compilation_cache,
     get_mesh,
     make_global_rows,
     pad_rows,

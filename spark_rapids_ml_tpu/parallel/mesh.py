@@ -104,6 +104,45 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+_COMPILE_CACHE_DIR: Optional[str] = None  # dir currently wired into jax, if any
+
+
+def ensure_compilation_cache() -> bool:
+    """Point XLA's PERSISTENT compilation cache at
+    ``core.config["compilation_cache_dir"]`` (seeded from
+    ``SRML_COMPILE_CACHE_DIR``), so compiled programs survive process
+    restarts — a transform fleet's bucket-ladder programs and a sweep's
+    batched solver compile once per cluster, not once per process. Called
+    from the fit and transform entry points; re-pointing the config dir
+    takes effect on the next call. Returns whether a cache dir is active."""
+    global _COMPILE_CACHE_DIR
+    from ..core import config
+
+    path = config.get("compilation_cache_dir") or None
+    if path == _COMPILE_CACHE_DIR:
+        return path is not None
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        if path is not None:
+            # default thresholds skip sub-second programs — the dispatch-bound
+            # serving shapes this cache exists for; persist everything
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            try:
+                jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            except Exception:  # older jax: knob absent, default is fine
+                pass
+    except Exception as e:  # pragma: no cover - jax build without the cache
+        from ..utils import get_logger
+
+        get_logger("mesh").warning(
+            "could not enable the persistent compilation cache at %r (%s: %s)",
+            path, type(e).__name__, e,
+        )
+        return False
+    _COMPILE_CACHE_DIR = path
+    return path is not None
+
+
 _PRECISION_SUPPORT: dict = {}
 
 
@@ -208,6 +247,48 @@ def pad_rows(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
     if rem == 0:
         return x, n
     pad_widths = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_widths), n
+
+
+def bucket_size(n: int, *, multiple: int = 1, min_rows: int = 256, cap: Optional[int] = None) -> int:
+    """Row count of the bucket that batch size `n` pads up to.
+
+    Serving pads every transform batch to a small GEOMETRIC ladder of row
+    buckets (min_rows, 2·min_rows, 4·min_rows, ...) instead of running the
+    exact batch shape: a jitted `predict` then compiles once per BUCKET, not
+    once per distinct tail shape — on a TPU backend each avoided compile is
+    tens of seconds. Every rung is rounded up to `multiple` (the mesh shard
+    count on the distributed path), and the ladder is capped at `cap`
+    (aligned up) so a near-full tail batch reuses the full-batch program
+    instead of minting one more rung."""
+    if multiple < 1:
+        multiple = 1
+    b = max(min_rows, multiple)
+    b = -(-b // multiple) * multiple
+    cap_aligned = None
+    if cap is not None:
+        cap_aligned = -(-max(cap, multiple) // multiple) * multiple
+        if n >= cap_aligned:
+            return cap_aligned
+    while b < n:
+        b = -(-(b * 2) // multiple) * multiple
+    if cap_aligned is not None:
+        b = min(b, cap_aligned)
+    return b
+
+
+def bucket_rows(
+    x: np.ndarray, *, multiple: int = 1, min_rows: int = 256, cap: Optional[int] = None
+) -> Tuple[np.ndarray, int]:
+    """Zero-pad axis 0 of `x` up to its `bucket_size` rung; returns
+    (padded, n_valid). THE one sanctioned padding entry point for
+    transform/serving code (ci/lint.py forbids raw `pad_rows` there): callers
+    slice every output back to `n_valid` rows."""
+    b = bucket_size(x.shape[0], multiple=multiple, min_rows=min_rows, cap=cap)
+    n = x.shape[0]
+    if b == n:
+        return x, n
+    pad_widths = [(0, b - n)] + [(0, 0)] * (x.ndim - 1)
     return np.pad(x, pad_widths), n
 
 

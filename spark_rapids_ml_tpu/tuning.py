@@ -17,9 +17,29 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .core import _TpuEstimator, _TpuModel
+from .core import _TpuEstimator, _TpuModel, device_dataset_scope, evaluator_label_column
 from .params import Param, Params, TypeConverters
 from .utils import get_logger
+
+
+def _scoring_labels(pdf, est, eva) -> np.ndarray:
+    """Held-out labels for fold scoring; the evaluator's labelCol governs
+    (it may differ from the estimator's)."""
+    return pdf[evaluator_label_column(est, eva)].to_numpy(dtype=np.float64)
+
+
+def _engine_eligible(est) -> bool:
+    """Whether the device-resident multi-fit engine can run this tuning job:
+    the estimator supports the fused evaluate path AND we are
+    single-controller (fold weight masks index GLOBAL rows; under
+    multi-process SPMD each rank holds only a local block, so those jobs
+    take the per-fold fitMultiple path instead)."""
+    from .parallel import TpuContext
+
+    if not isinstance(est, _TpuEstimator):
+        return False
+    active = TpuContext.current()
+    return active is None or not active.is_spmd
 
 
 class ParamGridBuilder:
@@ -150,13 +170,81 @@ class CrossValidator(_ValidatorParams):
         num_models = len(epm)
         metrics = np.zeros((len(folds), num_models))
         accelerated = isinstance(est, _TpuEstimator) and est._supportsTransformEvaluate(eva)
+        engine = accelerated and _engine_eligible(est)
         logger.info(
             "CrossValidator: %d folds x %d param maps (%s path)",
-            len(folds), num_models, "fused single-pass" if accelerated else "fallback per-model",
+            len(folds), num_models,
+            "device-resident engine" if engine
+            else ("fused single-pass" if accelerated else "fallback per-model"),
         )
 
         collect_sub = bool(self.getOrDefault("collectSubModels"))
         sub_models: Optional[List[List[Any]]] = [None] * len(folds) if collect_sub else None
+        parallelism = min(self.getOrDefault("parallelism"), len(folds))
+
+        def run_folds(run_fold) -> None:
+            if parallelism > 1:
+                with ThreadPool(parallelism) as pool:
+                    for i, scores in enumerate(pool.map(run_fold, range(len(folds)))):
+                        metrics[i] = scores
+            else:
+                for i in range(len(folds)):
+                    metrics[i] = run_fold(i)
+
+        def pick_best():
+            avg = metrics.mean(axis=0)
+            std = metrics.std(axis=0)
+            best_idx = int(np.argmax(avg) if eva.isLargerBetter() else np.argmin(avg))
+            logger.info(
+                "CrossValidator: best param map %d (avg metric %.6f)", best_idx, avg[best_idx]
+            )
+            return avg, std, best_idx
+
+        if engine:
+            # Multi-fit engine: the FULL dataset is ingested and laid out in
+            # HBM exactly once; each fold is realized as a row-weight mask
+            # over that one placement (w_fold = w * mask — the solvers treat
+            # w == 0 rows as padding), every fold's param maps dispatch
+            # through the batched-sweep solver where eligible, held-out
+            # scoring SLICES the one ingested host block, and the final
+            # best-model refit reuses the placement once more. numFolds x
+            # paramMaps fits -> 1 ingest + 1 layout (telemetry-asserted in
+            # tests/test_multifit.py).
+            labels = _scoring_labels(pdf, est, eva)
+            if parallelism > 1:
+                # every fold solves on the SAME mesh over the SAME placed
+                # dataset — the accelerator is the bottleneck, so driver-side
+                # thread parallelism adds only dispatch contention (and
+                # concurrent sharded executions over shared buffers can
+                # deadlock XLA CPU collectives); folds run sequentially here
+                logger.info(
+                    "CrossValidator: ignoring parallelism=%d on the "
+                    "device-resident engine (folds share one mesh placement)",
+                    parallelism,
+                )
+                parallelism = 1
+            with device_dataset_scope() as scope:
+
+                def run_fold(fold_i: int) -> np.ndarray:
+                    train_idx, valid_idx = folds[fold_i]
+                    mask = np.zeros(n)
+                    mask[train_idx] = 1.0
+                    models = est._fit_internal(pdf, list(epm), row_mask=mask)
+                    if collect_sub:
+                        sub_models[fold_i] = models
+                    combined = models[0]._combine(models)
+                    feats = scope.last.extracted.features[valid_idx]
+                    return np.asarray(
+                        combined._transform_evaluate_arrays(feats, labels[valid_idx], eva)
+                    )
+
+                run_folds(run_fold)
+                avg, std, best_idx = pick_best()
+                best_model = est.copy(epm[best_idx]).fit(pdf)  # reuses the placement
+            return CrossValidatorModel(
+                bestModel=best_model, avgMetrics=list(avg), stdMetrics=list(std),
+                subModels=sub_models,
+            )
 
         def run_fold(fold_i: int) -> np.ndarray:
             train_idx, valid_idx = folds[fold_i]
@@ -179,19 +267,8 @@ class CrossValidator(_ValidatorParams):
                 sub_models[fold_i] = fold_models
             return np.asarray(scores)
 
-        parallelism = min(self.getOrDefault("parallelism"), len(folds))
-        if parallelism > 1:
-            with ThreadPool(parallelism) as pool:
-                for i, scores in enumerate(pool.map(run_fold, range(len(folds)))):
-                    metrics[i] = scores
-        else:
-            for i in range(len(folds)):
-                metrics[i] = run_fold(i)
-
-        avg = metrics.mean(axis=0)
-        std = metrics.std(axis=0)
-        best_idx = int(np.argmax(avg) if eva.isLargerBetter() else np.argmin(avg))
-        logger.info("CrossValidator: best param map %d (avg metric %.6f)", best_idx, avg[best_idx])
+        run_folds(run_fold)
+        avg, std, best_idx = pick_best()
         best_model = est.copy(epm[best_idx]).fit(pdf)
         return CrossValidatorModel(
             bestModel=best_model, avgMetrics=list(avg), stdMetrics=list(std), subModels=sub_models
@@ -332,11 +409,38 @@ class TrainValidationSplit(_ValidatorParams):
         valid = pdf.iloc[perm[n_train:]].reset_index(drop=True)
 
         accelerated = isinstance(est, _TpuEstimator) and est._supportsTransformEvaluate(eva)
+        engine = accelerated and _engine_eligible(est)
         logger.info(
             "TrainValidationSplit: %d train / %d valid x %d param maps (%s path)",
             n_train, n - n_train, len(epm),
-            "fused single-pass" if accelerated else "fallback per-model",
+            "device-resident engine" if engine
+            else ("fused single-pass" if accelerated else "fallback per-model"),
         )
+        if engine:
+            # same multi-fit engine as CrossValidator, with one fold: one
+            # placement serves the masked grid fit, the sliced held-out
+            # scoring, AND the final full-data refit
+            mask = np.zeros(n)
+            mask[perm[:n_train]] = 1.0
+            labels = _scoring_labels(pdf, est, eva)
+            valid_idx = perm[n_train:]
+            with device_dataset_scope() as scope:
+                models = est._fit_internal(pdf, list(epm), row_mask=mask)
+                combined = models[0]._combine(models)
+                feats = scope.last.extracted.features[valid_idx]
+                metrics = np.asarray(
+                    combined._transform_evaluate_arrays(feats, labels[valid_idx], eva)
+                )
+                best_idx = int(np.argmax(metrics) if eva.isLargerBetter() else np.argmin(metrics))
+                logger.info(
+                    "TrainValidationSplit: best param map %d (metric %.6f)",
+                    best_idx, metrics[best_idx],
+                )
+                best_model = est.copy(epm[best_idx]).fit(pdf)  # reuses the placement
+            sub = models if bool(self.getOrDefault("collectSubModels")) else None
+            return TrainValidationSplitModel(
+                bestModel=best_model, validationMetrics=list(metrics), subModels=sub
+            )
         if accelerated:
             models = [m for _, m in sorted(est.fitMultiple(train, epm))]
             combined = models[0]._combine(models)
