@@ -89,6 +89,12 @@ def bench_algos() -> tuple:
 MAX_ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 10))
 ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 2400))
 READY_TIMEOUT_S = float(os.environ.get("BENCH_READY_TIMEOUT", 240))
+# post-@READY progress budget: once the backend is live, @PHASE lane marks
+# act as heartbeats — a lane silent past this is presumed deadlocked and
+# killed without burning the whole attempt budget. Generous by design: the
+# longest legitimately silent stretch is one lane's datagen + compile +
+# timed fits (~several minutes at protocol scale through the tunnel).
+PHASE_TIMEOUT_S = float(os.environ.get("BENCH_PHASE_TIMEOUT", 900))
 BACKOFF_FAST_FAIL_S = float(os.environ.get("BENCH_BACKOFF", 60))
 BACKOFF_SLOW_FAIL_S = 10.0
 FAST_FAIL_WINDOW_S = 300.0  # died in <5 min => almost surely backend init
@@ -226,8 +232,18 @@ def bench_cv_lane() -> float:
     return out["solves"] * CV_ROWS / out["fit"]
 
 
+def _phase(name: str) -> None:
+    """Structured heartbeat to the parent watchdog: `@PHASE <name>` on stdout.
+    Any phase line counts as PROGRESS — the parent only kills a child whose
+    LAST phase went silent past the budget, so it can tell a hung backend
+    init (stuck at `backend-init`) from a slow compile (progressing through
+    `lane:*` phases). The phase history rides the BENCH JSON (`attempts`)."""
+    print(f"@PHASE {name}", flush=True)
+
+
 def run_child() -> int:
     """Generate data once, run each pending algo fail-soft, emit @RESULT lines."""
+    _phase("backend-init")  # first breath: the parent now knows we launched
     import jax
 
     from benchmark.gen_data import gen_classification_device
@@ -257,6 +273,7 @@ def run_child() -> int:
         runner — so the sparse lane (which runs first) never coexists with
         the ~12 GiB dense X on the chip."""
         if not dense:
+            _phase("warmup")  # datagen + first-compile: slow but PROGRESSING
             t0 = time.perf_counter()
             _log(f"generating {N_ROWS}x{N_COLS} dataset tile-wise ON DEVICE...")
             # single chip: plain (uncommitted-sharding) arrays — a committed
@@ -282,11 +299,14 @@ def run_child() -> int:
     }
     n_fail = 0
     for name in pending:
+        _phase(f"lane:{name}:start")
         try:
             v = runners[name]() / n_chips
             print("@RESULT " + json.dumps({"algo": name, "rows_per_sec_chip": v}), flush=True)
+            _phase(f"lane:{name}:end")
         except Exception as e:  # fail-soft: one dead section keeps the rest
             n_fail += 1
+            _phase(f"lane:{name}:failed")
             _log(f"bench[{name}] FAILED: {type(e).__name__}: {e}")
     # per-stage telemetry snapshot (HBM watermark, solver iterations, span
     # aggregates) for the parent to embed in the BENCH JSON line
@@ -299,9 +319,18 @@ def run_child() -> int:
 
 
 def _run_child_watched(env: dict, attempt_timeout: float):
-    """Run one bench child with TWO deadlines: READY_TIMEOUT_S until the
-    child's @READY (backend init — where a dead tunnel hangs forever), then
-    `attempt_timeout` overall. Returns (stdout_so_far, rc)."""
+    """Run one bench child with a PROGRESS watchdog plus a hard deadline.
+
+    The child must emit a structured progress line (`@PHASE`, `@READY`, or
+    `@RESULT`) at least every READY_TIMEOUT_S before the backend is up and
+    every PHASE_TIMEOUT_S after — a hung backend init goes silent at
+    `backend-init` and dies on the short budget; a lane that deadlocks
+    post-init dies on the long one instead of burning the whole attempt; and
+    the kill reason names the exact phase that stalled instead of the old
+    blind "> 240s to @READY" with zero visibility. `attempt_timeout` bounds
+    the whole attempt regardless. Returns (stdout_so_far, rc, init_hang,
+    phases) where `phases` is the [{"phase", "t_s"}, ...] history the parent
+    embeds in the BENCH JSON."""
     import threading
 
     proc = subprocess.Popen(
@@ -309,47 +338,75 @@ def _run_child_watched(env: dict, attempt_timeout: float):
         env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
     )
     lines: list = []
-    init_hang = False
+    phases: list = []  # [(phase name, seconds since spawn)]
     ready = threading.Event()
+    start = time.monotonic()
+    progress = {"t": start, "phase": "spawned"}
+
+    def _mark(phase: str) -> None:
+        now = time.monotonic()
+        progress["t"], progress["phase"] = now, phase
+        phases.append({"phase": phase, "t_s": round(now - start, 3)})
 
     def reader():
         assert proc.stdout is not None
         for line in proc.stdout:
             lines.append(line)
-            if line.startswith(("@READY", "@RESULT")):
+            if line.startswith("@PHASE "):
+                _mark(line[len("@PHASE "):].strip())
+            elif line.startswith("@READY"):
+                _mark("ready")
+                ready.set()
+            elif line.startswith("@RESULT"):
+                # a finished lane is progress even if no phase line raced it
+                progress["t"] = time.monotonic()
                 ready.set()
 
     t = threading.Thread(target=reader, daemon=True)
     t.start()
-    start = time.monotonic()
-    ready_deadline = start + READY_TIMEOUT_S
     hard_deadline = start + attempt_timeout
     killed = None
+    init_hang = False
     while proc.poll() is None:
         now = time.monotonic()
-        if not ready.is_set() and now > ready_deadline:
-            killed = f"backend init hang (> {READY_TIMEOUT_S:.0f}s to @READY)"
-            init_hang = True
+        budget = READY_TIMEOUT_S if not ready.is_set() else PHASE_TIMEOUT_S
+        if now - progress["t"] > budget:
+            killed = (
+                f"no progress past phase {progress['phase']!r} "
+                f"(> {budget:.0f}s silent)"
+            )
+            # only a stall at (or before) backend-init is the tunnel-outage
+            # signature the early-give-up counter tracks; a deadlocked lane
+            # after @READY had a live backend and deserves a normal retry
+            init_hang = progress["phase"] in ("spawned", "backend-init")
             break
         if now > hard_deadline:
-            killed = f"attempt timeout ({attempt_timeout:.0f}s)"
+            killed = f"attempt timeout ({attempt_timeout:.0f}s, phase {progress['phase']!r})"
             break
         time.sleep(1.0)
     if killed is not None:
         _log(f"bench child killed: {killed}")
         proc.kill()
+        phases.append({"phase": f"killed:{killed}", "t_s": round(time.monotonic() - start, 3)})
     proc.wait()
     t.join(5.0)
-    return "".join(lines), (proc.returncode if killed is None else -1), init_hang
+    return "".join(lines), (proc.returncode if killed is None else -1), init_hang, phases
 
 
-def emit(results: dict, telemetry_snap: Optional[dict] = None) -> None:
+def emit(
+    results: dict,
+    telemetry_snap: Optional[dict] = None,
+    attempts: Optional[list] = None,
+) -> None:
     """The one stdout JSON line. Degrades to value 0.0 when nothing ran.
     Only the three headline BASELINES algos enter the geomean; extra lanes
     (sparse_logreg) are logged to stderr. When the child reported a telemetry
     snapshot (@TELEMETRY line), it is embedded under "telemetry" — the same
     counters/gauges/span-aggregate dict `telemetry.snapshot()` returns
-    in-process (docs/observability.md)."""
+    in-process (docs/observability.md). `attempts` is the per-attempt
+    phase/watchdog history (which phases each child reached, what killed it)
+    so a degraded emission explains ITSELF instead of requiring stderr
+    archaeology."""
     for name, v in results.items():
         if name not in BASELINES and v and np.isfinite(v):
             _log(f"{name}: {v:,.0f} rows/sec/chip (no baseline; excluded from geomean)")
@@ -376,20 +433,27 @@ def emit(results: dict, telemetry_snap: Optional[dict] = None) -> None:
     }
     if telemetry_snap:
         record["telemetry"] = telemetry_snap
+    if attempts:
+        record["attempts"] = attempts
     print(json.dumps(record), flush=True)
 
 
 def main() -> None:
     results: dict = {}
     telemetry_snap: dict = {}
+    attempts: list = []
     try:
-        _attempt_loop(results, telemetry_snap)
+        _attempt_loop(results, telemetry_snap, attempts)
     except Exception as e:  # the JSON line is a CONTRACT: never die before emit
         _log(f"bench driver error: {type(e).__name__}: {e}")
-    emit(results, telemetry_snap)
+    emit(results, telemetry_snap, attempts)
 
 
-def _attempt_loop(results: dict, telemetry_snap: Optional[dict] = None) -> None:
+def _attempt_loop(
+    results: dict,
+    telemetry_snap: Optional[dict] = None,
+    attempts: Optional[list] = None,
+) -> None:
     # total budget DEFAULTS BELOW any plausible driver timeout: if the caller
     # kills this process before emit(), the JSON contract is lost — 45 min
     # fits ~4 full attempts at the protocol scale with backoff. A run of
@@ -409,7 +473,7 @@ def _attempt_loop(results: dict, telemetry_snap: Optional[dict] = None) -> None:
         env = dict(os.environ, BENCH_SKIP=",".join(a for a in bench_algos() if a in results))
         _log(f"bench attempt {attempt}/{MAX_ATTEMPTS}: running {'+'.join(pending)}")
         t0 = time.monotonic()
-        out, rc, init_hang = _run_child_watched(
+        out, rc, init_hang, phases = _run_child_watched(
             env,
             attempt_timeout=min(ATTEMPT_TIMEOUT_S, max(60.0, deadline - time.monotonic())),
         )
@@ -428,6 +492,14 @@ def _attempt_loop(results: dict, telemetry_snap: Optional[dict] = None) -> None:
                         telemetry_snap.update(snap)
                 except ValueError:
                     pass
+        if attempts is not None:
+            attempts.append({
+                "attempt": attempt,
+                "rc": rc,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "ran": pending,
+                "phases": phases,
+            })
         if all(a in results for a in bench_algos()):
             break
         elapsed = time.monotonic() - t0

@@ -1,0 +1,51 @@
+#
+# Assemble a cross-rank post-mortem from flight-recorder dumps.
+#
+#   python -m benchmark.postmortem /path/to/flightrec_dir --nranks 3
+#
+# Reads every `flightrec_rank_<r>.jsonl` the failed run dumped (ranks write
+# them on any SrmlError / abort publication; a hard-killed rank writes
+# NOTHING — its absence is evidence), correlates them by trace id, and
+# prints one timeline naming the failed rank, the round it died in, and what
+# every survivor was blocked on. `--json` emits the machine-readable form.
+# See docs/robustness.md "Post-mortems" / docs/observability.md.
+#
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump_dir", help="directory holding flightrec_rank_<r>.jsonl dumps")
+    ap.add_argument("--nranks", type=int, default=None,
+                    help="expected rank count (absent dumps become missing-rank evidence)")
+    ap.add_argument("--trace-id", default=None,
+                    help="assemble this trace (default: newest seen in the dumps)")
+    ap.add_argument("--last-k", type=int, default=25,
+                    help="events of per-rank tail to include")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable post-mortem dict instead of text")
+    ap.add_argument("-o", "--out", default=None,
+                    help="also write the machine-readable JSON here")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_ml_tpu.diagnostics import assemble_postmortem, render_postmortem
+
+    pm = assemble_postmortem(
+        args.dump_dir, nranks=args.nranks, trace_id=args.trace_id, last_k=args.last_k
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(pm, f, indent=2, default=str)
+    print(json.dumps(pm, indent=2, default=str) if args.as_json else render_postmortem(pm))
+    # exit 0 when the assembler reached a verdict, 2 when it found no failure
+    # evidence (so harnesses can tell "clean run" from "named a culprit")
+    return 0 if pm.get("failed_rank") is not None else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

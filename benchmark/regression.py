@@ -1,0 +1,261 @@
+#
+# Perf-regression gate over the BENCH trajectory (docs/observability.md
+# "Regression gate").
+#
+# Every round ships a BENCH_r<NN>.json artifact (bench.py's one-line JSON,
+# wrapped by the round driver under a "parsed" key). The trajectory was
+# collected but never CHECKED — a slowdown ships silently, and a cache
+# regression that doubles ingest work can hide entirely inside unchanged
+# wall time. This gate closes both holes:
+#
+#   * WALL-TIME LANE — the headline throughput geomean of the newest complete
+#     run must stay within `--min-ratio` (default 0.8) of the trajectory
+#     reference (median of prior complete runs).
+#   * COUNTER LANES — telemetry counters embedded in the BENCH snapshot
+#     (ingest/layout/placement/solve counts) are lower-is-better efficiency
+#     invariants: the newest run failing `current <= tolerance * reference`
+#     fails the lane even when wall time looks fine.
+#
+# Infra honesty: a run the tunnel killed (value 0.0 / INCOMPLETE) carries no
+# perf signal — those runs are excluded from the reference and, when the
+# NEWEST run is incomplete, the verdict is "no-data" (exit 0): an outage is
+# the watchdog's problem, not a perf regression. A lane with no reference
+# data reports "skipped".
+#
+# Output: one machine-readable JSON verdict on stdout
+#   {"verdict": "pass"|"fail"|"no-data", "lanes": [...], ...}
+# Exit code: 1 on "fail" unless --report-only (the ci/ lane runs report-only
+# until the trajectory carries enough telemetry-bearing rounds to be strict).
+#
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Counter lanes: (counter name, lower-is-better tolerance ratio). Chosen for
+# work-amount invariants the multi-fit engine and ingest cache guarantee —
+# the "cache regression doubles ingests" class. Tolerances are loose enough
+# to absorb lane additions (a new bench lane adds real work) but a 2x blowup
+# always fails.
+DEFAULT_COUNTER_LANES: List[Tuple[str, float]] = [
+    ("ingest.rows", 1.5),
+    ("ingest.datasets", 1.5),
+    ("ingest.chunks", 1.5),
+    ("placement.device_put_calls", 1.5),
+    ("sparse.csr_to_ell_calls", 1.5),
+    ("fit.solves_sequential", 1.5),
+    ("rendezvous.rounds", 1.5),
+]
+
+
+def load_bench_record(path: str) -> Dict[str, Any]:
+    """A BENCH artifact's inner record: the round driver wraps bench.py's
+    stdout line under "parsed"; accept the bare record (or a JSONL file whose
+    last parseable line is the record) too, so fixtures and ad-hoc runs work."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if doc is None:
+            return {}
+    if not isinstance(doc, dict):
+        return {}
+    inner = doc.get("parsed")
+    if isinstance(inner, dict) and "value" in inner:
+        return inner
+    return doc if "value" in doc else {}
+
+
+def is_complete(rec: Dict[str, Any]) -> bool:
+    """A run carries perf signal only when it finished: positive value and
+    not flagged INCOMPLETE (a tunnel outage's degraded emission)."""
+    try:
+        value = float(rec.get("value") or 0.0)
+    except (TypeError, ValueError):
+        return False
+    return value > 0.0 and "INCOMPLETE" not in str(rec.get("unit", ""))
+
+
+def _counters(rec: Dict[str, Any]) -> Dict[str, float]:
+    tel = rec.get("telemetry")
+    if isinstance(tel, dict) and isinstance(tel.get("counters"), dict):
+        return {k: float(v) for k, v in tel["counters"].items()
+                if isinstance(v, (int, float))}
+    return {}
+
+
+def discover_trajectory(root: str, pattern: str = "BENCH_r*.json") -> List[str]:
+    """BENCH artifacts in round order (numeric suffix sort, not lexical —
+    r2 < r10)."""
+    def round_key(p: str):
+        m = re.search(r"_r(\d+)", os.path.basename(p))
+        return (int(m.group(1)) if m else -1, p)
+
+    return sorted(glob.glob(os.path.join(root, pattern)), key=round_key)
+
+
+def run_gate(
+    current: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    *,
+    min_ratio: float = 0.8,
+    counter_lanes: Optional[List[Tuple[str, float]]] = None,
+) -> Dict[str, Any]:
+    """Compare `current` against the completed runs in `history`. Pure
+    function of its inputs (the CLI wires files in); returns the verdict
+    dict described in the module header."""
+    if counter_lanes is None:
+        counter_lanes = DEFAULT_COUNTER_LANES
+    lanes: List[Dict[str, Any]] = []
+    complete_hist = [r for r in history if is_complete(r)]
+
+    if not is_complete(current):
+        return {
+            "verdict": "no-data",
+            "reason": "newest run is incomplete (infra outage, not a perf signal)",
+            "current_value": current.get("value"),
+            "reference_runs": len(complete_hist),
+            "lanes": [],
+        }
+
+    # -- wall-time lane: throughput geomean, higher is better --------------
+    cur_value = float(current["value"])
+    if complete_hist:
+        ref_value = statistics.median(float(r["value"]) for r in complete_hist)
+        ratio = cur_value / ref_value if ref_value > 0 else float("inf")
+        lanes.append({
+            "lane": "throughput_geomean",
+            "kind": "wall",
+            "direction": "higher-better",
+            "current": cur_value,
+            "reference": ref_value,
+            "ratio": round(ratio, 4),
+            "threshold": min_ratio,
+            "status": "pass" if ratio >= min_ratio else "fail",
+        })
+    else:
+        lanes.append({
+            "lane": "throughput_geomean",
+            "kind": "wall",
+            "current": cur_value,
+            "reference": None,
+            "status": "skipped",
+            "note": "no complete historical run to compare against",
+        })
+
+    # -- counter lanes: work-amount invariants, lower is better ------------
+    # Reference = the NEWEST complete run that embedded a telemetry
+    # snapshot, taken as one coherent set. Never assembled per-key across
+    # runs: a counter that stopped being emitted two rounds ago would then
+    # gate today's run against a stale reference while the wall lane
+    # compares against the current median.
+    cur_counters = _counters(current)
+    ref_counters: Dict[str, float] = {}
+    for r in reversed(complete_hist):
+        if _counters(r):
+            ref_counters = _counters(r)
+            break
+    for name, tolerance in counter_lanes:
+        cur = cur_counters.get(name)
+        ref = ref_counters.get(name)
+        if cur is None or ref is None or ref <= 0:
+            lanes.append({
+                "lane": name, "kind": "counter", "status": "skipped",
+                "current": cur, "reference": ref,
+                "note": "counter absent on one side",
+            })
+            continue
+        ratio = cur / ref
+        lanes.append({
+            "lane": name,
+            "kind": "counter",
+            "direction": "lower-better",
+            "current": cur,
+            "reference": ref,
+            "ratio": round(ratio, 4),
+            "threshold": tolerance,
+            "status": "pass" if ratio <= tolerance else "fail",
+        })
+
+    checked = [ln for ln in lanes if ln["status"] in ("pass", "fail")]
+    failed = [ln for ln in lanes if ln["status"] == "fail"]
+    verdict = "fail" if failed else ("pass" if checked else "no-data")
+    return {
+        "verdict": verdict,
+        "current_value": cur_value,
+        "reference_runs": len(complete_hist),
+        "failed_lanes": [ln["lane"] for ln in failed],
+        "lanes": lanes,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=None,
+                    help="repo root holding BENCH_r*.json (default: this repo)")
+    ap.add_argument("--pattern", default="BENCH_r*.json",
+                    help="glob for trajectory artifacts under --root")
+    ap.add_argument("--current", default=None,
+                    help="explicit newest artifact (default: highest round in the trajectory)")
+    ap.add_argument("--min-ratio", type=float, default=0.8,
+                    help="wall lane: fail when current/reference drops below this")
+    ap.add_argument("--counter-tolerance", type=float, default=None,
+                    help="override every counter lane's tolerance ratio")
+    ap.add_argument("--report-only", action="store_true",
+                    help="always exit 0 (CI report lane); the verdict JSON still says fail")
+    ap.add_argument("--out", default=None, help="also write the verdict JSON here")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = discover_trajectory(root, args.pattern)
+    if args.current:
+        current_path = args.current
+        history_paths = [p for p in paths if os.path.abspath(p) != os.path.abspath(current_path)]
+    elif paths:
+        current_path, history_paths = paths[-1], paths[:-1]
+    else:
+        verdict = {"verdict": "no-data", "reason": f"no artifacts match {args.pattern} under {root}",
+                   "lanes": []}
+        print(json.dumps(verdict, indent=2))
+        return 0
+
+    lanes = DEFAULT_COUNTER_LANES
+    if args.counter_tolerance is not None:
+        lanes = [(name, args.counter_tolerance) for name, _ in lanes]
+    verdict = run_gate(
+        load_bench_record(current_path),
+        [load_bench_record(p) for p in history_paths],
+        min_ratio=args.min_ratio,
+        counter_lanes=lanes,
+    )
+    verdict["current_artifact"] = os.path.basename(current_path)
+    verdict["history_artifacts"] = [os.path.basename(p) for p in history_paths]
+    out = json.dumps(verdict, indent=2)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    if verdict["verdict"] == "fail" and not args.report_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

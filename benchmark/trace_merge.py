@@ -1,0 +1,58 @@
+#
+# Merge per-rank telemetry JSONL into Chrome trace-event JSON.
+#
+#   python -m benchmark.trace_merge /tmp/metrics.jsonl -o /tmp/trace.json
+#   # then open /tmp/trace.json in https://ui.perfetto.dev or chrome://tracing
+#
+# Input is the telemetry sink family (`SRML_METRICS_PATH`): rank 0 owns the
+# base path, rank r writes `<base>.rank<r>`. Output is one track per rank,
+# every span as a complete ("X") event, rendezvous rounds as flow arrows,
+# and per-rank clock skew corrected using barrier rounds as sync points —
+# see spark_rapids_ml_tpu/diagnostics.py (merge_chrome_trace) and
+# docs/observability.md "Trace correlation".
+#
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("metrics", help="telemetry JSONL base path (SRML_METRICS_PATH)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output trace file (default: <metrics>.trace.json)")
+    ap.add_argument("--trace-id", default=None,
+                    help="merge only records of this trace id (default: all)")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip barrier-based clock-skew alignment")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_ml_tpu.diagnostics import chrome_trace_from_files
+
+    trace = chrome_trace_from_files(
+        args.metrics, trace_id=args.trace_id, align_clocks=not args.no_align
+    )
+    out_path = args.out or f"{args.metrics}.trace.json"
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    n_flows = sum(1 for e in trace["traceEvents"] if e.get("ph") == "s")
+    ranks = trace["otherData"]["ranks"]
+    print(
+        f"wrote {out_path}: {n_spans} spans across {len(ranks)} rank track(s), "
+        f"{n_flows} rendezvous flow arrow(s)",
+        file=sys.stderr,
+    )
+    if not n_spans:
+        print(
+            "note: no span records found — was the fit run with "
+            "SRML_METRICS_PATH set?", file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

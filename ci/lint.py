@@ -34,6 +34,20 @@ _BLOCKING_TREE = "spark_rapids_ml_tpu"
 _BLOCKING_EXEMPT_FILES = {"context.py"}
 _BLOCKING_RE = re.compile(r"while\s+True\b|\.wait\(\s*\)")
 
+# Framework JSONL emission goes through the telemetry/diagnostics sinks
+# (telemetry._sink_write, diagnostics.FlightRecorder.dump) — the two owners
+# that tag records with rank + trace ids and keep per-rank files from
+# interleaving. A hand-rolled `f.write(json.dumps(...) + "\n")` elsewhere
+# produces records the trace merge and post-mortem assemblers cannot
+# correlate. Non-JSONL json uses (model save metadata via json.dump,
+# control-plane payloads via bare json.dumps) don't match; a genuinely
+# non-telemetry JSONL writer carries a `# sink-ok` waiver.
+_JSONL_TREE = "spark_rapids_ml_tpu"
+_JSONL_EXEMPT_FILES = {"telemetry.py", "diagnostics.py"}
+_JSONL_RE = re.compile(
+    r"""\.write\(\s*json\.dumps|json\.dumps\([^)]*\)\s*\+\s*(['"])\\n\1"""
+)
+
 # Transform/serving code pads batches through the bucket ladder
 # (parallel/mesh.py bucket_rows), never raw pad_rows: an exact-shape pad
 # mints one compiled `predict` program per distinct tail shape — tens of
@@ -76,6 +90,17 @@ for target in TARGETS:
                     f"{path}:{lineno}: unbounded blocking wait in the framework — "
                     "a dead peer must raise a typed error, not hang; bound it with "
                     "a deadline (see parallel/context.py) or mark `# blocking-ok`"
+                )
+            if (
+                target == _JSONL_TREE
+                and path.name not in _JSONL_EXEMPT_FILES
+                and _JSONL_RE.search(line)
+                and "# sink-ok" not in line
+            ):
+                failures.append(
+                    f"{path}:{lineno}: hand-rolled JSONL emission in the framework — "
+                    "records must flow through the telemetry sink or flight recorder "
+                    "(rank + trace-id tagging, per-rank files) or mark `# sink-ok`"
                 )
             if (
                 target == _PAD_ROWS_TREE

@@ -13,6 +13,9 @@ cd "$(dirname "$0")/.."
 echo "== lint (compile + import checks)"
 python ci/lint.py
 
+echo "== perf regression gate (report-only against the checked-in BENCH trajectory)"
+python -m benchmark.regression --report-only
+
 if [[ "${1:-}" == "--nightly" ]]; then
   echo "== nightly: full suite incl. large-scale slow tests"
   python -m pytest tests/ -q --runslow

@@ -86,6 +86,11 @@ config: Dict[str, Any] = {
     # evicted beyond this, so a scope wrapped around a loop over FRESH
     # dataset objects cannot stack placements until HBM OOMs
     "device_dataset_cache_entries": 2,
+    # --- distributed diagnostics (docs/observability.md) -----------------
+    # directory for flight-recorder dumps (`flightrec_rank_<r>.jsonl`) on
+    # SrmlError / abort publication; seeded from SRML_FLIGHTREC_DIR. None ->
+    # exception tails still attach, but no dump files are written.
+    "flightrec_dir": os.environ.get("SRML_FLIGHTREC_DIR") or None,
 }
 
 def evaluator_label_column(params_obj: Any, evaluator: Any) -> str:
@@ -245,7 +250,7 @@ def retryable_stage(
     telemetry counter, which lands in ``model._fit_metrics`` and the bench
     snapshot. The chaos hook (`parallel.chaos.maybe_fail_stage`) runs at the
     top of every attempt so fault plans can inject the transient path."""
-    from . import telemetry
+    from . import diagnostics, telemetry
     from .errors import is_transient
     from .parallel import chaos
 
@@ -263,6 +268,10 @@ def retryable_stage(
             if not is_transient(e) or attempt >= max_retries:
                 raise
             telemetry.registry().inc("fit.retries")
+            diagnostics.record_event(
+                "retry", stage=stage, attempt=attempt + 1,
+                error=type(e).__name__,
+            )
             sleep_s = backoff_s * (2 ** attempt)
             logger.warning(
                 "stage %s attempt %d/%d failed transiently (%s: %s); "
@@ -631,10 +640,18 @@ class _TpuCaller(_TpuCommon):
             import jax
 
             profile_cm = jax.profiler.trace(profile_dir)
+        from . import diagnostics
         from .parallel import TpuContext
 
         active = TpuContext.current()
-        with profile_cm, telemetry.fit_scope(
+        # trace identity OUTERMOST: every span/metric/flight-recorder record
+        # of this fit (including the fit_scope snapshot) carries the same
+        # trace_id + fit_id on every rank — under SPMD, rank 0 mints the id
+        # and propagates it through one rendezvous round (docs/observability.md
+        # "Trace correlation")
+        with diagnostics.trace_scope(
+            type(self).__name__, active
+        ), profile_cm, telemetry.fit_scope(
             type(self).__name__
         ) as tele_scope, telemetry.span(
             "fit", logger=stage_logger, estimator=type(self).__name__

@@ -39,7 +39,27 @@ __all__ = [
 
 
 class SrmlError(Exception):
-    """Base class for every framework-raised error."""
+    """Base class for every framework-raised error.
+
+    Construction notifies the flight recorder (diagnostics.on_srml_error):
+    the error lands in the ring, the last-K ring events are attached as
+    ``self.flightrec_tail``, and — when a dump dir is configured — the whole
+    ring is dumped to ``flightrec_rank_<r>.jsonl`` for post-mortem assembly.
+    Subclasses must therefore set their diagnostic attributes (failed_rank,
+    round_index, ...) BEFORE calling ``super().__init__`` so the recorded
+    event carries them. The hook never raises: diagnostics failures must not
+    mask the error being constructed."""
+
+    flightrec_tail: Any = None
+
+    def __init__(self, *args: Any) -> None:
+        super().__init__(*args)
+        try:
+            from . import diagnostics
+
+            diagnostics.on_srml_error(self)
+        except Exception:  # pragma: no cover - never mask the real error
+            pass
 
 
 class RendezvousTimeoutError(SrmlError, TimeoutError):
@@ -58,10 +78,12 @@ class RendezvousTimeoutError(SrmlError, TimeoutError):
         missing_ranks: Optional[Sequence[int]] = None,
         timeout_s: Optional[float] = None,
     ):
-        super().__init__(message)
+        # attributes BEFORE super().__init__: the flight-recorder hook fires
+        # inside it and records whatever diagnostic fields are already set
         self.round_index = round_index
         self.missing_ranks = list(missing_ranks) if missing_ranks is not None else None
         self.timeout_s = timeout_s
+        super().__init__(message)
 
 
 class RankFailedError(SrmlError, RuntimeError):

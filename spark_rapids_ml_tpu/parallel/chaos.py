@@ -141,9 +141,14 @@ def maybe_fail_stage(stage: str, attempt: int) -> None:
     """Hook consulted by `core.retryable_stage` at the start of every attempt:
     a matching un-spent `fail` fault raises a transient RendezvousTimeoutError
     (the retryable class), consuming one firing."""
+    from .. import diagnostics
+
     for f in active_plan():
         if f.kind == "fail" and f.stage == stage and not f.spent():
             f.fired += 1
+            diagnostics.record_event(
+                "chaos_injection", fault="fail", stage=stage, attempt=attempt
+            )
             raise RendezvousTimeoutError(
                 f"chaos: injected transient failure at stage {stage!r} attempt {attempt}",
                 timeout_s=0.0,
@@ -163,8 +168,11 @@ class ChaosRendezvous(Rendezvous):
         self.nranks = inner.nranks
         self.plan = plan if plan is not None else active_plan()
         self._round = 0
+        self._epoch = 0  # mirrors inner: base allgather tags records with it
 
     def _apply_faults(self, round_index: int) -> None:
+        from .. import diagnostics
+
         for f in self.plan:
             if (
                 f.kind == "fail"
@@ -174,6 +182,15 @@ class ChaosRendezvous(Rendezvous):
             ):
                 continue
             f.fired += 1
+            # the injection itself is flight-recorder evidence: a post-mortem
+            # of a chaos run shows WHERE the fault plan fired, not just its
+            # downstream symptoms (for `kill` this event only survives in
+            # SURVIVOR dumps if it was gossiped — the victim's ring dies with
+            # it, which is exactly the hard-death shape being simulated)
+            diagnostics.record_event(
+                "chaos_injection", fault=f.kind, round=round_index,
+                seconds=f.seconds if f.kind == "delay" else None,
+            )
             if f.kind == "delay":
                 time.sleep(f.seconds)
             elif f.kind == "abort":
@@ -206,6 +223,7 @@ class ChaosRendezvous(Rendezvous):
     def begin_epoch(self, epoch: int) -> None:
         self.inner.begin_epoch(epoch)
         self._round = 0
+        self._epoch = int(epoch)
 
     def close(self) -> None:
         self.inner.close()

@@ -169,15 +169,37 @@ class Rendezvous:
     nranks: int
 
     def allgather(self, payload: str) -> List[str]:
-        from .. import telemetry
+        from .. import diagnostics, telemetry
 
-        if not telemetry.enabled():
-            return self._allgather_impl(payload)
-        with telemetry.span("rendezvous.allgather", nranks=self.nranks):
-            out = self._allgather_impl(payload)
-        reg = telemetry.registry()
-        reg.inc("rendezvous.rounds")
-        reg.inc("rendezvous.payload_bytes", len(payload))
+        # round index + epoch are best-effort (in-tree impls track `_round`/
+        # `_epoch`; a custom subclass without them still records, just
+        # untagged) — they are what the flight recorder / trace merge
+        # correlate lockstep rounds by. Epoch matters: `begin_epoch` resets
+        # the round counter, so (epoch, round) is unique where round alone
+        # collides across retry attempts.
+        round_index = getattr(self, "_round", None)
+        epoch = getattr(self, "_epoch", None)
+        diagnostics.record_event(
+            "rdv_enter", round=round_index, epoch=epoch, nranks=self.nranks
+        )
+        try:
+            if not telemetry.enabled():
+                out = self._allgather_impl(payload)
+            else:
+                with telemetry.span(
+                    "rendezvous.allgather",
+                    nranks=self.nranks, round=round_index, epoch=epoch,
+                ):
+                    out = self._allgather_impl(payload)
+                reg = telemetry.registry()
+                reg.inc("rendezvous.rounds")
+                reg.inc("rendezvous.payload_bytes", len(payload))
+        except BaseException as e:
+            diagnostics.record_event(
+                "rdv_fail", round=round_index, error=type(e).__name__
+            )
+            raise
+        diagnostics.record_event("rdv_exit", round=round_index)
         return out
 
     def _allgather_impl(self, payload: str) -> List[str]:
@@ -284,7 +306,7 @@ class LocalRendezvous(Rendezvous):
         """Publish ``ABORT:<rank>:<reason>`` (extra slot write) and break the
         barrier so every peer blocked in `barrier.wait` wakes immediately
         with a typed RankFailedError instead of its raw BrokenBarrierError."""
-        from .. import telemetry
+        from .. import diagnostics, telemetry
 
         shared = self._shared
         with shared.lock:
@@ -292,6 +314,8 @@ class LocalRendezvous(Rendezvous):
                 shared.abort_info = (self.rank, str(reason))
                 shared.slots[self.rank] = format_abort(self.rank, reason)
         telemetry.registry().inc("rendezvous.aborts_published")
+        diagnostics.record_event("abort_published", reason=str(reason)[:200])
+        diagnostics.flight_recorder().dump(reason="abort published")
         shared.barrier.abort()
 
     def begin_epoch(self, epoch: int) -> None:
@@ -319,6 +343,9 @@ class LocalRendezvous(Rendezvous):
             shared.barrier.reset()
         self._round = 0
         self._epoch = int(epoch)
+        from .. import diagnostics
+
+        diagnostics.record_event("epoch_begin", epoch=int(epoch))
 
     def _wait(self, round_index: int, timeout_s: float) -> None:
         """`barrier.wait` bounded by the round deadline; BrokenBarrierError
@@ -509,7 +536,7 @@ class FileRendezvous(Rendezvous):
         """Publish ``abort_rank_<rank>`` (write-then-rename, atomic appearance)
         carrying the ABORT sentinel; survivors' poll loops see it within one
         poll tick and raise RankFailedError."""
-        from .. import telemetry
+        from .. import diagnostics, telemetry
 
         tmp = os.path.join(self.root, f".abort_rank_{self.rank}.tmp")
         try:
@@ -519,10 +546,15 @@ class FileRendezvous(Rendezvous):
         except OSError:  # pragma: no cover - abort is best-effort by design
             return
         telemetry.registry().inc("rendezvous.aborts_published")
+        diagnostics.record_event("abort_published", reason=str(reason)[:200])
+        diagnostics.flight_recorder().dump(reason="abort published")
 
     def begin_epoch(self, epoch: int) -> None:
         self._epoch = int(epoch)
         self._round = 0
+        from .. import diagnostics
+
+        diagnostics.record_event("epoch_begin", epoch=int(epoch))
 
     def _check_failures(self, pending, round_index: int) -> None:
         """Raise RankFailedError when any rank published an abort for this

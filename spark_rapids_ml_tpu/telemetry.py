@@ -78,6 +78,20 @@ class _State:
 _STATE = _State()
 _LOCAL = threading.local()  # per-thread span stack (nesting -> paths)
 
+# Cached handle to the diagnostics module (trace tags + flight recorder).
+# Lazy: diagnostics never imports telemetry at module level and vice versa,
+# so whichever loads first wins without a cycle.
+_DIAG: Any = None
+
+
+def _diag():
+    global _DIAG
+    if _DIAG is None:
+        from . import diagnostics
+
+        _DIAG = diagnostics
+    return _DIAG
+
 
 def enabled() -> bool:
     """Whether telemetry recording is on (one branch — THE hot-path check)."""
@@ -111,17 +125,12 @@ def disable() -> None:
 
 
 def _rank() -> int:
-    """This process's rank for record tagging. Control-plane only — never
-    touches the XLA backend (jax.process_index() would initialize it)."""
-    try:
-        from .parallel.context import TpuContext
-
-        ctx = TpuContext.current()
-        if ctx is not None:
-            return ctx.rank
-    except Exception:  # pragma: no cover - import cycles during teardown
-        pass
-    return 0
+    """This process's rank for record tagging and per-rank sink naming.
+    Delegates to diagnostics (active TpuContext > set_process_rank >
+    SRML_RANK env > 0) so telemetry records and flight-recorder dumps agree
+    on rank identity. Control-plane only — never touches the XLA backend
+    (jax.process_index() would initialize it)."""
+    return _diag()._rank()
 
 
 # ---------------------------------------------------------------- registry --
@@ -176,11 +185,22 @@ class MetricsRegistry:
             h["min"] = min(h["min"], value)
             h["max"] = max(h["max"], value)
 
-    def record_span(self, name: str, path: str, wall_s: float, attrs: Dict[str, Any]) -> None:
+    def record_span(
+        self,
+        name: str,
+        path: str,
+        wall_s: float,
+        attrs: Dict[str, Any],
+        t0: Optional[float] = None,
+    ) -> None:
         if not _STATE.on:
             return
         rec = {"kind": "span", "name": name, "path": path, "wall_s": wall_s,
-               "rank": _rank(), **attrs}
+               "rank": _rank(), **_diag().trace_tags(), **attrs}
+        if t0 is not None:
+            # wall-clock start: what lets trace_merge place this span on a
+            # cross-rank timeline (perf_counter has no cross-process meaning)
+            rec["t0"] = t0
         with self._lock:
             self._spans.append(rec)
             self._spans_total += 1
@@ -225,7 +245,7 @@ class MetricsRegistry:
                         "min_s": h["min"],
                         "max_s": h["max"],
                     }
-            return {
+            snap = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "histograms": {k: dict(v) for k, v in self._hists.items()},
@@ -235,6 +255,11 @@ class MetricsRegistry:
                     for k, v in self._convergence.items()
                 },
             }
+        # flight-recorder health rides the snapshot (and therefore the bench
+        # JSON "telemetry" embedding) — outside the lock: the recorder has its
+        # own and never calls back into the registry while holding it
+        snap["flightrec"] = _diag().flight_recorder().stats()
+        return snap
 
     class _Mark:
         __slots__ = ("counters", "hists", "spans_total")
@@ -300,7 +325,9 @@ def snapshot() -> Dict[str, Any]:
 
 def summary() -> str:
     """One-line-per-stage human summary of the current registry state:
-    ``print(telemetry.summary())`` after any fit."""
+    ``print(telemetry.summary())`` after any fit. Ends with a flight-recorder
+    health line (events recorded/dropped for this rank) — ring truncation is
+    never silent (docs/observability.md "no silent caps")."""
     snap = _REGISTRY.snapshot()
     lines = []
     for path, agg in sorted(snap["spans"].items()):
@@ -311,7 +338,15 @@ def summary() -> str:
         lines.append(f"{name}: {v:,.0f}")
     for name, v in sorted(snap["gauges"].items()):
         lines.append(f"{name}: {v:,.6g}")
-    return "\n".join(lines) if lines else "telemetry: no records"
+    fr = snap["flightrec"]  # snapshot() already embeds the recorder stats
+    if fr["enabled"]:
+        lines.append(
+            f"flightrec rank{_rank()}: {fr['recorded']} events recorded / "
+            f"{fr['dropped']} dropped (capacity {fr['capacity']})"
+        )
+    else:
+        lines.append("flightrec: disabled (SRML_FLIGHTREC=0)")
+    return "\n".join(lines)
 
 
 # ------------------------------------------------------------------- sinks --
@@ -396,7 +431,7 @@ _NOOP_SPAN = _NoopSpan()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "logger", "path", "wall_s", "_t0", "_ta")
+    __slots__ = ("name", "attrs", "logger", "path", "wall_s", "_t0", "_w0", "_ta")
 
     def __init__(self, name: str, logger: Any, attrs: Dict[str, Any]) -> None:
         self.name = name
@@ -422,6 +457,8 @@ class _Span:
             self._ta.__enter__()
         except Exception:
             self._ta = None
+        self._w0 = time.time()  # wall clock, for cross-rank trace merging
+        _diag().record_event("span_begin", name=self.name, path=self.path)
         self._t0 = time.perf_counter()
         return self
 
@@ -436,9 +473,15 @@ class _Span:
         if stack and stack[-1] == self.name:
             stack.pop()
         if exc_type is None:
-            _REGISTRY.record_span(self.name, self.path, self.wall_s, self.attrs)
+            _diag().record_event("span_end", name=self.name, path=self.path,
+                                 wall_s=self.wall_s)
+            _REGISTRY.record_span(self.name, self.path, self.wall_s, self.attrs,
+                                  t0=self._w0)
             if self.logger is not None:
                 self.logger.info("stage %s: %.3fs", self.path, self.wall_s)
+        else:
+            _diag().record_event("span_fail", name=self.name, path=self.path,
+                                 error=exc_type.__name__)
         return False
 
 
@@ -507,6 +550,10 @@ def record_solver_result(
     if objective is not None:
         _REGISTRY.gauge(f"{solver}.objective", float(objective))
         _REGISTRY.record_convergence(solver, int(n_iter), float(objective))
+    _diag().record_event(
+        "solver_result", solver=solver, n_iter=int(n_iter),
+        objective=float(objective) if objective is not None else None,
+    )
 
 
 def record_convergence_point(solver: str, iteration: Any, value: Any) -> None:
@@ -517,9 +564,9 @@ def record_convergence_point(solver: str, iteration: Any, value: Any) -> None:
         return
     import numpy as np
 
-    _REGISTRY.record_convergence(
-        solver, int(np.asarray(iteration)), float(np.asarray(value))
-    )
+    it, val = int(np.asarray(iteration)), float(np.asarray(value))
+    _REGISTRY.record_convergence(solver, it, val)
+    _diag().record_event("solver_tick", solver=solver, iteration=it, value=val)
 
 
 # --------------------------------------------------------------- fit scope --
@@ -547,6 +594,7 @@ def fit_scope(label: str):
                 "kind": "fit",
                 "estimator": label,
                 "rank": _rank(),
+                **_diag().trace_tags(),
                 "counters": delta["counters"],
                 "gauges": delta["gauges"],
                 "histograms": delta["histograms"],

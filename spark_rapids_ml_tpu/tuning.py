@@ -155,6 +155,19 @@ class CrossValidator(_ValidatorParams):
         return out
 
     def fit(self, dataset: Any) -> "CrossValidatorModel":
+        # one trace for the WHOLE cross-validation: every fold fit, held-out
+        # scoring transform, and the best-model refit share this trace_id
+        # (inner fit scopes adopt it, each with its own fit_id), so the
+        # per-rank JSONL merges into ONE Perfetto timeline. The active
+        # TpuContext is passed so an SPMD cv.fit (all ranks enter in
+        # lockstep) propagates rank 0's id instead of minting per rank.
+        from . import diagnostics
+        from .parallel import TpuContext
+
+        with diagnostics.trace_scope(type(self).__name__, TpuContext.current()):
+            return self._fit_traced(dataset)
+
+    def _fit_traced(self, dataset: Any) -> "CrossValidatorModel":
         from .data import as_pandas
 
         est = self.getEstimator()
@@ -386,6 +399,14 @@ class TrainValidationSplit(_ValidatorParams):
         return self.getOrDefault("trainRatio")
 
     def fit(self, dataset: Any) -> "TrainValidationSplitModel":
+        # one trace per sweep (see CrossValidator.fit)
+        from . import diagnostics
+        from .parallel import TpuContext
+
+        with diagnostics.trace_scope(type(self).__name__, TpuContext.current()):
+            return self._fit_traced(dataset)
+
+    def _fit_traced(self, dataset: Any) -> "TrainValidationSplitModel":
         from .data import as_pandas
 
         est = self.getEstimator()

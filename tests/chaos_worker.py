@@ -34,10 +34,15 @@ def main() -> None:
     heartbeat_interval_s = float(sys.argv[7])
     timeout_s = float(sys.argv[8])
 
+    from spark_rapids_ml_tpu import diagnostics
     from spark_rapids_ml_tpu.errors import RankFailedError, RendezvousTimeoutError
     from spark_rapids_ml_tpu.parallel.chaos import ChaosRendezvous
     from spark_rapids_ml_tpu.parallel.context import FileRendezvous
 
+    # no TpuContext in this harness: pin the rank so flight-recorder events
+    # and dumps (flightrec_rank_<r>.jsonl, written on the typed errors below
+    # when SRML_FLIGHTREC_DIR is set) are attributed per rank, not all rank 0
+    diagnostics.set_process_rank(rank)
     rdv = ChaosRendezvous(
         FileRendezvous(
             rank,
