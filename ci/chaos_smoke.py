@@ -1,0 +1,97 @@
+#
+# Chaos smoke lane (ci/test.sh): one tiny kill+recover fit, end to end.
+#
+# Launches a 3-process FileRendezvous `recover`-mode fit (tests/chaos_worker.py
+# — a distributed Lloyd loop under core.recoverable_stage with solver
+# checkpoints on), SIGKILLs rank 2 mid-solve via SRML_FAULT_PLAN, and asserts
+# the elastic-recovery contract held: survivors reform to a 2-rank group,
+# resume from the checkpoint, finish clean, and the assembled post-mortem
+# NAMES the killed rank and the recovery epoch. The full parametrized sweep
+# lives in tests/test_chaos.py; this is the pre-merge canary.
+#
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import uuid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "chaos_worker.py")
+
+NRANKS = 3
+ITERS = 6
+# round 8 = iteration 3 of the worker's 2-rounds-per-iteration traffic —
+# after the iteration-2 checkpoint landed, so survivors must RESUME
+PLAN = "kill:rank=2:round=8"
+
+
+def fail(msg: str) -> None:
+    print(f"chaos smoke: FAIL — {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    from spark_rapids_ml_tpu import diagnostics
+
+    tmp = tempfile.mkdtemp(prefix="srml_chaos_smoke_")
+    flightrec = os.path.join(tmp, "flightrec")
+    out_dir = os.path.join(tmp, "out")
+    os.makedirs(out_dir, exist_ok=True)
+    run_id = uuid.uuid4().hex
+    trace_id = f"smoke-{run_id[:8]}"
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SRML_FAULT_PLAN"] = PLAN
+    env["SRML_FLIGHTREC_DIR"] = flightrec
+    env["SRML_TRACE_ID"] = trace_id
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, WORKER, str(r), str(NRANKS),
+                os.path.join(tmp, "rdv"), out_dir, run_id,
+                str(ITERS), "2.0", "45.0", "recover",
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(NRANKS)
+    ]
+    outputs = [p.communicate(timeout=180)[0].decode() for p in procs]
+
+    if procs[2].returncode != -signal.SIGKILL:
+        fail(f"victim rank 2 exited {procs[2].returncode}, expected SIGKILL")
+    for r in (0, 1):
+        if procs[r].returncode != 0:
+            fail(f"survivor rank {r} exited {procs[r].returncode}:\n{outputs[r]}")
+        with open(os.path.join(out_dir, f"result_rank{r}.json")) as f:
+            res = json.load(f)
+        if res["error"] is not None:
+            fail(f"survivor rank {r} raised {res['error']}: {res.get('detail')}")
+        if res["live_final"] != [0, 1]:
+            fail(f"survivor rank {r} finished on {res['live_final']}, expected [0, 1]")
+        c = res["counters"]
+        if c.get("fit.recoveries") != 1:
+            fail(f"rank {r} fit.recoveries == {c.get('fit.recoveries')}, expected 1")
+        if not c.get("checkpoint.restores"):
+            fail(f"rank {r} resumed from scratch (no checkpoint.restores)")
+
+    pm = diagnostics.assemble_postmortem(flightrec, nranks=NRANKS, trace_id=trace_id)
+    if pm.get("failed_rank") != 2:
+        fail(f"post-mortem blamed rank {pm.get('failed_rank')}, expected 2")
+    epochs = pm.get("recovery_epochs") or []
+    if not any(e.get("survivors") == [0, 1] for e in epochs):
+        fail(f"post-mortem shows no [0, 1]-survivor recovery epoch: {epochs}")
+    print(
+        "chaos smoke: OK — rank 2 SIGKILLed, survivors resumed from "
+        f"checkpoint, post-mortem names rank 2 and epoch g{epochs[0]['generation']}"
+    )
+
+
+if __name__ == "__main__":
+    main()

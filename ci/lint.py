@@ -48,6 +48,17 @@ _JSONL_RE = re.compile(
     r"""\.write\(\s*json\.dumps|json\.dumps\([^)]*\)\s*\+\s*(['"])\\n\1"""
 )
 
+# Bare `time.sleep` in the framework is either a poll loop that should be
+# event/deadline-driven or an ad-hoc delay that stretches failure detection
+# past its documented budget. Sleeping is legal only for the retry/backoff,
+# heartbeat-pacing, and rendezvous-poll owners (core.retryable_stage's capped
+# backoff, parallel/context.py's poll ticks + heartbeat Event.wait,
+# parallel/chaos.py's injected delays) — every such line carries `# sleep-ok`
+# naming its bound, as must any future waiver.
+_SLEEP_TREE = "spark_rapids_ml_tpu"
+_SLEEP_EXEMPT_FILES: set = set()
+_SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
+
 # Transform/serving code pads batches through the bucket ladder
 # (parallel/mesh.py bucket_rows), never raw pad_rows: an exact-shape pad
 # mints one compiled `predict` program per distinct tail shape — tens of
@@ -101,6 +112,17 @@ for target in TARGETS:
                     f"{path}:{lineno}: hand-rolled JSONL emission in the framework — "
                     "records must flow through the telemetry sink or flight recorder "
                     "(rank + trace-id tagging, per-rank files) or mark `# sink-ok`"
+                )
+            if (
+                target == _SLEEP_TREE
+                and path.name not in _SLEEP_EXEMPT_FILES
+                and _SLEEP_RE.search(line)
+                and "# sleep-ok" not in line
+            ):
+                failures.append(
+                    f"{path}:{lineno}: bare time.sleep in the framework — "
+                    "sleeping belongs to the retry-backoff/heartbeat/poll "
+                    "owners; bound it and mark `# sleep-ok: <why>`"
                 )
             if (
                 target == _PAD_ROWS_TREE

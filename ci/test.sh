@@ -16,6 +16,9 @@ python ci/lint.py
 echo "== perf regression gate (report-only against the checked-in BENCH trajectory)"
 python -m benchmark.regression --report-only
 
+echo "== chaos smoke (kill one rank mid-solve; survivors must recover + post-mortem must name it)"
+python ci/chaos_smoke.py
+
 if [[ "${1:-}" == "--nightly" ]]; then
   echo "== nightly: full suite incl. large-scale slow tests"
   python -m pytest tests/ -q --runslow

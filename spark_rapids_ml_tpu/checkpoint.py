@@ -1,0 +1,307 @@
+#
+# Solver checkpoints: collective-consistent, periodically host-fetched solver
+# state, so a fit interrupted by a transient fault (or a rank loss) resumes
+# from the last checkpoint instead of from scratch (docs/robustness.md
+# "Elastic recovery").
+#
+# Design:
+#   * A `CheckpointStore` lives for the dynamic extent of ONE recoverable fit
+#     stage (`core.recoverable_stage` / `core.retryable_stage` install it via
+#     `ensure_scope`). Attempts within the stage — bounded transient retries
+#     AND recovery epochs after a rank loss — share the store; the stage's
+#     exit clears it, so checkpoints never leak across fits.
+#   * Checkpoints are HOST-fetched numpy state (that is the point: device
+#     state dies with the mesh; host copies survive a re-mesh). Each carries
+#     a `placement_key` naming the mesh/shape it was taken on:
+#       - same placement  -> EXACT resume (bit-identical to an uninterrupted
+#         fit — the state round-trips device -> host -> device losslessly);
+#       - different placement (degraded survivor mesh) -> the solver falls
+#         back to its PORTABLE subset (k-means centers, the GLM iterate,
+#         sufficient statistics), deterministic given the survivor set.
+#   * Cadence is `config["checkpoint_every_iters"]` (0 disables — the
+#     default: no host fetch is ever added to an un-checkpointed fit).
+#
+# The k-means host loop checkpoints its centers (the shift scalar is fetched
+# each iteration anyway, so the cadence fetch is near-free); the GLM / OWL-QN
+# solvers segment their one big `lax.while_loop` into host segments of
+# `checkpoint_every_iters` inner iterations via `run_segmented_while`; the
+# linear/PCA family retains its one-pass sufficient statistics through
+# `CheckpointStore.get_or_compute`.
+#
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "SolverCheckpoint",
+    "CheckpointStore",
+    "checkpoint_scope",
+    "ensure_scope",
+    "active_store",
+    "every_iters",
+    "solver_checkpoints_active",
+    "placement_key_of",
+    "run_segmented_while",
+]
+
+
+@dataclass
+class SolverCheckpoint:
+    """One host-fetched solver snapshot.
+
+    `state` maps names to host numpy arrays / scalars. `placement_key`
+    identifies the mesh + data layout the snapshot was taken on (exact-resume
+    eligibility); `portable` optionally carries the mesh-independent subset
+    a degraded-mesh resume may warm-start from."""
+
+    solver: str
+    iteration: int
+    state: Dict[str, Any]
+    placement_key: Optional[tuple] = None
+    portable: Dict[str, Any] = field(default_factory=dict)
+    wall_t: float = field(default_factory=time.time)
+
+
+class CheckpointStore:
+    """Keyed checkpoint container for one recoverable fit stage.
+
+    Thread-safe (fold fits may run on pool threads inside one scope). Saves
+    and restores are counted through the telemetry registry
+    (``checkpoint.saves`` / ``checkpoint.restores`` /
+    ``checkpoint.stats_reuses``) so the elastic-recovery acceptance tests can
+    assert resume-from-checkpoint rather than re-solve-from-scratch."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, SolverCheckpoint] = {}
+        self._lock = threading.Lock()
+
+    def save(self, key: str, ckpt: SolverCheckpoint) -> None:
+        from . import diagnostics, telemetry
+
+        with self._lock:
+            self._entries[key] = ckpt
+        telemetry.registry().inc("checkpoint.saves")
+        diagnostics.record_event(
+            "checkpoint_saved", solver=ckpt.solver, iteration=ckpt.iteration, key=key
+        )
+
+    def load(self, key: str) -> Optional[SolverCheckpoint]:
+        with self._lock:
+            ckpt = self._entries.get(key)
+        if ckpt is not None:
+            from . import diagnostics, telemetry
+
+            telemetry.registry().inc("checkpoint.restores")
+            diagnostics.record_event(
+                "checkpoint_restored", solver=ckpt.solver, iteration=ckpt.iteration,
+                key=key,
+            )
+        return ckpt
+
+    def peek(self, key: str) -> Optional[SolverCheckpoint]:
+        """`load` without counting a restore (cadence bookkeeping)."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def get_or_compute(self, key: str, fn: Callable[[], Dict[str, Any]],
+                       *, solver: str, placement_key: Optional[tuple] = None) -> Dict[str, Any]:
+        """Host-retained sufficient statistics: return the stored state when
+        the key AND placement match (a transient retry / same-mesh re-solve
+        skips the data pass entirely), else compute, retain, and return."""
+        from . import telemetry
+
+        with self._lock:
+            ckpt = self._entries.get(key)
+        if ckpt is not None and ckpt.placement_key == placement_key:
+            telemetry.registry().inc("checkpoint.stats_reuses")
+            return ckpt.state
+        state = fn()
+        self.save(key, SolverCheckpoint(
+            solver=solver, iteration=0, state=state, placement_key=placement_key
+        ))
+        return state
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# Context-local (same isolation argument as core's DeviceDataset scope):
+# concurrent fits on different threads must not share checkpoint state.
+_STORE: "contextvars.ContextVar[Optional[CheckpointStore]]" = contextvars.ContextVar(
+    "srml_checkpoint_store", default=None
+)
+
+
+def active_store() -> Optional[CheckpointStore]:
+    """The store installed by the enclosing recoverable/retryable stage, or
+    None (solvers then skip all checkpoint work)."""
+    return _STORE.get()
+
+
+@contextlib.contextmanager
+def checkpoint_scope(store: Optional[CheckpointStore] = None):
+    """Install a fresh (or given) CheckpointStore for the dynamic extent;
+    clears it on exit (checkpoints are per-stage, never cross-fit)."""
+    own = store is None
+    scope = CheckpointStore() if own else store
+    token = _STORE.set(scope)
+    try:
+        yield scope
+    finally:
+        _STORE.reset(token)
+        if own:
+            scope.clear()
+
+
+@contextlib.contextmanager
+def ensure_scope():
+    """`checkpoint_scope` that ADOPTS an already-active store (the outer
+    recoverable stage owns clearing) instead of shadowing it — so
+    `recoverable_stage`'s store survives the nested `retryable_stage`."""
+    existing = _STORE.get()
+    if existing is not None:
+        yield existing
+        return
+    with checkpoint_scope() as scope:
+        yield scope
+
+
+def every_iters() -> int:
+    """``config["checkpoint_every_iters"]``: solver-checkpoint cadence in
+    inner iterations; 0 disables (the default)."""
+    from .core import config
+
+    try:
+        return max(0, int(config.get("checkpoint_every_iters", 0)))
+    except (TypeError, ValueError):
+        return 0
+
+
+def solver_checkpoints_active() -> bool:
+    """Whether solvers should checkpoint: a cadence is configured AND a
+    store is installed by the enclosing stage."""
+    return every_iters() > 0 and _STORE.get() is not None
+
+
+def placement_key_of(inputs: Any) -> tuple:
+    """Placement identity of a `core.FitInputs`: (mesh device ids, global
+    valid rows, columns, dtype). Checkpoints taken under one placement
+    exact-resume only under an EQUAL key; a reformed survivor mesh changes
+    the device set, so stale full-state snapshots are rejected and the
+    solver falls back to its portable subset."""
+    mesh = getattr(inputs, "mesh", None)
+    devs = (
+        tuple(int(d.id) for d in mesh.devices.flatten()) if mesh is not None else ()
+    )
+    return (
+        devs,
+        int(getattr(inputs, "n_valid", 0)),
+        int(getattr(inputs, "n_cols", 0)),
+        str(getattr(inputs, "dtype", "")),
+    )
+
+
+# ------------------------------------------------------------------------
+# Segmented while_loop driver: the GLM / OWL-QN checkpointing substrate.
+# ------------------------------------------------------------------------
+
+
+def run_segmented_while(
+    cond: Callable,
+    body: Callable,
+    state0: Any,
+    *,
+    it_of: Callable[[Any], Any],
+    every: int,
+    store: Optional[CheckpointStore],
+    key: str,
+    solver: str,
+    placement_key: Optional[tuple] = None,
+    max_iter: int,
+    portable_of: Optional[Callable[[Any], Dict[str, Any]]] = None,
+) -> Any:
+    """Run ``while cond(state): state = body(state)`` as HOST segments of
+    ``every`` inner iterations, checkpointing the full state at each segment
+    boundary.
+
+    The segment itself is one jitted ``lax.while_loop`` whose condition is
+    ``cond(state) AND it < seg_end`` — inside a segment nothing changes
+    versus the monolithic loop, and the boundary fetch round-trips the state
+    through host numpy losslessly, so a resume ON THE SAME MESH is
+    bit-identical to an uninterrupted (checkpointed) run. On restore, every
+    leaf's shape/dtype is validated against `state0`; any mismatch (a
+    degraded mesh changed the data-dependent leaves) discards the snapshot —
+    callers wanting a portable warm start rebuild `state0` from the
+    checkpoint's `portable` payload first.
+
+    `it_of(state)` extracts the iteration counter (used for the segment
+    bound and the checkpoint's `iteration` field). `portable_of(state)`
+    optionally extracts the mesh-independent subset stored alongside the
+    full leaves — what a degraded-mesh resume warm-starts from."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    leaves0, treedef = jax.tree_util.tree_flatten(state0)
+    state = state0
+    if store is not None:
+        ckpt = store.peek(key)
+        if ckpt is not None and ckpt.placement_key == placement_key:
+            saved = ckpt.state.get("leaves")
+            if (
+                isinstance(saved, list)
+                and len(saved) == len(leaves0)
+                and all(
+                    tuple(np.shape(s)) == tuple(np.shape(t))
+                    for s, t in zip(saved, leaves0)
+                )
+            ):
+                state = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [
+                        jnp.asarray(s, dtype=jnp.asarray(t).dtype)
+                        for s, t in zip(saved, leaves0)
+                    ],
+                )
+                store.load(key)  # count the restore + flight-recorder event
+
+    cond_j = jax.jit(cond)
+
+    def _segment(st, seg_end):
+        return jax.lax.while_loop(
+            lambda s: jnp.logical_and(cond(s), it_of(s) < seg_end), body, st
+        )
+
+    seg_j = jax.jit(_segment)
+    from .parallel import chaos
+
+    while bool(cond_j(state)):
+        it_now = int(np.asarray(it_of(state)))
+        seg_end = min(it_now + max(1, every), max_iter)
+        state = seg_j(state, jnp.asarray(seg_end, jnp.int32))
+        if store is not None:
+            leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(state)]
+            it_after = int(np.asarray(it_of(state)))
+            store.save(key, SolverCheckpoint(
+                solver=solver, iteration=it_after,
+                state={"leaves": leaves}, placement_key=placement_key,
+                portable=portable_of(state) if portable_of is not None else {},
+            ))
+            # mid-solve fault injection point: a `fail:stage=solve` plan
+            # entry interrupts AFTER this boundary's checkpoint landed, so
+            # the bounded retry exercises the real resume-from-checkpoint
+            # path instead of restarting the whole loop
+            chaos.maybe_fail_stage("solve", it_after)
+        if seg_end >= max_iter:
+            break
+    return state

@@ -65,9 +65,29 @@ config: Dict[str, Any] = {
     "teardown_timeout_s": 15.0,
     # retryable_stage policy: transient failures (rendezvous timeout,
     # distributed-init race — errors.is_transient) are retried up to this
-    # many times with exponential backoff from this base
+    # many times with exponential backoff from this base, capped at
+    # fit_retry_backoff_max_s (uncapped base * 2^N sleeps for minutes before
+    # the final attempt of a high fit_max_retries budget)
     "fit_max_retries": 2,
     "fit_retry_backoff_s": 0.5,
+    "fit_retry_backoff_max_s": 30.0,
+    # --- elastic recovery (docs/robustness.md "Elastic recovery") ---------
+    # solver-checkpoint cadence in inner iterations: at each boundary the
+    # solver state is host-fetched so an interrupted fit resumes from the
+    # last checkpoint instead of from scratch. 0 disables (default — no
+    # extra host sync is ever added to an un-checkpointed fit).
+    "checkpoint_every_iters": 0,
+    # how many rank losses one fit may absorb through survivor re-meshing
+    # (recovery epochs) before degrading to the typed RankFailedError
+    "recovery_max_rank_losses": 1,
+    # minimum membership window a reform round stays open, so a respawned
+    # rank relaunched promptly after a kill can rejoin at the epoch boundary
+    # (0 = close as soon as all known-live ranks have voted)
+    "recovery_rejoin_grace_s": 0.0,
+    # how many times a CrossValidator/TrainValidationSplit sweep may resume
+    # after a mid-flight failure; the completion ledger (tuning.SweepLedger)
+    # guarantees finished (fold, paramMap) fits are never redone
+    "sweep_max_resumes": 1,
     # opt-in NaN/Inf scan over ingested feature/label/weight columns
     # (chunked under ingest_chunk_bytes); raises IngestValidationError
     # naming the column instead of feeding NaNs to a solver
@@ -244,12 +264,19 @@ def retryable_stage(
     peer is dead), SolverDivergedError, user errors — propagate immediately.
 
     Before each retry: exponential backoff from ``config["fit_retry_backoff_s"]``
-    (attempt N sleeps base * 2^(N-1)), and `rendezvous.begin_epoch(attempt)`
+    (attempt N sleeps base * 2^(N-1), capped at
+    ``config["fit_retry_backoff_max_s"]``), and `rendezvous.begin_epoch(attempt)`
     re-namespaces the control plane so the retry never reads the failed
     attempt's stale rounds. Every retry increments the ``fit.retries``
     telemetry counter, which lands in ``model._fit_metrics`` and the bench
     snapshot. The chaos hook (`parallel.chaos.maybe_fail_stage`) runs at the
-    top of every attempt so fault plans can inject the transient path."""
+    top of every attempt so fault plans can inject the transient path.
+
+    A `checkpoint.CheckpointStore` is active for all attempts (adopting the
+    enclosing `recoverable_stage`'s store when present): solvers that
+    checkpoint (``config["checkpoint_every_iters"]``) resume a transient
+    retry from the last checkpoint instead of from scratch."""
+    from . import checkpoint as _checkpoint
     from . import diagnostics, telemetry
     from .errors import is_transient
     from .parallel import chaos
@@ -258,30 +285,154 @@ def retryable_stage(
         max_retries = int(config.get("fit_max_retries", 2))
     if backoff_s is None:
         backoff_s = float(config.get("fit_retry_backoff_s", 0.5))
+    backoff_max_s = float(config.get("fit_retry_backoff_max_s", 30.0))
     if logger is None:
         logger = get_logger("retryable_stage")
-    for attempt in range(max_retries + 1):
-        try:
-            chaos.maybe_fail_stage(stage, attempt)
-            return fn(attempt)
-        except Exception as e:
-            if not is_transient(e) or attempt >= max_retries:
-                raise
-            telemetry.registry().inc("fit.retries")
-            diagnostics.record_event(
-                "retry", stage=stage, attempt=attempt + 1,
-                error=type(e).__name__,
-            )
-            sleep_s = backoff_s * (2 ** attempt)
-            logger.warning(
-                "stage %s attempt %d/%d failed transiently (%s: %s); "
-                "retrying in %.2fs",
-                stage, attempt + 1, max_retries + 1, type(e).__name__, e, sleep_s,
-            )
-            time.sleep(sleep_s)
-            if rendezvous is not None:
-                rendezvous.begin_epoch(attempt + 1)
+    with _checkpoint.ensure_scope():
+        for attempt in range(max_retries + 1):
+            try:
+                chaos.maybe_fail_stage(stage, attempt)
+                return fn(attempt)
+            except Exception as e:
+                if not is_transient(e) or attempt >= max_retries:
+                    raise
+                telemetry.registry().inc("fit.retries")
+                diagnostics.record_event(
+                    "retry", stage=stage, attempt=attempt + 1,
+                    error=type(e).__name__,
+                )
+                sleep_s = min(backoff_s * (2 ** attempt), backoff_max_s)
+                logger.warning(
+                    "stage %s attempt %d/%d failed transiently (%s: %s); "
+                    "retrying in %.2fs",
+                    stage, attempt + 1, max_retries + 1, type(e).__name__, e, sleep_s,
+                )
+                time.sleep(sleep_s)  # sleep-ok: capped retry backoff (the one backoff owner)
+                if rendezvous is not None:
+                    rendezvous.begin_epoch(attempt + 1)
     raise AssertionError("unreachable")  # pragma: no cover
+
+
+def recoverable_stage(
+    fn: Callable[[int], Any],
+    *,
+    stage: str,
+    ctx: Any = None,
+    rendezvous: Any = None,
+    on_recover: Optional[Callable[[Any, int, set], None]] = None,
+    logger: Any = None,
+    max_rank_losses: Optional[int] = None,
+    max_retries: Optional[int] = None,
+    backoff_s: Optional[float] = None,
+) -> Any:
+    """Elastic outer layer over `retryable_stage`: grow abort-and-retry into
+    survivor re-meshing (docs/robustness.md "Elastic recovery").
+
+    Transient failures retry as before. A `RankFailedError` — previously
+    always terminal — now opens a RECOVERY EPOCH when the rendezvous
+    substrate supports membership reform (so does an exhausted
+    `RendezvousTimeoutError` that names missing ranks: a peer dead before
+    first contact never heartbeats, so it can only surface as a timeout;
+    the reform is evidence-based — no dead hint — so a merely-slow rank
+    that votes late is re-admitted): survivors agree on the live rank
+    set (`rendezvous.reform`, which also admits a respawned rank rejoining
+    at the epoch boundary), the context adopts the reformed group
+    (`ctx.adopt_reform`: new rank/nranks + the mesh rebuilt over survivors),
+    and the stage re-enters — solvers resume from the last checkpoint in the
+    shared `CheckpointStore` rather than from scratch. Bounded by
+    ``config["recovery_max_rank_losses"]``; exhaustion (or a substrate
+    without reform) degrades to today's typed failure.
+
+    `on_recover(new_rendezvous, generation, dead_original_ranks)` lets
+    context-free callers (the chaos harness) swap their rendezvous handle.
+    Recovery epochs are counted (``fit.recoveries`` / ``recovery.epochs`` /
+    ``recovery.rank_losses``) and flight-recorded, and the ring is dumped
+    after each successful reform so post-mortems show the epoch."""
+    from . import checkpoint as _checkpoint
+    from . import diagnostics, telemetry
+    from .errors import RankFailedError, RendezvousTimeoutError
+
+    if rendezvous is None and ctx is not None:
+        rendezvous = getattr(ctx, "rendezvous", None)
+    if max_rank_losses is None:
+        max_rank_losses = int(config.get("recovery_max_rank_losses", 1))
+    if logger is None:
+        logger = get_logger("recoverable_stage")
+    losses = 0
+    with _checkpoint.ensure_scope():
+        while True:  # blocking-ok: every epoch charges the recovery budget; exhaustion raises
+            try:
+                return retryable_stage(
+                    fn, stage=stage, rendezvous=rendezvous, logger=logger,
+                    max_retries=max_retries, backoff_s=backoff_s,
+                )
+            except (RankFailedError, RendezvousTimeoutError) as e:
+                if isinstance(e, RendezvousTimeoutError) and not getattr(
+                    e, "missing_ranks", None
+                ):
+                    # a timeout naming NO missing ranks carries no liveness
+                    # evidence to reform around (e.g. a desync, a chaos
+                    # `fail` injection) — that stays retryable_stage's
+                    # territory, and it already exhausted its budget
+                    raise
+                if (
+                    rendezvous is None
+                    or not getattr(rendezvous, "can_reform", False)
+                    or losses >= max_rank_losses
+                ):
+                    # stamp how far recovery got before degrading to the
+                    # typed failure (0 = never opened an epoch), so callers
+                    # and post-mortems distinguish "unreformable substrate"
+                    # from "budget exhausted"
+                    e.recovery_exhausted = losses > 0
+                    e.recovery_generations = losses
+                    raise
+                live = list(getattr(rendezvous, "live_ranks", range(rendezvous.nranks)))
+                dead = set()
+                failed_rank = getattr(e, "failed_rank", None)
+                if (
+                    isinstance(e, RankFailedError)
+                    and failed_rank is not None
+                    and 0 <= failed_rank < len(live)
+                ):
+                    dead.add(live[failed_rank])
+                # an exhausted TIMEOUT (a peer dead before it ever made
+                # contact — no abort file, no heartbeat file to go stale)
+                # seeds NO dead hint: the reform round's own evidence
+                # (votes, abort files, heartbeat staleness) decides who is
+                # gone, so a merely-slow rank that votes late is re-admitted
+                # instead of excluded on circumstantial missing_ranks
+                generation = int(getattr(rendezvous, "reform_generation", 0)) + 1
+                reg = telemetry.registry()
+                reg.inc("fit.recoveries")
+                reg.inc("recovery.epochs")
+                diagnostics.record_event(
+                    "recovery_epoch_begin", stage=stage, generation=generation,
+                    failed_rank=failed_rank,
+                    dead_ranks=sorted(dead),
+                )
+                logger.warning(
+                    "stage %s: rank failure (%s) — entering recovery epoch %d "
+                    "over the survivor set", stage, e, generation,
+                )
+                new_rdv = rendezvous.reform(dead_ranks=dead, generation=generation)
+                lost = len(live) - len(getattr(new_rdv, "live_ranks", range(new_rdv.nranks)))
+                losses += max(1, lost)
+                reg.inc("recovery.rank_losses", max(1, lost))
+                if ctx is not None and hasattr(ctx, "adopt_reform"):
+                    ctx.adopt_reform(new_rdv)
+                if on_recover is not None:
+                    on_recover(new_rdv, generation, dead)
+                rendezvous = new_rdv
+                diagnostics.record_event(
+                    "recovery_epoch", stage=stage, generation=generation,
+                    survivors=list(getattr(new_rdv, "live_ranks", range(new_rdv.nranks))),
+                )
+                # dump the ring so the post-mortem timeline NAMES the epoch
+                # even when the fit then completes cleanly
+                diagnostics.flight_recorder().dump(
+                    reason=f"recovery epoch {generation}"
+                )
 
 
 # ---------------------------------------------------------------------------
@@ -578,16 +729,43 @@ class _TpuCaller(_TpuCommon):
                 scope.cache[key] = scope.cache.pop(key)  # LRU: move to newest
                 telemetry.registry().inc("fit.device_dataset_reuses")
             else:
-                with telemetry.span("ingest", logger=stage_logger):
-                    extracted = self._pre_process_data(dataset, for_fit=True)
-                with telemetry.span("layout", logger=stage_logger):
-                    inputs = self._build_fit_inputs(extracted, ctx)
-                telemetry.record_device_memory()
-                # `source=dataset` pins the object so its id() — the heart of
-                # the cache key — cannot be recycled while the entry lives
-                dds = DeviceDataset(key=key, extracted=extracted, inputs=inputs, source=dataset)
-                scope.cache[key] = dds
-                telemetry.registry().inc("fit.device_dataset_builds")
+                # host-retained re-placement (docs/robustness.md "Elastic
+                # recovery"): a cached entry for the SAME data on a DIFFERENT
+                # mesh — the survivor re-mesh shape, where the device set
+                # changed under one fit — still holds the right host blocks.
+                # Reuse them: the ingest pass is skipped entirely and only
+                # the layout runs against the new mesh.
+                from .data import same_ingest_identity
+
+                retained = next(
+                    (e for ek, e in scope.cache.items() if same_ingest_identity(ek, key)),
+                    None,
+                )
+                if retained is not None:
+                    extracted = retained.extracted
+                    reg = telemetry.registry()
+                    reg.inc("recovery.replacements")
+                    reg.inc("recovery.rows_replaced", int(extracted.n_rows))
+                    with telemetry.span("layout", logger=stage_logger):
+                        inputs = self._build_fit_inputs(extracted, ctx)
+                    telemetry.record_device_memory()
+                    dds = DeviceDataset(
+                        key=key, extracted=extracted, inputs=inputs,
+                        source=retained.source,
+                    )
+                    scope.cache[key] = dds
+                else:
+                    with telemetry.span("ingest", logger=stage_logger):
+                        extracted = self._pre_process_data(dataset, for_fit=True)
+                    with telemetry.span("layout", logger=stage_logger):
+                        inputs = self._build_fit_inputs(extracted, ctx)
+                    telemetry.record_device_memory()
+                    # `source=dataset` pins the object so its id() — the
+                    # heart of the cache key — cannot be recycled while the
+                    # entry lives
+                    dds = DeviceDataset(key=key, extracted=extracted, inputs=inputs, source=dataset)
+                    scope.cache[key] = dds
+                    telemetry.registry().inc("fit.device_dataset_builds")
                 # bounded retention: a scope around a loop over FRESH dataset
                 # objects (per-fold slices on a non-engine path) must not
                 # stack HBM placements — evict least-recently-used entries
@@ -657,15 +835,18 @@ class _TpuCaller(_TpuCommon):
             "fit", logger=stage_logger, estimator=type(self).__name__
         ):
             # the whole traced fit (ingest -> layout -> solve) is ONE
-            # retryable stage: every attempt re-derives its state from the
-            # immutable dataset, so a retried fit is bit-identical to an
-            # unfaulted one (pinned by tests/test_chaos.py)
-            rows = retryable_stage(
+            # recoverable stage: a transient retry re-derives its state from
+            # the immutable dataset (bit-identical to an unfaulted fit —
+            # pinned by tests/test_chaos.py), and a rank loss on a
+            # reform-capable rendezvous opens a recovery epoch — the
+            # survivor mesh re-ingests from host-retained chunks and the
+            # solvers resume from the checkpoint store
+            rows = recoverable_stage(
                 lambda attempt: self._call_fit_func_traced(
                     dataset, param_maps, logger, stage_logger, row_mask
                 ),
                 stage="fit",
-                rendezvous=active.rendezvous if active is not None else None,
+                ctx=active,
                 logger=logger,
             )
         self._last_fit_metrics = tele_scope["metrics"]

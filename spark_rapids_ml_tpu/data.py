@@ -99,6 +99,22 @@ def dataset_fingerprint(dataset: Any) -> tuple:
     return (id(dataset), type(dataset).__name__, cols_t, tuple(shape) if shape else None)
 
 
+def same_ingest_identity(key_a: Any, key_b: Any) -> bool:
+    """Whether two DeviceDataset cache keys name the SAME ingested data —
+    dataset fingerprint, extraction columns, dtype/sparse mode — regardless
+    of the MESH they were placed on (the key's final component). This is the
+    host-retained re-placement predicate for elastic recovery
+    (docs/robustness.md): after a survivor re-mesh changes the device set,
+    the stale placement's `extracted` host blocks are still the right data —
+    only the layout must be redone on the new mesh."""
+    return (
+        key_a is not None
+        and key_b is not None
+        and len(key_a) == len(key_b) == 4
+        and key_a[:3] == key_b[:3]
+    )
+
+
 def ingest_chunk_rows(row_bytes: int) -> int:
     """Rows per ingest chunk under ``core.config["ingest_chunk_bytes"]``."""
     from .core import config  # lazy: core imports this module at load time

@@ -459,6 +459,10 @@ def assemble_postmortem(
     reasons: List[str] = []
     ranks: Dict[int, Dict[str, Any]] = {}
     timeline: List[Dict[str, Any]] = []
+    # recovery epochs (elastic recovery): every reform a rank recorded,
+    # deduped by generation — the post-mortem NAMES each epoch, its survivor
+    # set, and the dead ranks it excluded
+    recovery_epochs: Dict[int, Dict[str, Any]] = {}
     for r, events in sorted(per_rank.items()):
         timeline.extend(events)
         last_enter: Optional[Dict[str, Any]] = None
@@ -471,6 +475,18 @@ def assemble_postmortem(
                 last_enter = ev
             elif k in ("rdv_exit", "rdv_fail"):
                 open_round = None
+            elif k in ("recovery_reform", "recovery_epoch_begin", "chaos_reform"):
+                gen = ev.get("generation")
+                if gen is not None:
+                    entry = recovery_epochs.setdefault(
+                        int(gen), {"generation": int(gen)}
+                    )
+                    if ev.get("survivors") is not None:
+                        entry["survivors"] = list(ev["survivors"])
+                    if ev.get("dead") is not None:
+                        entry["dead"] = sorted(ev["dead"])
+                    elif ev.get("dead_ranks") is not None:
+                        entry.setdefault("dead", sorted(ev["dead_ranks"]))
             elif k == "error":
                 fr = ev.get("failed_rank")
                 if fr is not None:
@@ -525,6 +541,9 @@ def assemble_postmortem(
         "failed_rank": failed_rank,
         "failed_round": failed_round,
         "failure_reason": reasons[0] if reasons else None,
+        "recovery_epochs": [
+            recovery_epochs[g] for g in sorted(recovery_epochs)
+        ],
         "ranks": ranks,
         "timeline": timeline,
     }
@@ -547,6 +566,13 @@ def render_postmortem(pm: Dict[str, Any]) -> str:
     if pm.get("missing_ranks"):
         lines.append(
             f"missing dumps (hard-killed? never started?): ranks {pm['missing_ranks']}"
+        )
+    for ep in pm.get("recovery_epochs") or []:
+        dead = f", excluded {ep['dead']}" if ep.get("dead") else ""
+        lines.append(
+            f"recovery epoch g{ep.get('generation')}: survivors "
+            f"{ep.get('survivors')}{dead} — the fit CONTINUED on the "
+            "reformed group"
         )
     for r, info in sorted(pm.get("ranks", {}).items()):
         status = info.get("error") or (

@@ -89,8 +89,20 @@ class RendezvousTimeoutError(SrmlError, TimeoutError):
 class RankFailedError(SrmlError, RuntimeError):
     """A peer rank failed mid-fit: it published an ``ABORT:<rank>:<reason>``
     sentinel through the rendezvous, or its heartbeat went stale (killed
-    process). PERMANENT for this attempt — the peer's partition state is gone;
-    an external supervisor (not an in-process retry) must relaunch the rank."""
+    process). On a reform-capable rendezvous, `core.recoverable_stage`
+    absorbs this by opening a recovery epoch (survivor re-meshing, bounded
+    by ``config["recovery_max_rank_losses"]``); when that budget is
+    exhausted — or the substrate cannot reform — the error propagates with
+    ``recovery_exhausted``/``recovery_generations`` stamped so callers and
+    post-mortems can tell "never tried" from "tried and ran out".
+    PERMANENT once it propagates: an external supervisor (not an in-process
+    retry) must relaunch the rank."""
+
+    # stamped by core.recoverable_stage when it re-raises after recovery
+    # epochs were attempted: how many membership reforms this fit survived
+    # before the budget ran out (0 = recovery was never opened)
+    recovery_exhausted: bool = False
+    recovery_generations: int = 0
 
     def __init__(
         self,

@@ -362,7 +362,13 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
         )
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
-        from ..ops.logistic import logistic_fit, logistic_fit_ell
+        from .. import checkpoint as _ckpt
+        from ..ops.logistic import (
+            logistic_fit,
+            logistic_fit_checkpointed,
+            logistic_fit_ell,
+            logistic_fit_ell_checkpointed,
+        )
 
         labels_host = extracted.label
 
@@ -381,14 +387,34 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 use_l1=alpha * l1_ratio > 0,
                 **self._solver_statics(params),
             )
+            # elastic recovery: with a checkpoint cadence configured and a
+            # store installed by the enclosing recoverable stage, the solver
+            # loop runs host-segmented so an interrupted fit resumes from
+            # the last boundary. Single-controller only: the segment
+            # boundary host-fetches globally-sharded state, which a
+            # multi-process rank cannot address alone.
+            use_ckpt = _ckpt.solver_checkpoints_active() and (
+                inputs.ctx is None or not inputs.ctx.is_spmd
+            )
+            ckpt_common = (
+                dict(
+                    ckpt_key="logistic:" + repr(sorted(common.items())),
+                    placement_key=_ckpt.placement_key_of(inputs),
+                )
+                if use_ckpt
+                else {}
+            )
             if inputs.X_sparse is not None:
                 ell_val, ell_idx = inputs.ell_rows()
                 w_dev = inputs.put_rows(np.asarray(inputs.w, dtype=inputs.dtype))
-                state = logistic_fit_ell(
-                    ell_val, ell_idx, y_idx, w_dev, d=inputs.n_cols, **common
+                fit_fn = logistic_fit_ell_checkpointed if use_ckpt else logistic_fit_ell
+                state = fit_fn(
+                    ell_val, ell_idx, y_idx, w_dev, d=inputs.n_cols,
+                    **common, **ckpt_common,
                 )
             else:
-                state = logistic_fit(inputs.X, y_idx, inputs.w, **common)
+                fit_fn = logistic_fit_checkpointed if use_ckpt else logistic_fit
+                state = fit_fn(inputs.X, y_idx, inputs.w, **common, **ckpt_common)
             # ONE device->host fetch of the whole result, then the divergence
             # guard runs on the already-fetched scalars (no extra sync)
             state = {k: np.asarray(v) for k, v in state.items()}

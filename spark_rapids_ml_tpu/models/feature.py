@@ -80,7 +80,13 @@ class PCA(_PCAParams, _TpuEstimator):
         return self._set_params(outputCol=value)
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
-        from ..ops.pca import check_pca_state, pca_fit, record_pca_fit
+        from .. import checkpoint as _ckpt
+        from ..ops.pca import (
+            check_pca_state,
+            pca_fit,
+            pca_fit_checkpointed,
+            record_pca_fit,
+        )
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             k = int(params["n_components"])
@@ -88,7 +94,18 @@ class PCA(_PCAParams, _TpuEstimator):
                 raise ValueError(f"k must be >= 1, got {k}")
             if k > inputs.n_cols:
                 raise ValueError(f"k={k} exceeds the number of features {inputs.n_cols}")
-            state = pca_fit(inputs.X, inputs.w, k=k)
+            # elastic recovery: retain the (mean, covariance) statistics so a
+            # transient retry (or a k sweep in this stage) skips the data pass
+            use_ckpt = _ckpt.solver_checkpoints_active() and (
+                inputs.ctx is None or not inputs.ctx.is_spmd
+            )
+            if use_ckpt:
+                state = pca_fit_checkpointed(
+                    inputs.X, inputs.w, k=k,
+                    placement_key=_ckpt.placement_key_of(inputs),
+                )
+            else:
+                state = pca_fit(inputs.X, inputs.w, k=k)
             out = {name: np.asarray(v) for name, v in state.items()}
             check_pca_state(out, k=k)  # guard on the host-fetched attributes
             record_pca_fit(out, k=k)

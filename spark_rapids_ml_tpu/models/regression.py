@@ -198,7 +198,13 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
     _supports_sparse_input = True
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
-        from ..ops.linear import linear_fit, linear_fit_ell
+        from .. import checkpoint as _ckpt
+        from ..ops.linear import (
+            linear_fit,
+            linear_fit_checkpointed,
+            linear_fit_ell,
+            linear_fit_ell_checkpointed,
+        )
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             alpha = float(params["alpha"])
@@ -213,18 +219,34 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
             )
+            # elastic recovery: retain the sufficient statistics (the one
+            # data pass) on host so a transient retry — and every further
+            # sequential param set in this fit stage — solves without
+            # another pass over the data. The stats never depend on
+            # alpha/l1_ratio, so one key serves the whole sweep.
+            use_ckpt = _ckpt.solver_checkpoints_active() and (
+                inputs.ctx is None or not inputs.ctx.is_spmd
+            )
+            ckpt_common = (
+                dict(placement_key=_ckpt.placement_key_of(inputs))
+                if use_ckpt
+                else {}
+            )
             if inputs.X_sparse is not None:
                 ell_val, ell_idx = inputs.ell_rows()
-                state = linear_fit_ell(
+                fit_fn = linear_fit_ell_checkpointed if use_ckpt else linear_fit_ell
+                state = fit_fn(
                     ell_val,
                     ell_idx,
                     inputs.put_rows(np.asarray(inputs.y, dtype=inputs.dtype)),
                     inputs.put_rows(np.asarray(inputs.w, dtype=inputs.dtype)),
                     d=inputs.n_cols,
                     **common,
+                    **ckpt_common,
                 )
             else:
-                state = linear_fit(inputs.X, inputs.y, inputs.w, **common)
+                fit_fn = linear_fit_checkpointed if use_ckpt else linear_fit
+                state = fit_fn(inputs.X, inputs.y, inputs.w, **common, **ckpt_common)
             return {
                 "coef_": np.asarray(state["coef_"]),
                 "intercept_": float(state["intercept_"]),

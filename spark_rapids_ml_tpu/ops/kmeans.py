@@ -267,6 +267,10 @@ def kmeans_fit(
     the protocol shape, true inertia agrees to ~1e-5); "high" keeps the
     ambient (3-pass-bf16 "f32") precision everywhere. f64 inputs always run
     "high". The final reported inertia is high-precision in both modes."""
+    import numpy as np
+
+    from .. import checkpoint as _ckpt
+
     centers = jnp.asarray(init_centers)
     fast = precision_mode == "fast" and X.dtype == jnp.float32
     inertia = jnp.zeros((), X.dtype)
@@ -291,7 +295,48 @@ def kmeans_fit(
     # point and the NaN/Inf check cost no extra device synchronization.
     prev_shift = None
     last_good = centers  # iterate entering the step that produced prev_shift
-    for _ in range(max_iter):
+    # Solver checkpoints (docs/robustness.md "Elastic recovery"): the host
+    # loop already fetches the shift scalar every iteration, so host-fetching
+    # the centers at the configured cadence is near-free. Centers are
+    # REPLICATED state — fully portable across meshes — so a resume after a
+    # transient retry or a survivor re-mesh restarts Lloyd from the
+    # checkpointed iterate: bit-identical on the same mesh (the host
+    # round-trip is lossless and each step depends only on (X, w, centers)),
+    # deterministic given the survivor set on a degraded one.
+    ckpt_store = _ckpt.active_store()
+    ckpt_every = _ckpt.every_iters()
+    ckpt_key = None
+    if ckpt_store is not None and ckpt_every > 0:
+        # the key must identify THIS solve's trajectory, not just its shape:
+        # sequential param sets in one fit stage (a maxIter/tol sweep, or a
+        # different init seed) share the store, and a shape-only key would
+        # resume solve N from solve N-1's converged state. The init-centers
+        # fingerprint (one tiny host fetch, once per fit) plus the loop
+        # statics pin the trajectory; tol/maxIter only move the STOP point
+        # on it, but keying them too keeps the entries disjoint and cheap.
+        import hashlib
+
+        init_digest = hashlib.sha1(
+            np.ascontiguousarray(np.asarray(init_centers)).tobytes()
+        ).hexdigest()[:12]
+        ckpt_key = (
+            f"kmeans:{tuple(jnp.shape(centers))}:{init_digest}:{max_iter}:{tol}"
+        )
+        saved = ckpt_store.load(ckpt_key)
+        if saved is not None and tuple(saved.state["centers"].shape) == tuple(
+            jnp.shape(centers)
+        ):
+            centers = jnp.asarray(saved.state["centers"], dtype=X.dtype)
+            # last_good is the iterate ENTERING the step that produced
+            # prev_shift — one step BEHIND the checkpointed centers. Restore
+            # it too, so a divergence detected right after resume reports the
+            # same last-good iterate an uninterrupted run would.
+            lg = saved.state.get("last_good")
+            last_good = centers if lg is None else jnp.asarray(lg, dtype=X.dtype)
+            n_iter = int(saved.iteration)
+            ps = saved.state.get("prev_shift")
+            prev_shift = None if ps is None else float(ps)
+    while n_iter < max_iter:
         step_in = centers
         centers, inertia, shift = step(centers, fast)
         n_iter += 1
@@ -305,6 +350,27 @@ def kmeans_fit(
                 break
         prev_shift = shift
         last_good = step_in
+        if ckpt_store is not None and ckpt_every > 0 and n_iter % ckpt_every == 0:
+            # the cadence fetch of prev_shift syncs with the device — the
+            # documented checkpoint overhead; the float survives the
+            # round-trip exactly, so the resumed convergence pipeline sees
+            # the same value the uninterrupted run would
+            prev_shift = float(prev_shift)
+            ckpt_store.save(ckpt_key, _ckpt.SolverCheckpoint(
+                solver="kmeans", iteration=n_iter,
+                state={
+                    "centers": np.asarray(centers),
+                    "prev_shift": prev_shift,
+                    # the divergence-fallback iterate (one step behind)
+                    "last_good": np.asarray(last_good),
+                },
+            ))
+            # mid-solve fault injection point (`fail:stage=solve` plans):
+            # fires AFTER the boundary checkpoint landed, so a retried fit
+            # provably resumes instead of restarting Lloyd from scratch
+            from ..parallel import chaos
+
+            chaos.maybe_fail_stage("solve", n_iter)
     if telemetry.enabled():
         telemetry.record_solver_result("kmeans", n_iter=n_iter)
     # inertia reported is one iteration stale; recompute once with final

@@ -315,6 +315,122 @@ def _solve_from_stats(
     return {"coef_": coef, "intercept_": intercept, "n_iter_": n_iter, "rss_": jnp.maximum(rss, 0.0), "sw_": sw}
 
 
+# names for the host-retained sufficient-statistics checkpoint payload, in
+# `_sufficient_stats` tuple order
+_STATS_NAMES = ("sw", "sx", "sy", "G", "c", "syy")
+
+_stats_jit = jax.jit(_sufficient_stats)
+_ell_stats_jit = jax.jit(_ell_sufficient_stats, static_argnames=("d", "tile"))
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter", "use_cd"))
+def _solve_stats_jit(
+    stats, dtype_probe, *, alpha, l1_ratio, fit_intercept, standardize, use_cd,
+    max_iter, tol,
+):
+    return _solve_from_stats(
+        stats, dtype_probe.dtype,
+        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+    )
+
+
+def _fit_from_retained_stats(
+    compute_stats, dtype, *, alpha, l1_ratio, fit_intercept, standardize,
+    use_cd, max_iter, tol, ckpt_key, placement_key,
+) -> Dict[str, jax.Array]:
+    """Linear-family fit through host-RETAINED sufficient statistics
+    (docs/robustness.md "Elastic recovery"): the one distributed data pass
+    lands its (d,d)-sized outputs in the active `CheckpointStore`, so a
+    transient retry — and every further param set of a sequential sweep in
+    the same fit stage — solves from the retained statistics WITHOUT another
+    pass over the data (``checkpoint.stats_reuses``). The replicated solve
+    is deterministic given the statistics, so a resumed fit is bit-identical
+    to an uninterrupted one."""
+    import numpy as np
+
+    from .. import checkpoint as _ckpt
+    from ..parallel import chaos
+
+    store = _ckpt.active_store()
+
+    def compute() -> Dict:
+        stats = compute_stats()
+        return {n: np.asarray(v) for n, v in zip(_STATS_NAMES, stats)}
+
+    if store is not None:
+        state = store.get_or_compute(
+            ckpt_key, compute, solver="linear", placement_key=placement_key
+        )
+    else:
+        state = compute()
+    # mid-solve fault injection point: `fail:stage=solve` fires after the
+    # stats were retained, so the retried attempt provably reuses them
+    chaos.maybe_fail_stage("solve", 0)
+    stats = tuple(jnp.asarray(state[n], dtype) for n in _STATS_NAMES)
+    return _solve_stats_jit(
+        stats, jnp.zeros((), dtype),
+        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, use_cd=use_cd, max_iter=int(max_iter), tol=tol,
+    )
+
+
+def linear_fit_checkpointed(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    *,
+    alpha: float,
+    l1_ratio: float,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    use_cd: bool = False,
+    max_iter: int = 1000,
+    tol: float = 1e-6,
+    ckpt_key: str = "linear_stats",
+    placement_key=None,
+) -> Dict[str, jax.Array]:
+    """`linear_fit` with the sufficient statistics retained on host (see
+    `_fit_from_retained_stats`). The statistics depend only on (X, y, w) —
+    never on alpha/l1_ratio — so one retained pass serves a whole sequential
+    hyperparameter sweep AND any bounded-retry resume."""
+    return _fit_from_retained_stats(
+        lambda: _stats_jit(X, y, w), X.dtype,
+        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+        ckpt_key=ckpt_key, placement_key=placement_key,
+    )
+
+
+def linear_fit_ell_checkpointed(
+    values: jax.Array,
+    indices: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    *,
+    d: int,
+    alpha: float,
+    l1_ratio: float,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    use_cd: bool = False,
+    max_iter: int = 1000,
+    tol: float = 1e-6,
+    tile: int = 8192,
+    ckpt_key: str = "linear_stats_ell",
+    placement_key=None,
+) -> Dict[str, jax.Array]:
+    """Sparse (padded-ELL) analog of `linear_fit_checkpointed`: the tiled
+    gram accumulation is the retained pass."""
+    return _fit_from_retained_stats(
+        lambda: _ell_stats_jit(values, indices, y, w, d=d, tile=min(tile, values.shape[0])),
+        values.dtype,
+        alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
+        standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
+        ckpt_key=ckpt_key, placement_key=placement_key,
+    )
+
+
 @jax.jit
 def linear_predict(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.Array:
     return X @ coef + intercept

@@ -26,7 +26,7 @@
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 
@@ -49,13 +49,17 @@ def _make_scaling(X, w, standardize: bool, fit_intercept: bool):
     return mu, d_scale, total_w
 
 
-def _glm_qn_minimize(
+def _glm_qn_setup(
     z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat: int, dtype,
     penalty_terms, max_iter: int, tol: float, memory: int = 10,
-    n_alphas: int = 12, c1: float = 1e-4,
+    n_alphas: int = 12, c1: float = 1e-4, x0=None,
 ):
     """L-BFGS specialized to GLM objectives: loss(p) = rowloss(z_of(p)) +
-    penalty(p) with z LINEAR in p.
+    penalty(p) with z LINEAR in p. Builds and returns the loop triple
+    ``(cond, body, state0)`` — shared verbatim by the one-program
+    `_glm_qn_minimize` path and the host-segmented checkpointing driver
+    (`glm_qn_minimize_segmented`). `x0` warm-starts the iterate (the
+    degraded-mesh portable resume; z0/g0/f0 are re-derived from it).
 
     Two structural exploits of linearity keep every iteration at TWO passes
     over the data matrix (the HBM-bandwidth floor for a logit model):
@@ -147,10 +151,14 @@ def _glm_qn_minimize(
             )
         return x, z_p, g, S, Y, rho, (count, pos), f_cur, f_out, it + 1, ~ok
 
-    x0 = jnp.zeros((n_flat,), dtype)
-    z0 = jnp.zeros(z_shape, dtype)  # z_of(0) == 0: z is linear with no constant
+    if x0 is None:
+        x0 = jnp.zeros((n_flat,), dtype)
+        z0 = jnp.zeros(z_shape, dtype)  # z_of(0) == 0: z is linear with no constant
+    else:
+        x0 = jnp.asarray(x0, dtype)
+        z0 = z_of(x0)
     g0 = grad_from_z(x0, z0)
-    p00, _, _ = penalty_terms(x0, x0)
+    p00, _, _ = penalty_terms(x0, jnp.zeros_like(x0))
     f0 = rowloss(z0) + p00
     state0 = (
         x0, z0, g0,
@@ -159,12 +167,86 @@ def _glm_qn_minimize(
         (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
         jnp.asarray(jnp.inf, x0.dtype), f0, jnp.asarray(0, jnp.int32), jnp.asarray(False),
     )
+    return cond, body, state0
+
+
+def _glm_qn_minimize(
+    z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat: int, dtype,
+    penalty_terms, max_iter: int, tol: float, memory: int = 10,
+    n_alphas: int = 12, c1: float = 1e-4,
+):
+    """One-program GLM quasi-Newton minimization (see `_glm_qn_setup` for
+    the algorithm and its two structural exploits of linearity). Returns
+    (flat_params, objective, n_iter, stalled)."""
+    from .owlqn import freeze_when_done
+
+    cond, body, state0 = _glm_qn_setup(
+        z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat, dtype,
+        penalty_terms, max_iter, tol, memory, n_alphas, c1,
+    )
     # freeze_when_done makes the loop vmap-safe: batched hyperparameter
     # sweeps (vmap over lam_l2/lam_l1) step until the SLOWEST grid element
     # converges, and converged elements must hold their iterate exactly
     x, _, _, _, _, _, _, _, obj, n_iter, stalled = jax.lax.while_loop(
         cond, freeze_when_done(cond, body), state0
     )
+    return x, obj, n_iter, stalled
+
+
+def glm_qn_minimize_segmented(
+    z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat: int, dtype,
+    penalty_terms, max_iter: int, tol: float, memory: int = 10,
+    n_alphas: int = 12, c1: float = 1e-4, *,
+    ckpt_key: str = "glm_qn", placement_key=None,
+):
+    """`_glm_qn_minimize` with the one big ``lax.while_loop`` segmented into
+    outer HOST segments of ``config["checkpoint_every_iters"]`` inner
+    iterations: each boundary host-fetches the full solver state — the
+    iterate x, its logits z_p, the gradient, the circular L-BFGS (S, Y, rho)
+    memory, and n_iter — into the active `CheckpointStore` so an interrupted
+    fit resumes there instead of from scratch. The segment body is the SAME
+    traced body and the boundary round-trip is lossless, so a same-mesh
+    resume is bit-identical to an uninterrupted segmented run (pinned by
+    tests/test_recovery.py). When a checkpoint's shapes no longer match (a
+    survivor re-mesh changed n), the PORTABLE subset — the iterate x — warm-
+    starts a fresh loop with re-derived logits/gradient: deterministic given
+    the survivor set."""
+    import numpy as np
+
+    from .. import checkpoint as _ckpt
+
+    store = _ckpt.active_store()
+    x_warm = None
+    if store is not None:
+        saved = store.peek(ckpt_key)
+        if saved is not None and saved.placement_key != placement_key:
+            # degraded-mesh resume: leaf shapes changed with the data, but
+            # the iterate is mesh-independent — warm-start from it
+            x_saved = saved.portable.get("x")
+            if x_saved is not None and np.shape(x_saved) == (n_flat,):
+                x_warm = x_saved
+                store.load(ckpt_key)  # count the (portable) restore
+    cond, body, state0 = _glm_qn_setup(
+        z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat, dtype,
+        penalty_terms, max_iter, tol, memory, n_alphas, c1, x0=x_warm,
+    )
+    every = _ckpt.every_iters() or max_iter
+
+    def _save_portable(state):  # ride the generic driver's save with x
+        return {"x": np.asarray(state[0])}
+
+    state = _ckpt.run_segmented_while(
+        cond, body, state0,
+        it_of=lambda s: s[9],  # (x, z_p, g, S, Y, rho, meta, f_prev, f_cur, IT, stalled)
+        every=every,
+        store=store,
+        key=ckpt_key,
+        solver="glm_qn",
+        placement_key=placement_key,
+        max_iter=max_iter,
+        portable_of=_save_portable,
+    )
+    x, _, _, _, _, _, _, _, obj, n_iter, stalled = state
     return x, obj, n_iter, stalled
 
 
@@ -435,10 +517,14 @@ def logistic_fit_ell_batched(
     return jax.vmap(fit_one)(lam_l2s, lam_l1s)
 
 
-def _fit_common(
-    matvec, rmat, n_rows, dtype, d, y_idx, w, mu, d_scale, total_w,
-    *, k, multinomial, lam_l2, lam_l1, use_l1, fit_intercept, max_iter, tol, lbfgs_memory,
-) -> Dict[str, jax.Array]:
+def _build_glm_problem(
+    matvec, rmat, dtype, d, y_idx, w, mu, d_scale, total_w,
+    *, k, multinomial, lam_l2, fit_intercept,
+) -> Dict[str, Any]:
+    """The GLM objective closures — z_of / rowloss / rowloss_alphas /
+    penalty_terms / grad_from_z plus the flat-parameter geometry — shared by
+    the one-program `_fit_common` path and the host-segmented checkpointing
+    driver (`logistic_fit_checkpointed`), so both trace the identical math."""
     k_out = k if multinomial else 1
     n_flat = d * k_out + k_out
 
@@ -498,6 +584,43 @@ def _fit_common(
         db0 = jnp.sum(r, axis=0) if fit_intercept else jnp.zeros((k_out,), dtype)
         return jnp.concatenate([dB.ravel(), db0])
 
+    return dict(
+        k_out=k_out, n_flat=n_flat, unflatten=unflatten, z_of=z_of,
+        rowloss=rowloss, rowloss_alphas=rowloss_alphas,
+        penalty_terms=penalty_terms, grad_from_z=grad_from_z,
+    )
+
+
+def _finish_glm(
+    xf, obj, n_iter, stalled, unflatten, d_scale, mu, *, fit_intercept, multinomial,
+) -> Dict[str, jax.Array]:
+    """Flat iterate -> model-attribute dict in ORIGINAL feature space
+    (standardization folded out, Spark multinomial intercept centering)."""
+    B, b0 = unflatten(xf)
+    coef = (B * d_scale[:, None]).T  # [k_out, d] original space
+    intercept = b0 - coef @ mu if fit_intercept else jnp.zeros_like(b0)
+    if multinomial:
+        # softmax shift invariance: center intercepts (Spark parity,
+        # reference classification.py:1077-1089)
+        intercept = intercept - jnp.mean(intercept)
+    return {
+        "coef_": coef, "intercept_": intercept, "objective_": obj,
+        "n_iter_": n_iter, "stalled_": stalled,
+    }
+
+
+def _fit_common(
+    matvec, rmat, n_rows, dtype, d, y_idx, w, mu, d_scale, total_w,
+    *, k, multinomial, lam_l2, lam_l1, use_l1, fit_intercept, max_iter, tol, lbfgs_memory,
+) -> Dict[str, jax.Array]:
+    prob = _build_glm_problem(
+        matvec, rmat, dtype, d, y_idx, w, mu, d_scale, total_w,
+        k=k, multinomial=multinomial, lam_l2=lam_l2, fit_intercept=fit_intercept,
+    )
+    k_out, n_flat, unflatten = prob["k_out"], prob["n_flat"], prob["unflatten"]
+    z_of, rowloss, rowloss_alphas = prob["z_of"], prob["rowloss"], prob["rowloss_alphas"]
+    penalty_terms, grad_from_z = prob["penalty_terms"], prob["grad_from_z"]
+
     if use_l1:
         # L1/ElasticNet: OWL-QN over the flattened (B, b0) with the L1 mask
         # covering coefficients only (intercepts are never penalized — Spark
@@ -522,18 +645,126 @@ def _fit_common(
             z_of, rowloss, rowloss_alphas, grad_from_z, (n_rows, k_out), n_flat,
             dtype, penalty_terms, max_iter=max_iter, tol=tol, memory=lbfgs_memory,
         )
-    B, b0 = unflatten(xf)
+    return _finish_glm(
+        xf, obj, n_iter, stalled, unflatten, d_scale, mu,
+        fit_intercept=fit_intercept, multinomial=multinomial,
+    )
 
-    coef = (B * d_scale[:, None]).T  # [k_out, d] original space
-    intercept = b0 - coef @ mu if fit_intercept else jnp.zeros_like(b0)
-    if multinomial:
-        # softmax shift invariance: center intercepts (Spark parity,
-        # reference classification.py:1077-1089)
-        intercept = intercept - jnp.mean(intercept)
-    return {
-        "coef_": coef, "intercept_": intercept, "objective_": obj,
-        "n_iter_": n_iter, "stalled_": stalled,
-    }
+
+def _fit_common_checkpointed(
+    matvec, rmat, n_rows, dtype, d, y_idx, w, mu, d_scale, total_w,
+    *, k, multinomial, lam_l2, lam_l1, use_l1, fit_intercept, max_iter, tol,
+    lbfgs_memory, ckpt_key, placement_key,
+) -> Dict[str, jax.Array]:
+    """`_fit_common` with the solver loop segmented for checkpointing
+    (docs/robustness.md "Elastic recovery"): the IDENTICAL objective closures
+    (`_build_glm_problem`) drive the host-segmented OWL-QN / GLM-QN loops
+    instead of the one-program `lax.while_loop`, so an interrupted fit
+    resumes from the last segment boundary. Runs eagerly (the segments are
+    jitted; the glue is host code) — callers gate on
+    `checkpoint.solver_checkpoints_active()`."""
+    prob = _build_glm_problem(
+        matvec, rmat, dtype, d, y_idx, w, mu, d_scale, total_w,
+        k=k, multinomial=multinomial, lam_l2=lam_l2, fit_intercept=fit_intercept,
+    )
+    k_out, n_flat, unflatten = prob["k_out"], prob["n_flat"], prob["unflatten"]
+    z_of, rowloss, rowloss_alphas = prob["z_of"], prob["rowloss"], prob["rowloss_alphas"]
+    penalty_terms, grad_from_z = prob["penalty_terms"], prob["grad_from_z"]
+
+    if use_l1:
+        from .owlqn import owlqn_minimize_segmented
+
+        def flat_loss(xf):
+            p0, _, _ = penalty_terms(xf, jnp.zeros_like(xf))
+            return rowloss(z_of(xf)) + p0
+
+        l1_mask = jnp.concatenate(
+            [jnp.ones((d * k_out,), dtype), jnp.zeros((k_out,), dtype)]
+        )
+        x0 = jnp.zeros((n_flat,), dtype)
+        xf, obj, n_iter = owlqn_minimize_segmented(
+            flat_loss, x0, l1_mask, lam_l1,
+            max_iter=max_iter, tol=tol, memory=lbfgs_memory,
+            ckpt_key=ckpt_key + ":owlqn", placement_key=placement_key,
+        )
+        stalled = jnp.asarray(False)
+    else:
+        xf, obj, n_iter, stalled = glm_qn_minimize_segmented(
+            z_of, rowloss, rowloss_alphas, grad_from_z, (n_rows, k_out), n_flat,
+            dtype, penalty_terms, max_iter=max_iter, tol=tol, memory=lbfgs_memory,
+            ckpt_key=ckpt_key, placement_key=placement_key,
+        )
+    return _finish_glm(
+        xf, obj, n_iter, stalled, unflatten, d_scale, mu,
+        fit_intercept=fit_intercept, multinomial=multinomial,
+    )
+
+
+def logistic_fit_checkpointed(
+    X: jax.Array,
+    y_idx: jax.Array,
+    w: jax.Array,
+    *,
+    k: int,
+    multinomial: bool,
+    lam_l2: float,
+    lam_l1: float = 0.0,
+    use_l1: bool = False,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    lbfgs_memory: int = 10,
+    ckpt_key: str = "logistic",
+    placement_key=None,
+) -> Dict[str, jax.Array]:
+    """`logistic_fit` with solver checkpoints: same returns, same math
+    (shared closures), segmented loop. The model layer routes here when
+    ``config["checkpoint_every_iters"]`` > 0 and a `CheckpointStore` is
+    active; a same-placement resume is bit-identical to an uninterrupted
+    checkpointed fit (pinned by tests/test_recovery.py)."""
+    d = X.shape[1]
+    mu, d_scale, total_w = _make_scaling(X, w, standardize, fit_intercept)
+    return _fit_common_checkpointed(
+        lambda Beff: X @ Beff, lambda r: X.T @ r, X.shape[0],
+        X.dtype, d, y_idx, w, mu, d_scale, total_w,
+        k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
+        fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
+        lbfgs_memory=lbfgs_memory, ckpt_key=ckpt_key, placement_key=placement_key,
+    )
+
+
+def logistic_fit_ell_checkpointed(
+    values: jax.Array,
+    indices: jax.Array,
+    y_idx: jax.Array,
+    w: jax.Array,
+    *,
+    d: int,
+    k: int,
+    multinomial: bool,
+    lam_l2: float,
+    lam_l1: float = 0.0,
+    use_l1: bool = False,
+    fit_intercept: bool = True,
+    standardize: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    lbfgs_memory: int = 10,
+    ckpt_key: str = "logistic_ell",
+    placement_key=None,
+) -> Dict[str, jax.Array]:
+    """Sparse (padded-ELL) analog of `logistic_fit_checkpointed` — scale-only
+    standardization, same closures as `logistic_fit_ell`, segmented loop."""
+    mu, d_scale, total_w = _ell_scaling(values, indices, w, d, standardize)
+    matvec, rmat = _ell_ops(values, indices, d)
+    return _fit_common_checkpointed(
+        matvec, rmat, values.shape[0],
+        values.dtype, d, y_idx, w, mu, d_scale, total_w,
+        k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
+        fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
+        lbfgs_memory=lbfgs_memory, ckpt_key=ckpt_key, placement_key=placement_key,
+    )
 
 
 @partial(jax.jit, static_argnames=("multinomial",))

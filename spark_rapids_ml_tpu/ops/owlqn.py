@@ -117,7 +117,7 @@ def lbfgs_two_loop(pg, S, Y, rho, count, pos, m):
     return -r
 
 
-def owlqn_minimize(
+def _owlqn_setup(
     smooth_f: Callable[[jax.Array], jax.Array],
     x0: jax.Array,  # flat [n]
     l1_mask: jax.Array,  # [n]: per-coordinate L1 weight multiplier (0 = unpenalized)
@@ -128,11 +128,10 @@ def owlqn_minimize(
     memory: int = 10,
     ls_max: int = 25,
     c1: float = 1e-4,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Minimize smooth_f(x) + lam1 * sum(l1_mask * |x|).
-
-    Returns (x, objective, n_iter). With lam1=0 this degrades to plain
-    two-loop L-BFGS (used as the common path for testing)."""
+):
+    """Build the OWL-QN loop triple ``(cond, body, state0)`` — shared by the
+    one-program `owlqn_minimize` path and the host-segmented checkpointing
+    driver (`owlqn_minimize_segmented`), so both run the IDENTICAL body."""
     n = x0.shape[0]
     m = memory
     lam = lam1 * l1_mask
@@ -218,7 +217,76 @@ def owlqn_minimize(
         (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
         jnp.asarray(jnp.inf, x0.dtype), f0, jnp.asarray(0, jnp.int32), jnp.asarray(False),
     )
+    return cond, body, state0
+
+
+def owlqn_minimize(
+    smooth_f: Callable[[jax.Array], jax.Array],
+    x0: jax.Array,  # flat [n]
+    l1_mask: jax.Array,  # [n]: per-coordinate L1 weight multiplier (0 = unpenalized)
+    lam1: float,
+    *,
+    max_iter: int,
+    tol: float,
+    memory: int = 10,
+    ls_max: int = 25,
+    c1: float = 1e-4,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Minimize smooth_f(x) + lam1 * sum(l1_mask * |x|).
+
+    Returns (x, objective, n_iter). With lam1=0 this degrades to plain
+    two-loop L-BFGS (used as the common path for testing)."""
+    cond, body, state0 = _owlqn_setup(
+        smooth_f, x0, l1_mask, lam1,
+        max_iter=max_iter, tol=tol, memory=memory, ls_max=ls_max, c1=c1,
+    )
     x, _, _, _, _, _, _, obj, n_iter, _ = jax.lax.while_loop(
         cond, freeze_when_done(cond, body), state0
     )
+    return x, obj, n_iter
+
+
+def owlqn_minimize_segmented(
+    smooth_f: Callable[[jax.Array], jax.Array],
+    x0: jax.Array,
+    l1_mask: jax.Array,
+    lam1: float,
+    *,
+    max_iter: int,
+    tol: float,
+    memory: int = 10,
+    ls_max: int = 25,
+    c1: float = 1e-4,
+    ckpt_key: str = "owlqn",
+    placement_key=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """`owlqn_minimize` with the one big ``lax.while_loop`` segmented into
+    outer HOST segments of ``config["checkpoint_every_iters"]`` inner
+    iterations (docs/robustness.md "Elastic recovery"): each segment
+    boundary host-fetches the full iterate — (x, L-BFGS (s, y, rho) memory,
+    n_iter, line-search state) — into the active `CheckpointStore`, and a
+    resumed fit re-enters from the last boundary. The segment body is the
+    SAME traced body as the monolithic loop and the boundary round-trip is
+    lossless, so a same-mesh resume is bit-identical to an uninterrupted
+    segmented run."""
+    import numpy as np
+
+    from .. import checkpoint as _ckpt
+
+    cond, body, state0 = _owlqn_setup(
+        smooth_f, x0, l1_mask, lam1,
+        max_iter=max_iter, tol=tol, memory=memory, ls_max=ls_max, c1=c1,
+    )
+    state = _ckpt.run_segmented_while(
+        cond, body, state0,
+        it_of=lambda s: s[8],  # (x, g, S, Y, rho, meta, f_prev, f_cur, IT, stalled)
+        every=_ckpt.every_iters() or max_iter,
+        store=_ckpt.active_store(),
+        key=ckpt_key,
+        solver="owlqn",
+        placement_key=placement_key,
+        max_iter=max_iter,
+        portable_of=lambda s: {"x": np.asarray(s[0])},
+    )
+    x, _, _, _, _, _, _, obj, n_iter, _ = state
     return x, obj, n_iter

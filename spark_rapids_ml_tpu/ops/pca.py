@@ -70,6 +70,18 @@ def pca_fit(X: jax.Array, w: jax.Array, *, k: int) -> Dict[str, jax.Array]:
     at transform time).
     """
     total_w, mean, cov = weighted_cov(X, w, ddof=1)
+    # one shared finish kernel with the checkpointed path (stats -> model),
+    # so the two entry points cannot drift
+    return _pca_finish(total_w, mean, cov, k=k)
+
+
+@jax.jit
+def _pca_stats(X: jax.Array, w: jax.Array):
+    return weighted_cov(X, w, ddof=1)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _pca_finish(total_w, mean, cov, *, k: int) -> Dict[str, jax.Array]:
     evals, comps = topk_eigh_desc(cov, k)
     evals = jnp.maximum(evals, 0.0)
     comps = sign_flip(comps)
@@ -83,6 +95,48 @@ def pca_fit(X: jax.Array, w: jax.Array, *, k: int) -> Dict[str, jax.Array]:
         "explained_variance_ratio_": ratio,
         "singular_values_": singular_values,
     }
+
+
+def pca_fit_checkpointed(
+    X: jax.Array, w: jax.Array, *, k: int,
+    ckpt_key: str = "pca_stats", placement_key=None,
+) -> Dict[str, jax.Array]:
+    """`pca_fit` with the sufficient statistics — weighted (total_w, mean,
+    covariance), the output of the ONE distributed data pass — retained on
+    host in the active `CheckpointStore` (docs/robustness.md "Elastic
+    recovery"). A transient retry (or a k sweep in the same fit stage)
+    re-runs only the replicated d×d eigendecomposition from the retained
+    statistics; the data pass is never repeated (``checkpoint.stats_reuses``).
+    Identical math to `pca_fit`: same stats kernel, same finish kernel."""
+    import numpy as np
+
+    from .. import checkpoint as _ckpt
+    from ..parallel import chaos
+
+    store = _ckpt.active_store()
+
+    def compute() -> Dict:
+        total_w, mean, cov = _pca_stats(X, w)
+        return {
+            "total_w": np.asarray(total_w),
+            "mean": np.asarray(mean),
+            "cov": np.asarray(cov),
+        }
+
+    if store is not None:
+        state = store.get_or_compute(
+            ckpt_key, compute, solver="pca", placement_key=placement_key
+        )
+    else:
+        state = compute()
+    chaos.maybe_fail_stage("solve", 0)  # after retention: retries reuse stats
+    dtype = X.dtype
+    return _pca_finish(
+        jnp.asarray(state["total_w"], dtype),
+        jnp.asarray(state["mean"], dtype),
+        jnp.asarray(state["cov"], dtype),
+        k=k,
+    )
 
 
 @partial(jax.jit, static_argnames=("whiten",))

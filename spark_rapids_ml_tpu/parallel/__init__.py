@@ -25,6 +25,7 @@ from .mesh import (  # noqa: F401
     row_sharding,
     set_devices,
     shard_row_slices,
+    survivor_mesh,
 )
 from .partition import PartitionDescriptor  # noqa: F401
 from .context import (  # noqa: F401

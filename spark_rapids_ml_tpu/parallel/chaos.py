@@ -24,6 +24,9 @@
 #
 # Every entry fires at most `times` times (default 1), so a retried attempt
 # runs clean — exactly the transient-fault shape the fit driver retries.
+# `rank=` names the ORIGINAL rank identity (`Rendezvous.orig_rank`): after a
+# membership reform renumbers survivors, the fault keeps targeting the same
+# physical process, never whoever inherited its index.
 #
 from __future__ import annotations
 
@@ -58,6 +61,12 @@ class Fault:
     seconds: float = 0.0  # `delay` faults: how long
     reason: str = "chaos"  # `abort` faults: published reason
     times: int = 1  # how many firings remain
+    # `kill` faults: a kill+rejoin recovery injection — the harness driving
+    # the plan relaunches the victim, which rejoins the reformed group at
+    # the epoch boundary (FileRendezvous.rejoin). Informational to the
+    # in-process injector (the kill itself is identical); consumed by
+    # subprocess harnesses (tests/chaos_worker.py, ci/chaos_smoke.py).
+    respawn: int = 0
     fired: int = field(default=0)
 
     def spent(self) -> bool:
@@ -96,6 +105,8 @@ def parse_fault_plan(spec: str) -> List[Fault]:
                 fault.reason = v
             elif k == "times":
                 fault.times = int(v)
+            elif k == "respawn":
+                fault.respawn = int(v)
             else:
                 raise ValueError(f"unknown fault field {k!r} in plan entry {entry!r}")
         if fault.kind == "fail":
@@ -174,10 +185,17 @@ class ChaosRendezvous(Rendezvous):
         from .. import diagnostics
 
         for f in self.plan:
+            # rank= targets the ORIGINAL rank identity, stable across
+            # reforms. Matching the CURRENT index re-targets the fault onto
+            # an innocent survivor after renumbering: kill rank=1, reform to
+            # [0, 2], and the orig-2 survivor (now current rank 1, its own
+            # per-process firing ledger still unspent) kills itself at the
+            # same round of the recovery attempt — a second loss that
+            # exhausts the budget (found by the kill-at-every-round sweep).
             if (
                 f.kind == "fail"
                 or f.spent()
-                or f.rank != self.rank
+                or f.rank != self.orig_rank
                 or f.round != round_index
             ):
                 continue
@@ -192,7 +210,7 @@ class ChaosRendezvous(Rendezvous):
                 seconds=f.seconds if f.kind == "delay" else None,
             )
             if f.kind == "delay":
-                time.sleep(f.seconds)
+                time.sleep(f.seconds)  # sleep-ok: plan-bounded injected delay
             elif f.kind == "abort":
                 self.inner.abort(f.reason)
                 raise RuntimeError(
@@ -203,13 +221,13 @@ class ChaosRendezvous(Rendezvous):
                 # own deadline so the failure is the same symmetric timeout
                 # the peers raise
                 timeout_s = self.inner._round_timeout_s()
-                time.sleep(timeout_s)
+                time.sleep(timeout_s)  # sleep-ok: waits out OUR OWN round deadline (drop = symmetric timeout)
                 self._raise_timeout(round_index, None, timeout_s)
             elif f.kind == "kill":
                 # the hard-death case: no abort file, no atexit, no flush —
                 # exactly what a preempted/OOM-killed TPU host looks like
                 os.kill(os.getpid(), signal.SIGKILL)
-                time.sleep(60)  # pragma: no cover - SIGKILL delivery race
+                time.sleep(60)  # sleep-ok: SIGKILL already sent to self  # pragma: no cover - delivery race
 
     def _allgather_impl(self, payload: str) -> List[str]:
         round_index = self._round
@@ -224,6 +242,43 @@ class ChaosRendezvous(Rendezvous):
         self.inner.begin_epoch(epoch)
         self._round = 0
         self._epoch = int(epoch)
+
+    # -- elastic membership: the plan (and its fired state) RIDES the
+    # recovery — a reformed group stays under chaos, so multi-fault plans
+    # (kill, recover, kill again) exercise the bounded-losses path
+    @property
+    def can_reform(self) -> bool:
+        return getattr(self.inner, "can_reform", False)
+
+    @property
+    def live_ranks(self):
+        return self.inner.live_ranks
+
+    @property
+    def orig_rank(self):
+        return self.inner.orig_rank
+
+    @property
+    def reform_generation(self):
+        return getattr(self.inner, "reform_generation", 0)
+
+    def reform(self, dead_ranks=(), generation: int = 1) -> "ChaosRendezvous":
+        from .. import diagnostics
+
+        new_inner = self.inner.reform(dead_ranks=dead_ranks, generation=generation)
+        diagnostics.record_event(
+            "chaos_reform", generation=int(generation),
+            survivors=list(getattr(new_inner, "live_ranks", [])),
+        )
+        wrapped = ChaosRendezvous(new_inner, self.plan)
+        wrapped.rank, wrapped.nranks = new_inner.rank, new_inner.nranks
+        return wrapped
+
+    def rejoin(self, generation=None) -> "ChaosRendezvous":
+        new_inner = self.inner.rejoin(generation)
+        wrapped = ChaosRendezvous(new_inner, self.plan)
+        wrapped.rank, wrapped.nranks = new_inner.rank, new_inner.nranks
+        return wrapped
 
     def close(self) -> None:
         self.inner.close()

@@ -14,8 +14,10 @@
 #
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import re
 import socket
 import threading
 import time
@@ -168,6 +170,38 @@ class Rendezvous:
     rank: int
     nranks: int
 
+    # --- elastic membership (docs/robustness.md "Elastic recovery") -------
+    # Whether this substrate can agree on a reduced live-rank set after a
+    # peer dies (`reform`). Substrates with their own supervisor (Spark
+    # barrier stages) leave this False: the stage fails and Spark relaunches.
+    can_reform: bool = False
+    # Original rank ids of the current membership, in current-rank order
+    # (identity for a never-reformed group). `reform` results carry the
+    # surviving subset so failures and post-mortems keep naming ORIGINAL
+    # ranks across recovery epochs.
+    _live_ranks: Optional[List[int]] = None
+    reform_generation: int = 0
+
+    @property
+    def live_ranks(self) -> List[int]:
+        return list(self._live_ranks) if self._live_ranks is not None else list(range(self.nranks))
+
+    @property
+    def orig_rank(self) -> int:
+        return self.live_ranks[self.rank]
+
+    def reform(self, dead_ranks=(), generation: int = 1) -> "Rendezvous":
+        """Membership reform round: agree with the other live ranks on the
+        surviving rank set (admitting any respawned rank that votes within
+        the window) and return a NEW rendezvous over it — fresh namespace,
+        ranks renumbered 0..len(live)-1, `live_ranks` mapping back to the
+        original ids. `dead_ranks` (ORIGINAL ids) seeds the known-dead set;
+        the protocol converges on votes + liveness beyond the hint."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support membership reform; "
+            "rank failures stay terminal on this substrate"
+        )
+
     def allgather(self, payload: str) -> List[str]:
         from .. import diagnostics, telemetry
 
@@ -281,6 +315,8 @@ class LocalRendezvous(Rendezvous):
     (tests/conftest.py:44-70 there): real collective code paths, one machine.
     """
 
+    can_reform = True
+
     class _Shared:
         def __init__(self, nranks: int):
             self.barrier = threading.Barrier(nranks)
@@ -288,6 +324,10 @@ class LocalRendezvous(Rendezvous):
             self.lock = threading.Lock()
             self.abort_info: Optional[Tuple[int, str]] = None
             self.epoch = 0
+            # generation -> (live original-rank list, the survivors' _Shared):
+            # the FIRST reformer builds the entry; peers adopt it, so every
+            # survivor agrees on one membership + one fresh barrier
+            self.reforms: dict = {}
 
     def __init__(self, rank: int, shared: "_Shared", timeout_s: Optional[float] = None):
         self.rank = rank
@@ -302,6 +342,38 @@ class LocalRendezvous(Rendezvous):
         shared = cls._Shared(nranks)
         return [cls(r, shared, timeout_s) for r in range(nranks)]
 
+    def reform(self, dead_ranks=(), generation: int = 1) -> "LocalRendezvous":
+        """Thread-substrate membership reform: the first surviving rank to
+        arrive computes the live set (current membership minus `dead_ranks`)
+        and builds the survivors' fresh shared barrier; later arrivals adopt
+        that entry, so all survivors agree by construction."""
+        from .. import diagnostics, telemetry
+
+        shared = self._shared
+        generation = int(generation)
+        with shared.lock:
+            entry = shared.reforms.get(generation)
+            if entry is None:
+                dead = {int(r) for r in dead_ranks}
+                live = [r for r in self.live_ranks if r not in dead]
+                if not live:
+                    raise RankFailedError(-1, "reform left no live ranks", round_index=None)
+                entry = (live, LocalRendezvous._Shared(len(live)))
+                shared.reforms[generation] = entry
+        live, new_shared = entry
+        if self.orig_rank not in live:
+            raise RankFailedError(
+                self.orig_rank, "this rank was declared dead by the reform round"
+            )
+        new = LocalRendezvous(live.index(self.orig_rank), new_shared, self.timeout_s)
+        new._live_ranks = list(live)
+        new.reform_generation = generation
+        telemetry.registry().inc("rendezvous.reforms")
+        diagnostics.record_event(
+            "recovery_reform", generation=generation, survivors=list(live)
+        )
+        return new
+
     def abort(self, reason: str) -> None:
         """Publish ``ABORT:<rank>:<reason>`` (extra slot write) and break the
         barrier so every peer blocked in `barrier.wait` wakes immediately
@@ -312,7 +384,15 @@ class LocalRendezvous(Rendezvous):
         with shared.lock:
             if shared.abort_info is None:
                 shared.abort_info = (self.rank, str(reason))
-                shared.slots[self.rank] = format_abort(self.rank, reason)
+                cur = shared.slots[self.rank]
+                if not (isinstance(cur, tuple) and cur[0] == self._epoch):
+                    # leave a current-epoch payload in place: peers that
+                    # completed the round's data barrier but have not yet
+                    # copied the slots must still receive the full round (a
+                    # rank dying BETWEEN rounds must not retroactively tear
+                    # the round it finished); they learn of the abort from
+                    # `abort_info` via the broken release fence instead
+                    shared.slots[self.rank] = format_abort(self.rank, reason)
         telemetry.registry().inc("rendezvous.aborts_published")
         diagnostics.record_event("abort_published", reason=str(reason)[:200])
         diagnostics.flight_recorder().dump(reason="abort published")
@@ -377,7 +457,26 @@ class LocalRendezvous(Rendezvous):
         shared.slots[self.rank] = (self._epoch, round_index, payload)  # type: ignore[assignment]
         self._wait(round_index, timeout_s)
         out_tagged = list(shared.slots)
-        self._wait(round_index, timeout_s)  # don't let a fast rank overwrite slots early
+        try:
+            self._wait(round_index, timeout_s)  # don't let a fast rank overwrite slots early
+        except (RankFailedError, RendezvousTimeoutError):
+            # The first wait tripped, so every rank published this round and
+            # our copy above is the complete exchange; only the RELEASE FENCE
+            # broke — a peer died between completing this round and entering
+            # the next. If the copy is consistent for (epoch, round), the
+            # round happened: return it so survivors keep the progress (and
+            # the checkpoint) it carries. The failure still surfaces at the
+            # next round's entry fail-fast. A torn copy re-raises. Late
+            # copiers are safe because after an abort no rank writes slots
+            # again (entry fail-fast precedes the slot write) and `abort`
+            # never clobbers a current-epoch payload.
+            if not all(
+                isinstance(item, tuple)
+                and item[0] == self._epoch
+                and item[1] == round_index
+                for item in out_tagged
+            ):
+                raise
         out: List[str] = []
         for r, item in enumerate(out_tagged):
             aborted = parse_abort(item) if isinstance(item, str) else None
@@ -437,6 +536,8 @@ class FileRendezvous(Rendezvous):
     atomic.
     """
 
+    can_reform = True
+
     def __init__(
         self,
         rank: int,
@@ -445,11 +546,20 @@ class FileRendezvous(Rendezvous):
         timeout_s: Optional[float] = None,
         run_id: Optional[str] = None,
         heartbeat_interval_s: Optional[float] = None,
+        live_ranks: Optional[List[int]] = None,
+        anchor_root: Optional[str] = None,
     ):
         """`run_id` should be a fresh nonce minted by the LAUNCHER and passed to
         every rank — it namespaces this run's rounds so stale files from a
         previous run in the same root can never be read as current. Without it,
         the caller must guarantee `root` is a fresh directory per run.
+
+        `anchor_root` (set by `reform`, never by launchers) pins the reform /
+        rejoin coordination directory to the ORIGINAL run root across
+        generations: reformed planes nest under ``<anchor>/reform_g<N>/plane``,
+        so a respawned rank constructing over the original root and a
+        twice-reformed survivor still agree on where membership windows open
+        and where rejoin markers appear.
 
         `timeout_s` is the per-round deadline (None -> the framework's
         ``config["rendezvous_timeout_s"]``). `heartbeat_interval_s` (None ->
@@ -462,6 +572,7 @@ class FileRendezvous(Rendezvous):
         self.rank = rank
         self.nranks = nranks
         self.root = os.path.join(root, run_id) if run_id else root
+        self._anchor = anchor_root if anchor_root else self.root
         self.timeout_s = timeout_s
         self._round = 0
         self._epoch = 0
@@ -477,7 +588,66 @@ class FileRendezvous(Rendezvous):
         # clock, never writer-clock vs reader-clock — cross-host skew on a
         # shared FS must not kill healthy ranks
         self._hb_seen: dict = {}
+        self._live_ranks = list(live_ranks) if live_ranks is not None else None
         os.makedirs(self.root, exist_ok=True)
+        # stale-state hygiene: when the caller reuses a root WITHOUT a fresh
+        # run_id, a previous crashed run's `abort_rank_<r>` file for OUR rank
+        # would poison this run's peers into declaring us instantly dead —
+        # each rank removes its own stale abort markers (every epoch prefix)
+        # before any peer can scan them. run_id-namespaced roots never
+        # collide, so this is a no-op there.
+        if run_id is None:
+            pat = re.compile(
+                rf"^((e\d+_)?abort|rejoin_wait)_rank_{self.rank}$"
+            )
+            try:
+                for name in os.listdir(self.root):
+                    if pat.match(name):
+                        with contextlib.suppress(OSError):
+                            os.unlink(os.path.join(self.root, name))
+            except OSError:  # pragma: no cover - racing cleanup is best-effort
+                pass
+            if anchor_root is None:
+                self._clean_stale_reform_dirs()
+        # heartbeat from CONSTRUCTION, not first allgather: a rank that dies
+        # between the two leaves a STALE file (detectable within the
+        # staleness window) instead of NO file (indistinguishable from a
+        # peer still importing, so survivors would wait out the full round
+        # deadline — found by the kill-at-round-0 chaos sweep)
+        self._ensure_heartbeat()
+
+    def _clean_stale_reform_dirs(self) -> None:
+        """Root-reuse hygiene (no run_id, original-root construction only): a
+        previous crashed run's ``reform_g*`` trees would poison this run's
+        first recovery epoch — stale member votes close the window instantly
+        with the wrong live set, and the stale plane's round files corrupt
+        the confirmation allgather. Only trees with NO recent file activity
+        are removed: a LIVE window (a peer already reforming, or survivors
+        still heartbeating on a reformed plane while we respawn) keeps fresh
+        vote/heartbeat mtimes and is left alone."""
+        import shutil
+
+        bound = max(
+            60.0,
+            2.0 * self._round_timeout_s(),
+            4.0 * max(0.0, self.heartbeat_interval_s),
+        )
+        now = time.time()
+        try:
+            names = [
+                n for n in os.listdir(self.root) if re.match(r"^reform_g\d+$", n)
+            ]
+        except OSError:  # pragma: no cover - root vanished
+            return
+        for name in names:
+            tree = os.path.join(self.root, name)
+            newest = 0.0
+            for dirpath, _dirnames, filenames in os.walk(tree):
+                for entry in [dirpath] + [os.path.join(dirpath, f) for f in filenames]:
+                    with contextlib.suppress(OSError):
+                        newest = max(newest, os.path.getmtime(entry))
+            if now - newest > bound:
+                shutil.rmtree(tree, ignore_errors=True)
 
     # -- file layout -------------------------------------------------------
     def _eprefix(self) -> str:
@@ -490,6 +660,13 @@ class FileRendezvous(Rendezvous):
 
     def _heartbeat_path(self, rank: int) -> str:
         return os.path.join(self.root, f"heartbeat_rank_{rank}")
+
+    def _rejoin_wait_path(self, orig_rank: int) -> str:
+        # keyed by ORIGINAL rank id (stable across reforms), epoch-less (the
+        # marker describes an incarnation, not a round), and ANCHORED at the
+        # original run root — a respawn writing over the original root and a
+        # reformed survivor scanning from its g<N> plane must agree on it
+        return os.path.join(self._anchor, f"rejoin_wait_rank_{orig_rank}")
 
     # -- heartbeat ---------------------------------------------------------
     def _touch_heartbeat(self) -> None:
@@ -556,10 +733,201 @@ class FileRendezvous(Rendezvous):
 
         diagnostics.record_event("epoch_begin", epoch=int(epoch))
 
+    # -- membership reform (elastic recovery) -----------------------------
+    def _reform_dir(self, generation: int) -> str:
+        # anchored: generation N+1's window must be discoverable both by
+        # survivors rooted at the g<N> plane and by a respawn constructing
+        # over the ORIGINAL root
+        return os.path.join(self._anchor, f"reform_g{int(generation)}")
+
+    def latest_generation(self) -> Optional[int]:
+        """Highest reform generation already opened under the anchor root
+        (how a respawned rank discovers which epoch boundary to rejoin at)."""
+        best = None
+        try:
+            for name in os.listdir(self._anchor):
+                m = re.match(r"^reform_g(\d+)$", name)
+                if m:
+                    g = int(m.group(1))
+                    best = g if best is None else max(best, g)
+        except OSError:  # pragma: no cover - root vanished
+            return None
+        return best
+
+    def rejoin(self, generation: Optional[int] = None) -> "FileRendezvous":
+        """Respawned-rank entry point: vote in the open reform round (found
+        via `latest_generation` when not given) and join the reformed group
+        at the epoch boundary. With no generation given, POLLS for a reform
+        window to open (deadline-bounded) — a respawned process typically
+        launches while survivors are still detecting the death, before any
+        window exists. The survivors' window must still be open when the vote
+        lands (``config["recovery_rejoin_grace_s"]`` keeps it open for
+        prompt respawns).
+
+        Entry publishes a ``rejoin_wait_rank_<orig>`` marker FIRST: this
+        incarnation's heartbeat resumes touching the dead rank's liveness
+        file from construction, which would otherwise make the corpse look
+        alive to survivors blocked in a round — they'd wait out the full
+        round deadline instead of detecting the death within the heartbeat
+        budget (and this rejoiner's window poll can expire before any reform
+        opens). The marker is positive evidence the ORIGINAL incarnation
+        died, so survivors raise RankFailedError within one failure-scan
+        tick and the reform window opens while we are still polling for it.
+        The marker is removed on admission."""
+        me = self.orig_rank
+        tmp = os.path.join(self.root, f".rejoin_wait_rank_{me}.tmp")
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps({"rank": me, "t": time.time()}))  # sink-ok: control-plane marker payload, not a telemetry record
+            os.replace(tmp, self._rejoin_wait_path(me))
+        except OSError:  # pragma: no cover - best-effort; survivors fall back to the round deadline
+            pass
+        if generation is None:
+            deadline = time.monotonic() + self._round_timeout_s()
+            while True:  # blocking-ok: deadline-bounded window poll
+                generation = self.latest_generation()
+                if generation is not None:
+                    break
+                if time.monotonic() > deadline:
+                    raise RendezvousTimeoutError(
+                        "rejoin: no reform round opened under this root "
+                        "within the deadline",
+                        timeout_s=self._round_timeout_s(),
+                    )
+                time.sleep(0.02)  # sleep-ok: poll tick inside the deadline-bounded rejoin wait
+        reformed = self.reform(dead_ranks=(), generation=generation)
+        with contextlib.suppress(OSError):
+            os.unlink(self._rejoin_wait_path(me))
+        return reformed
+
+    def reform(self, dead_ranks=(), generation: int = 1) -> "FileRendezvous":
+        """File-substrate membership reform.
+
+        Each participant votes by writing ``member_rank_<orig>`` under
+        ``reform_g<generation>`` (write-then-rename), then waits until every
+        currently-expected rank has either voted or is evidently dead (its
+        abort file exists, or its heartbeat/vote never materializes within
+        the staleness window). Votes from OUTSIDE the expected set — a
+        respawned rank rejoining — are admitted. The window stays open at
+        least ``config["recovery_rejoin_grace_s"]`` so a prompt respawn is
+        admitted deterministically. The agreed live set is then CONFIRMED
+        with one allgather round on the reformed plane: any membership
+        mismatch (a straggler vote landing after one side closed) surfaces
+        as the transient `RendezvousTimeoutError`, never a silently split
+        group."""
+        from .. import diagnostics, telemetry
+        from ..core import config
+
+        generation = int(generation)
+        member_dir = self._reform_dir(generation)
+        os.makedirs(member_dir, exist_ok=True)
+        me = self.orig_rank
+        tmp = os.path.join(member_dir, f".member_rank_{me}.tmp")
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"rank": me, "t": time.time()}))  # sink-ok: control-plane vote payload, not a telemetry record
+        os.replace(tmp, os.path.join(member_dir, f"member_rank_{me}"))
+
+        dead = {int(r) for r in dead_ranks}
+        expected = set(self.live_ranks)
+        live_map = self.live_ranks  # current index <- position of orig id
+        stale_after = (
+            _HEARTBEAT_MISS_FACTOR * self.heartbeat_interval_s
+            if self.heartbeat_interval_s > 0
+            else 2.0
+        )
+        grace = float(config.get("recovery_rejoin_grace_s", 0.0))
+        timeout_s = self._round_timeout_s()
+        start = time.monotonic()
+        deadline = start + timeout_s
+        member_pat = re.compile(r"^member_rank_(\d+)$")
+        while True:  # blocking-ok: deadline- and staleness-bounded vote scan
+            filed = set()
+            for name in os.listdir(member_dir):
+                m = member_pat.match(name)
+                if m:
+                    filed.add(int(m.group(1)))
+            now_m = time.monotonic()
+            pending = expected - filed - dead
+            for r in list(pending):
+                cur = live_map.index(r)
+                if os.path.exists(self._abort_path(cur)):
+                    dead.add(r)
+                    pending.discard(r)
+                    continue
+                # no vote yet: alive only if its heartbeat keeps progressing
+                try:
+                    mtime = os.path.getmtime(self._heartbeat_path(cur))
+                except OSError:
+                    mtime = None
+                seen = self._hb_seen.get(("reform", r))
+                if mtime is not None and (seen is None or mtime != seen[0]):
+                    self._hb_seen[("reform", r)] = (mtime, now_m)
+                    continue
+                base_t = seen[1] if seen is not None else start
+                if now_m - base_t > stale_after:
+                    dead.add(r)
+                    pending.discard(r)
+            if not pending and (
+                now_m - start >= grace
+                # every ORIGINALLY-expected member (incl. a respawned
+                # incarnation of a dead rank) has voted: no further vote can
+                # arrive, so the grace window may close early — a prompt
+                # rejoin doesn't cost survivors the full grace wait
+                or filed >= expected
+            ):
+                break
+            if now_m > deadline:
+                telemetry.registry().inc("rendezvous.timeouts")
+                raise RendezvousTimeoutError(
+                    f"reform generation {generation}: ranks {sorted(pending)} "
+                    f"neither voted nor died within {timeout_s}s",
+                    missing_ranks=sorted(pending),
+                    timeout_s=timeout_s,
+                )
+            time.sleep(0.01)  # sleep-ok: poll tick inside the deadline-bounded reform scan
+        # a VOTE proves a live process — the dead set only governs who the
+        # window stops waiting for. A respawned incarnation of a killed rank
+        # that votes inside the window is admitted even though its original
+        # id was seeded dead (that is the whole rejoin path).
+        live = sorted(filed)
+        if me not in live or not live:
+            raise RankFailedError(
+                me, "this rank was excluded by the reform round", round_index=None
+            )
+        new = FileRendezvous(
+            live.index(me),
+            len(live),
+            os.path.join(member_dir, "plane"),
+            timeout_s=self.timeout_s,
+            heartbeat_interval_s=self.heartbeat_interval_s,
+            live_ranks=live,
+            anchor_root=self._anchor,
+        )
+        new.reform_generation = generation
+        # confirmation round: every member states the set it computed; a
+        # mismatch means a vote landed after somebody closed the window
+        confirmed = new.allgather("REFORM:" + json.dumps(live))
+        if any(p != confirmed[0] for p in confirmed):
+            telemetry.registry().inc("rendezvous.timeouts")
+            raise RendezvousTimeoutError(
+                f"reform generation {generation}: members disagree on the "
+                "live set (vote landed after the window closed)",
+                timeout_s=timeout_s,
+            )
+        telemetry.registry().inc("rendezvous.reforms")
+        diagnostics.record_event(
+            "recovery_reform", generation=generation, survivors=live,
+            dead=sorted(dead),
+        )
+        return new
+
     def _check_failures(self, pending, round_index: int) -> None:
         """Raise RankFailedError when any rank published an abort for this
-        epoch, or a PENDING peer's heartbeat went stale (killed process —
-        it cannot publish anything)."""
+        epoch, a PENDING peer's respawned incarnation announced it is
+        waiting to rejoin (the original is dead even though the respawn's
+        heartbeat keeps the liveness file fresh), or a PENDING peer's
+        heartbeat went stale (killed process — it cannot publish
+        anything)."""
         for r in range(self.nranks):
             if r == self.rank:
                 continue
@@ -572,6 +940,26 @@ class FileRendezvous(Rendezvous):
                     parsed = None
                 rank, reason = parsed if parsed is not None else (r, "abort file unreadable")
                 self._raise_rank_failed(rank, reason, round_index)
+        live = self.live_ranks
+        for r in pending:
+            if r == self.rank:
+                continue
+            # a rejoin marker is POSITIVE death evidence for the original
+            # incarnation — and it must outrank heartbeat progress, because
+            # the respawn resumes touching the same liveness file from
+            # construction (a corpse that looks alive would otherwise pin
+            # survivors in this round until the full deadline)
+            if os.path.exists(self._rejoin_wait_path(live[r])):
+                # raise the CURRENT index (like the abort/heartbeat paths —
+                # recoverable_stage maps failed_rank through live_ranks once;
+                # raising the original id here would double-map it after a
+                # prior reform and blame an innocent survivor)
+                self._raise_rank_failed(
+                    r,
+                    f"process died (original rank {live[r]}); a respawned "
+                    "incarnation is waiting to rejoin at the next reform round",
+                    round_index,
+                )
         if self.heartbeat_interval_s <= 0:
             return
         stale_after = _HEARTBEAT_MISS_FACTOR * self.heartbeat_interval_s
@@ -629,7 +1017,7 @@ class FileRendezvous(Rendezvous):
                     next_failure_scan = now_m + _FAILURE_SCAN_INTERVAL_S
                 if now_m > deadline:
                     self._raise_timeout(round_index, sorted(pending), timeout_s)
-                time.sleep(0.005)
+                time.sleep(0.005)  # sleep-ok: poll tick inside the deadline-bounded round wait
         return out  # type: ignore[return-value]
 
 
@@ -698,6 +1086,43 @@ class TpuContext:
     def current(cls) -> Optional["TpuContext"]:
         """The context entered by the caller, if any (estimators consult this)."""
         return _ACTIVE_CONTEXT
+
+    def adopt_reform(self, new_rendezvous: "Rendezvous") -> None:
+        """Adopt a reformed (survivor) rendezvous: renumbered rank/nranks,
+        and the mesh rebuilt over the survivors' devices (the dead rank's
+        chips leave the mesh; its row shards are re-placed from
+        host-retained ingest chunks when the fit re-enters). Called by
+        `core.recoverable_stage` at each recovery epoch."""
+        old_live = set(self.live_ranks_hint())
+        self.rendezvous = new_rendezvous
+        self.rank = new_rendezvous.rank
+        self.nranks = new_rendezvous.nranks
+        self.recovery_generation = int(getattr(new_rendezvous, "reform_generation", 0))
+        dead_procs = old_live - set(
+            getattr(new_rendezvous, "live_ranks", range(new_rendezvous.nranks))
+        )
+        if self.mesh is not None and dead_procs:
+            import jax
+
+            from .mesh import survivor_mesh
+
+            if jax.process_count() > 1:
+                try:
+                    self.mesh = survivor_mesh(self.mesh, dead_procs)
+                except Exception as e:  # pragma: no cover - backend-specific
+                    from ..utils import get_logger
+
+                    get_logger("TpuContext").warning(
+                        "could not rebuild the mesh over survivors (%s: %s); "
+                        "keeping the previous mesh", type(e).__name__, e,
+                    )
+
+    def live_ranks_hint(self) -> List[int]:
+        """Original rank ids of the current membership (identity when the
+        rendezvous tracks none)."""
+        if self.rendezvous is not None:
+            return list(getattr(self.rendezvous, "live_ranks", range(self.nranks)))
+        return list(range(self.nranks))
 
     @property
     def is_spmd(self) -> bool:

@@ -95,6 +95,21 @@ def get_mesh(num_workers: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices[:num_workers]), (ROWS_AXIS,))
 
 
+def survivor_mesh(mesh: Mesh, dead_process_indices) -> Mesh:
+    """Rebuild a 1-D `rows` mesh over the devices NOT owned by the dead
+    processes — the re-sharding half of elastic recovery: under GSPMD a rank
+    loss is a mesh + placement change, not a solver rewrite
+    (docs/robustness.md "Elastic recovery"). Raises when no devices survive."""
+    dead = {int(p) for p in dead_process_indices}
+    devices = [d for d in mesh.devices.flatten() if int(d.process_index) not in dead]
+    if not devices:
+        raise ValueError("survivor_mesh: no devices remain after excluding "
+                         f"processes {sorted(dead)}")
+    if telemetry.enabled():
+        telemetry.registry().inc("recovery.mesh_rebuilds")
+    return Mesh(np.asarray(devices), (ROWS_AXIS,))
+
+
 def row_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """NamedSharding that shards axis 0 over `rows` and replicates the rest."""
     return NamedSharding(mesh, P(ROWS_AXIS, *([None] * (ndim - 1))))

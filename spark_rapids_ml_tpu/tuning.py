@@ -12,6 +12,8 @@
 #
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from multiprocessing.pool import ThreadPool
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -26,6 +28,113 @@ def _scoring_labels(pdf, est, eva) -> np.ndarray:
     """Held-out labels for fold scoring; the evaluator's labelCol governs
     (it may differ from the estimator's)."""
     return pdf[evaluator_label_column(est, eva)].to_numpy(dtype=np.float64)
+
+
+class SweepLedger:
+    """Completion ledger for one tuning sweep (docs/robustness.md "Elastic
+    recovery"): each finished (fold, paramMap) fit's metric — and its model,
+    for collectSubModels — is recorded keyed by the sweep's trace_id, so a
+    sweep that fails mid-flight (a rank loss that exhausted the recovery
+    budget, a rendezvous timeout past its retries) RESUMES at the first
+    incomplete fit instead of restarting from zero. Finished fits are never
+    redone; the ``sweep.fits_completed`` / ``sweep.fits_skipped`` /
+    ``sweep.resumes`` counters make that assertable from telemetry alone.
+
+    Thread-safe (folds may run on a ThreadPool). Entries live in-process for
+    the duration of the sweep call; the module registry (`sweep_ledger`)
+    keeps the last few ledgers around for inspection."""
+
+    def __init__(self, trace_id: Optional[str], num_folds: int, num_models: int):
+        self.trace_id = trace_id
+        self.num_folds = int(num_folds)
+        self.num_models = int(num_models)
+        self._metrics: Dict[Tuple[int, int], float] = {}
+        self._models: Dict[Tuple[int, int], Any] = {}
+        self._lock = threading.Lock()
+
+    def complete(self, fold: int, idx: int, metric: float, model: Any = None) -> None:
+        from . import diagnostics, telemetry
+
+        with self._lock:
+            fresh = (fold, idx) not in self._metrics
+            self._metrics[(fold, idx)] = float(metric)
+            if model is not None:
+                self._models[(fold, idx)] = model
+        if fresh:
+            telemetry.registry().inc("sweep.fits_completed")
+            diagnostics.record_event(
+                "sweep_fit_completed", fold=int(fold), param_map=int(idx)
+            )
+
+    def complete_fold(self, fold: int, metrics, models: Optional[List[Any]] = None) -> None:
+        for j, m in enumerate(np.asarray(metrics, dtype=np.float64)):
+            self.complete(fold, j, float(m), models[j] if models else None)
+
+    def is_done(self, fold: int, idx: int) -> bool:
+        with self._lock:
+            return (fold, idx) in self._metrics
+
+    def fold_done(self, fold: int) -> bool:
+        with self._lock:
+            return all((fold, j) in self._metrics for j in range(self.num_models))
+
+    def metric(self, fold: int, idx: int) -> float:
+        with self._lock:
+            return self._metrics[(fold, idx)]
+
+    def model(self, fold: int, idx: int) -> Any:
+        with self._lock:
+            return self._models.get((fold, idx))
+
+    def fold_metrics(self, fold: int) -> np.ndarray:
+        with self._lock:
+            return np.asarray(
+                [self._metrics[(fold, j)] for j in range(self.num_models)]
+            )
+
+    def fold_models(self, fold: int) -> Optional[List[Any]]:
+        with self._lock:
+            models = [self._models.get((fold, j)) for j in range(self.num_models)]
+        return models if all(m is not None for m in models) else None
+
+    def count_skipped(self, n: int) -> None:
+        from . import telemetry
+
+        if n > 0:
+            telemetry.registry().inc("sweep.fits_skipped", n)
+
+    def release_models(self) -> None:
+        """Drop model references once the sweep has harvested them: the
+        module registry retains the ledger (metrics) for inspection, and
+        models can pin large host/device buffers for the driver's life."""
+        with self._lock:
+            self._models.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._metrics)
+
+
+# last few sweeps' ledgers, keyed by trace_id (inspection / tests); bounded
+# so long-lived drivers don't accumulate model references forever
+_LEDGERS: "OrderedDict[str, SweepLedger]" = OrderedDict()
+_LEDGERS_LOCK = threading.Lock()
+_LEDGERS_CAP = 8
+
+
+def _register_ledger(ledger: SweepLedger) -> SweepLedger:
+    if ledger.trace_id is not None:
+        with _LEDGERS_LOCK:
+            _LEDGERS[ledger.trace_id] = ledger
+            while len(_LEDGERS) > _LEDGERS_CAP:
+                _LEDGERS.popitem(last=False)
+    return ledger
+
+
+def sweep_ledger(trace_id: str) -> Optional[SweepLedger]:
+    """The completion ledger of a (recent) sweep by its trace_id."""
+    with _LEDGERS_LOCK:
+        return _LEDGERS.get(trace_id)
 
 
 def _engine_eligible(est) -> bool:
@@ -195,14 +304,60 @@ class CrossValidator(_ValidatorParams):
         sub_models: Optional[List[List[Any]]] = [None] * len(folds) if collect_sub else None
         parallelism = min(self.getOrDefault("parallelism"), len(folds))
 
+        # Sweep completion ledger (docs/robustness.md "Elastic recovery"):
+        # every finished (fold, paramMap) fit is recorded keyed by this
+        # sweep's trace_id. A mid-flight control-plane failure that escapes
+        # the per-fit recovery machinery resumes the sweep at the first
+        # incomplete fit — bounded by config["sweep_max_resumes"] — and
+        # finished fits are NEVER redone (sweep.fits_skipped counts the
+        # ledger-served ones).
+        from . import diagnostics
+        from .core import config
+        from .errors import RankFailedError, RendezvousTimeoutError
+
+        tr = diagnostics.current_trace()
+        ledger = _register_ledger(
+            SweepLedger(tr.get("trace_id") if tr else None, len(folds), num_models)
+        )
+
         def run_folds(run_fold) -> None:
-            if parallelism > 1:
-                with ThreadPool(parallelism) as pool:
-                    for i, scores in enumerate(pool.map(run_fold, range(len(folds)))):
-                        metrics[i] = scores
-            else:
-                for i in range(len(folds)):
-                    metrics[i] = run_fold(i)
+            max_resumes = max(0, int(config.get("sweep_max_resumes", 1)))
+
+            def guarded(i):
+                if ledger.fold_done(i):
+                    # completed before the failure: serve from the ledger
+                    ledger.count_skipped(num_models)
+                    if collect_sub and sub_models[i] is None:
+                        sub_models[i] = ledger.fold_models(i)
+                    return ledger.fold_metrics(i)
+                return run_fold(i)
+
+            for attempt in range(max_resumes + 1):
+                try:
+                    if parallelism > 1:
+                        with ThreadPool(parallelism) as pool:
+                            for i, scores in enumerate(pool.map(guarded, range(len(folds)))):
+                                metrics[i] = scores
+                    else:
+                        for i in range(len(folds)):
+                            metrics[i] = guarded(i)
+                    return
+                except (RankFailedError, RendezvousTimeoutError) as e:
+                    if attempt >= max_resumes:
+                        raise
+                    from . import telemetry
+
+                    telemetry.registry().inc("sweep.resumes")
+                    diagnostics.record_event(
+                        "sweep_resume", completed=len(ledger),
+                        error=type(e).__name__,
+                    )
+                    logger.warning(
+                        "sweep failed mid-flight (%s: %s); resuming at the "
+                        "first incomplete fit — %d/%d (fold, paramMap) fits "
+                        "already complete and ledger-served",
+                        type(e).__name__, e, len(ledger), len(folds) * num_models,
+                    )
 
         def pick_best():
             avg = metrics.mean(axis=0)
@@ -247,13 +402,16 @@ class CrossValidator(_ValidatorParams):
                         sub_models[fold_i] = models
                     combined = models[0]._combine(models)
                     feats = scope.last.extracted.features[valid_idx]
-                    return np.asarray(
+                    scores = np.asarray(
                         combined._transform_evaluate_arrays(feats, labels[valid_idx], eva)
                     )
+                    ledger.complete_fold(fold_i, scores, models if collect_sub else None)
+                    return scores
 
                 run_folds(run_fold)
                 avg, std, best_idx = pick_best()
                 best_model = est.copy(epm[best_idx]).fit(pdf)  # reuses the placement
+            ledger.release_models()
             return CrossValidatorModel(
                 bestModel=best_model, avgMetrics=list(avg), stdMetrics=list(std),
                 subModels=sub_models,
@@ -269,13 +427,25 @@ class CrossValidator(_ValidatorParams):
                 if collect_sub:
                     sub_models[fold_i] = models
                 combined = models[0]._combine(models)
-                return np.asarray(combined._transform_evaluate(valid, eva))
+                scores = np.asarray(combined._transform_evaluate(valid, eva))
+                ledger.complete_fold(fold_i, scores, models if collect_sub else None)
+                return scores
             scores = []
             fold_models = []
-            for pm in epm:
+            for j, pm in enumerate(epm):
+                # (fold, paramMap) granularity on the per-model path: a
+                # resume after a mid-fold failure redoes only the maps that
+                # never finished
+                if ledger.is_done(fold_i, j):
+                    ledger.count_skipped(1)
+                    fold_models.append(ledger.model(fold_i, j))
+                    scores.append(ledger.metric(fold_i, j))
+                    continue
                 model = est.copy(pm).fit(train)
+                score = float(eva.evaluate(model.transform(valid)))
+                ledger.complete(fold_i, j, score, model if collect_sub else None)
                 fold_models.append(model)
-                scores.append(eva.evaluate(model.transform(valid)))
+                scores.append(score)
             if collect_sub:
                 sub_models[fold_i] = fold_models
             return np.asarray(scores)
@@ -283,6 +453,7 @@ class CrossValidator(_ValidatorParams):
         run_folds(run_fold)
         avg, std, best_idx = pick_best()
         best_model = est.copy(epm[best_idx]).fit(pdf)
+        ledger.release_models()
         return CrossValidatorModel(
             bestModel=best_model, avgMetrics=list(avg), stdMetrics=list(std), subModels=sub_models
         )
@@ -437,6 +608,45 @@ class TrainValidationSplit(_ValidatorParams):
             "device-resident engine" if engine
             else ("fused single-pass" if accelerated else "fallback per-model"),
         )
+
+        # Sweep completion ledger — the same elastic-recovery contract as
+        # CrossValidator (docs/robustness.md "Elastic recovery"), with one
+        # "fold": a mid-flight control-plane failure resumes at the first
+        # incomplete param-map fit, finished fits ledger-served, bounded by
+        # config["sweep_max_resumes"].
+        from . import diagnostics
+        from .core import config
+        from .errors import RankFailedError, RendezvousTimeoutError
+
+        collect_sub = bool(self.getOrDefault("collectSubModels"))
+        tr = diagnostics.current_trace()
+        ledger = _register_ledger(
+            SweepLedger(tr.get("trace_id") if tr else None, 1, len(epm))
+        )
+
+        def with_resume(run_once):
+            max_resumes = max(0, int(config.get("sweep_max_resumes", 1)))
+            for attempt in range(max_resumes + 1):
+                try:
+                    return run_once()
+                except (RankFailedError, RendezvousTimeoutError) as e:
+                    if attempt >= max_resumes:
+                        raise
+                    from . import telemetry
+
+                    telemetry.registry().inc("sweep.resumes")
+                    diagnostics.record_event(
+                        "sweep_resume", completed=len(ledger),
+                        error=type(e).__name__,
+                    )
+                    logger.warning(
+                        "sweep failed mid-flight (%s: %s); resuming at the "
+                        "first incomplete fit — %d/%d param-map fits already "
+                        "complete and ledger-served",
+                        type(e).__name__, e, len(ledger), len(epm),
+                    )
+            raise AssertionError("unreachable")  # pragma: no cover
+
         if engine:
             # same multi-fit engine as CrossValidator, with one fold: one
             # placement serves the masked grid fit, the sliced held-out
@@ -446,45 +656,80 @@ class TrainValidationSplit(_ValidatorParams):
             labels = _scoring_labels(pdf, est, eva)
             valid_idx = perm[n_train:]
             with device_dataset_scope() as scope:
-                models = est._fit_internal(pdf, list(epm), row_mask=mask)
-                combined = models[0]._combine(models)
-                feats = scope.last.extracted.features[valid_idx]
-                metrics = np.asarray(
-                    combined._transform_evaluate_arrays(feats, labels[valid_idx], eva)
-                )
+
+                def run_grid():
+                    if ledger.fold_done(0):
+                        ledger.count_skipped(len(epm))
+                        return ledger.fold_metrics(0), (
+                            ledger.fold_models(0) if collect_sub else None
+                        )
+                    models = est._fit_internal(pdf, list(epm), row_mask=mask)
+                    combined = models[0]._combine(models)
+                    feats = scope.last.extracted.features[valid_idx]
+                    metrics = np.asarray(
+                        combined._transform_evaluate_arrays(feats, labels[valid_idx], eva)
+                    )
+                    ledger.complete_fold(0, metrics, models if collect_sub else None)
+                    return metrics, models
+
+                metrics, models = with_resume(run_grid)
                 best_idx = int(np.argmax(metrics) if eva.isLargerBetter() else np.argmin(metrics))
                 logger.info(
                     "TrainValidationSplit: best param map %d (metric %.6f)",
                     best_idx, metrics[best_idx],
                 )
                 best_model = est.copy(epm[best_idx]).fit(pdf)  # reuses the placement
-            sub = models if bool(self.getOrDefault("collectSubModels")) else None
+            ledger.release_models()
+            sub = list(models) if collect_sub and models is not None else None
             return TrainValidationSplitModel(
                 bestModel=best_model, validationMetrics=list(metrics), subModels=sub
             )
         if accelerated:
-            models = [m for _, m in sorted(est.fitMultiple(train, epm))]
-            combined = models[0]._combine(models)
-            metrics = np.asarray(combined._transform_evaluate(valid, eva))
+
+            def run_grid():
+                if ledger.fold_done(0):
+                    ledger.count_skipped(len(epm))
+                    return ledger.fold_metrics(0), (
+                        ledger.fold_models(0) if collect_sub else None
+                    )
+                models = [m for _, m in sorted(est.fitMultiple(train, epm))]
+                combined = models[0]._combine(models)
+                metrics = np.asarray(combined._transform_evaluate(valid, eva))
+                ledger.complete_fold(0, metrics, models if collect_sub else None)
+                return metrics, models
+
+            metrics, models = with_resume(run_grid)
         else:
             # parallelism spans PARAM MAPS here (pyspark TVS semantics; CV
-            # parallelizes over folds instead)
+            # parallelizes over folds instead); (paramMap) granularity on
+            # this path — a resume redoes only the maps that never finished
             par = min(int(self.getOrDefault("parallelism")), len(epm))
 
-            def fit_one(pm):
-                return est.copy(pm).fit(train)
+            def fit_score_one(j_pm):
+                j, pm = j_pm
+                if ledger.is_done(0, j):
+                    ledger.count_skipped(1)
+                    return ledger.metric(0, j), ledger.model(0, j)
+                model = est.copy(pm).fit(train)
+                score = float(eva.evaluate(model.transform(valid)))
+                ledger.complete(0, j, score, model if collect_sub else None)
+                return score, model
 
-            if par > 1:
-                with ThreadPool(par) as pool:
-                    models = pool.map(fit_one, epm)
-            else:
-                models = [fit_one(pm) for pm in epm]
-            metrics = np.asarray([eva.evaluate(m.transform(valid)) for m in models])
+            def run_grid():
+                if par > 1:
+                    with ThreadPool(par) as pool:
+                        out = pool.map(fit_score_one, list(enumerate(epm)))
+                else:
+                    out = [fit_score_one(j_pm) for j_pm in enumerate(epm)]
+                return np.asarray([s for s, _ in out]), [m for _, m in out]
+
+            metrics, models = with_resume(run_grid)
 
         best_idx = int(np.argmax(metrics) if eva.isLargerBetter() else np.argmin(metrics))
         logger.info("TrainValidationSplit: best param map %d (metric %.6f)", best_idx, metrics[best_idx])
         best_model = est.copy(epm[best_idx]).fit(pdf)
-        sub = models if bool(self.getOrDefault("collectSubModels")) else None
+        ledger.release_models()
+        sub = list(models) if collect_sub and models is not None else None
         return TrainValidationSplitModel(
             bestModel=best_model, validationMetrics=list(metrics), subModels=sub
         )

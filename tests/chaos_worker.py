@@ -2,14 +2,30 @@
 # Worker for the fault-injection harness (launched by tests/test_chaos.py;
 # the non-test prefix keeps pytest from collecting it).
 #
-# Each rank drives a fixed number of control-plane rounds through a
-# ChaosRendezvous(FileRendezvous) — pure rendezvous traffic, no fit, no XLA
-# backend — with the fault plan inherited from SRML_FAULT_PLAN. Before each
-# round it writes a timestamp mark (so the parent can date a SIGKILL to the
-# round that triggered it), and on exit it writes a JSON result: rounds
-# completed, the typed error class observed, which rank it blamed, and when.
+# Modes (argv[9], default "rounds"):
 #
-# argv: rank nranks rdv_dir out_dir run_id rounds heartbeat_interval_s timeout_s
+#   rounds    Each rank drives a fixed number of control-plane rounds through
+#             a ChaosRendezvous(FileRendezvous) — pure rendezvous traffic, no
+#             fit, no XLA backend — with the fault plan inherited from
+#             SRML_FAULT_PLAN. Before each round it writes a timestamp mark
+#             (so the parent can date a SIGKILL to the round that triggered
+#             it), and on exit a JSON result: rounds completed, the typed
+#             error class observed, which rank it blamed, and when.
+#
+#   recover   The ELASTIC-RECOVERY harness: each rank runs a small
+#             distributed Lloyd fit (numpy + rendezvous collectives — the
+#             control-plane shape of a real SPMD fit without needing
+#             cross-process XLA) under `core.recoverable_stage` with solver
+#             checkpoints on. The dataset derives from a fixed seed (the
+#             host-retained-ingest analog: every survivor can re-derive the
+#             full row set), sharded over the CURRENT live rank set. A
+#             SIGKILLed peer surfaces as RankFailedError; survivors reform,
+#             re-shard, and RESUME from the collective-consistent checkpoint
+#             — the per-attempt resume-consensus round adopts the most
+#             advanced member checkpoint, which also lets a rejoining rank
+#             catch up. `rounds` argv = Lloyd iterations.
+#
+# argv: rank nranks rdv_dir out_dir run_id rounds heartbeat_interval_s timeout_s [mode]
 #
 import json
 import os
@@ -24,6 +40,142 @@ def _write_json(path: str, obj) -> None:
     os.replace(tmp, path)
 
 
+def _recover_dataset(n_rows: int = 240, d: int = 4, k: int = 3):
+    """Deterministic dataset + init — derivable by every rank (and any
+    respawned incarnation) from the seed alone."""
+    import numpy as np
+
+    rng = np.random.default_rng(1234)
+    offsets = rng.normal(scale=6.0, size=(k, d))
+    X = np.concatenate(
+        [rng.normal(size=(n_rows // k, d)) + offsets[c] for c in range(k)]
+    ).astype(np.float64)
+    init = X[rng.choice(len(X), size=k, replace=False)].copy()
+    return X, init
+
+
+def _lloyd_local_sums(X_shard, centers):
+    import numpy as np
+
+    d2 = (
+        np.sum(centers * centers, axis=1)[None, :]
+        - 2.0 * (X_shard @ centers.T)
+    )
+    assign = np.argmin(d2, axis=1)
+    k, d = centers.shape
+    sums = np.zeros((k, d))
+    counts = np.zeros(k)
+    for c in range(k):
+        m = assign == c
+        counts[c] = m.sum()
+        sums[c] = X_shard[m].sum(axis=0)
+    return sums, counts
+
+
+def recover_main(
+    rank: int, nranks: int, rdv_dir: str, out_dir: str, run_id: str,
+    iters: int, heartbeat_interval_s: float, timeout_s: float, *, rejoin: bool,
+) -> None:
+    import numpy as np
+
+    from spark_rapids_ml_tpu import checkpoint as ckpt
+    from spark_rapids_ml_tpu import core, diagnostics, telemetry
+    from spark_rapids_ml_tpu.errors import SrmlError
+    from spark_rapids_ml_tpu.parallel.chaos import ChaosRendezvous
+    from spark_rapids_ml_tpu.parallel.context import FileRendezvous, allgather_ndarray
+
+    diagnostics.set_process_rank(rank)
+    telemetry.enable()
+    core.config["checkpoint_every_iters"] = 2
+    core.config["heartbeat_interval_s"] = heartbeat_interval_s
+    # kill+rejoin runs: the launcher keeps the reform window open long enough
+    # for the respawned incarnation to import + vote
+    core.config["recovery_rejoin_grace_s"] = float(
+        os.environ.get("SRML_TEST_REJOIN_GRACE", "0")
+    )
+
+    base = FileRendezvous(
+        rank, nranks, rdv_dir, timeout_s=timeout_s, run_id=run_id,
+        heartbeat_interval_s=heartbeat_interval_s,
+    )
+    if rejoin:
+        # respawned incarnation: vote in the open reform window and join the
+        # reformed group at the epoch boundary
+        base = base.rejoin()
+    rdv = ChaosRendezvous(base)
+    holder = {"rdv": rdv}
+
+    X, init = _recover_dataset()
+    k = init.shape[0]
+
+    def fit(attempt: int):
+        r = holder["rdv"]
+        store = ckpt.active_store()
+        live = r.live_ranks
+        # survivor re-sharding: the FULL row set re-partitions over the
+        # CURRENT membership (host-retained: re-derived from the seed)
+        bounds = np.linspace(0, len(X), r.nranks + 1).astype(int)
+        shard = X[bounds[r.rank]: bounds[r.rank + 1]]
+        # resume consensus: adopt the most advanced member checkpoint, so
+        # survivors resume together and a rejoined (fresh) rank catches up
+        saved = store.load("centers") if store is not None else None
+        it0 = 0 if saved is None else int(saved.iteration)
+        centers = init.copy() if saved is None else saved.state["centers"]
+        packed = np.concatenate([[float(it0)], centers.ravel()])
+        gathered = allgather_ndarray(r, packed)
+        best = max(range(len(gathered)), key=lambda i: (gathered[i][0], -i))
+        it0 = int(gathered[best][0])
+        centers = gathered[best][1:].reshape(centers.shape)
+        for it in range(it0, iters):
+            sums, counts = _lloyd_local_sums(shard, centers)
+            packed = np.concatenate([sums, counts[:, None]], axis=1)
+            total = np.sum(allgather_ndarray(r, packed[None, ...]), axis=0)[0]
+            g_sums, g_counts = total[:, :-1], total[:, -1]
+            centers = np.where(
+                g_counts[:, None] > 0,
+                g_sums / np.maximum(g_counts[:, None], 1.0),
+                centers,
+            )
+            if store is not None and (it + 1) % 2 == 0:
+                store.save("centers", ckpt.SolverCheckpoint(
+                    solver="harness_kmeans", iteration=it + 1,
+                    state={"centers": centers.copy()},
+                ))
+        return centers
+
+    result = {"rank": rank, "error": None}
+    try:
+        centers = core.recoverable_stage(
+            fit, stage="fit", rendezvous=rdv,
+            on_recover=lambda new_rdv, gen, dead: holder.update(rdv=new_rdv),
+        )
+        final = holder["rdv"]
+        result.update(
+            centers=np.asarray(centers).tolist(),
+            live_final=list(final.live_ranks),
+            generation=int(getattr(final, "reform_generation", 0)),
+            orig_rank=int(final.orig_rank),
+        )
+    except SrmlError as e:
+        result["error"] = type(e).__name__
+        result["detail"] = str(e)
+    except Exception as e:  # noqa: BLE001 - typed classification is the point
+        result["error"] = type(e).__name__
+        result["detail"] = str(e)
+    finally:
+        holder["rdv"].close()
+    counters = telemetry.registry().snapshot().get("counters", {})
+    result["counters"] = {
+        key: counters.get(key)
+        for key in (
+            "fit.recoveries", "recovery.epochs", "recovery.rank_losses",
+            "rendezvous.reforms", "checkpoint.saves", "checkpoint.restores",
+            "fit.retries",
+        )
+    }
+    _write_json(os.path.join(out_dir, f"result_rank{rank}.json"), result)
+
+
 def main() -> None:
     rank = int(sys.argv[1])
     nranks = int(sys.argv[2])
@@ -33,6 +185,14 @@ def main() -> None:
     rounds = int(sys.argv[6])
     heartbeat_interval_s = float(sys.argv[7])
     timeout_s = float(sys.argv[8])
+    mode = sys.argv[9] if len(sys.argv) > 9 else "rounds"
+
+    if mode in ("recover", "rejoin"):
+        recover_main(
+            rank, nranks, rdv_dir, out_dir, run_id, rounds,
+            heartbeat_interval_s, timeout_s, rejoin=(mode == "rejoin"),
+        )
+        return
 
     from spark_rapids_ml_tpu import diagnostics
     from spark_rapids_ml_tpu.errors import RankFailedError, RendezvousTimeoutError

@@ -1,0 +1,803 @@
+#
+# Elastic recovery tests (docs/robustness.md "Elastic recovery"): solver
+# checkpoints that make a resumed fit bit-identical to an uninterrupted one,
+# survivor re-meshing through membership reform, host-retained re-placement,
+# and the sweep completion ledger. The subprocess SIGKILL-mid-solve harness
+# lives in tests/test_chaos.py (it shares the chaos_worker launcher).
+#
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import checkpoint as ckpt
+from spark_rapids_ml_tpu import core as core_mod
+from spark_rapids_ml_tpu import telemetry
+from spark_rapids_ml_tpu.errors import RankFailedError, RendezvousTimeoutError
+from spark_rapids_ml_tpu.parallel import FileRendezvous, LocalRendezvous, chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    chaos.clear_fault_plan()
+    saved = {
+        k: core_mod.config[k]
+        for k in (
+            "checkpoint_every_iters", "recovery_max_rank_losses",
+            "fit_retry_backoff_s", "sweep_max_resumes",
+        )
+    }
+    core_mod.config["fit_retry_backoff_s"] = 0.01
+    telemetry.enable()
+    telemetry.registry().reset()
+    yield
+    chaos.clear_fault_plan()
+    core_mod.config.update(saved)
+    telemetry.disable()
+
+
+def _counters():
+    return telemetry.registry().snapshot()["counters"]
+
+
+# ------------------------------------------------------------ store basics --
+
+
+def test_checkpoint_scope_isolation_and_adoption():
+    assert ckpt.active_store() is None
+    with ckpt.checkpoint_scope() as outer:
+        assert ckpt.active_store() is outer
+        outer.save("k", ckpt.SolverCheckpoint(solver="s", iteration=1, state={}))
+        with ckpt.ensure_scope() as inner:  # adopts, does not shadow
+            assert inner is outer
+            assert len(inner) == 1
+        assert len(outer) == 1  # the nested exit did NOT clear the store
+    assert ckpt.active_store() is None
+
+
+def test_checkpoint_scope_clears_on_exit():
+    with ckpt.checkpoint_scope() as store:
+        store.save("k", ckpt.SolverCheckpoint(solver="s", iteration=3, state={}))
+    assert len(store) == 0  # per-stage: checkpoints never leak across fits
+
+
+def test_get_or_compute_is_placement_keyed():
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return {"G": np.eye(2)}
+
+    with ckpt.checkpoint_scope() as store:
+        a = store.get_or_compute("stats", compute, solver="linear", placement_key=("m1",))
+        b = store.get_or_compute("stats", compute, solver="linear", placement_key=("m1",))
+        assert a is b and len(calls) == 1
+        assert _counters()["checkpoint.stats_reuses"] == 1
+        # a DIFFERENT placement (survivor mesh) must recompute, not reuse
+        store.get_or_compute("stats", compute, solver="linear", placement_key=("m2",))
+        assert len(calls) == 2
+
+
+def test_solver_checkpoints_active_requires_cadence_and_store():
+    core_mod.config["checkpoint_every_iters"] = 0
+    with ckpt.checkpoint_scope():
+        assert not ckpt.solver_checkpoints_active()
+    core_mod.config["checkpoint_every_iters"] = 2
+    assert not ckpt.solver_checkpoints_active()  # no store installed
+    with ckpt.checkpoint_scope():
+        assert ckpt.solver_checkpoints_active()
+
+
+# --------------------------------------------- solver-level resume pinning --
+
+
+def _blob_df(rng, n=600, d=5):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    return pd.DataFrame({"features": list(x)}), x
+
+
+def test_kmeans_interrupted_fit_resumes_bit_identical(rng):
+    # THE acceptance pin: a fit interrupted mid-solve (transient fault at a
+    # checkpoint boundary) retries, RESUMES from the checkpoint — counted —
+    # and its model is bit-identical to an uninterrupted checkpointed fit.
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    df, _ = _blob_df(rng)
+    core_mod.config["checkpoint_every_iters"] = 3
+
+    clean = KMeans(k=8, maxIter=10, tol=0.0, seed=7).fit(df)
+    assert _counters()["checkpoint.saves"] >= 3
+
+    chaos.set_fault_plan("fail:stage=solve:times=1")
+    telemetry.registry().reset()
+    resumed = KMeans(k=8, maxIter=10, tol=0.0, seed=7).fit(df)
+    snap = _counters()
+    np.testing.assert_array_equal(
+        resumed.cluster_centers_, clean.cluster_centers_
+    )
+    assert resumed.n_iter_ == clean.n_iter_
+    assert snap["fit.retries"] == 1
+    assert snap["checkpoint.restores"] >= 1  # resumed, not restarted
+
+
+def test_kmeans_checkpointing_does_not_change_the_fit(rng):
+    # cadence on vs off: the checkpoint fetches add host syncs, never math
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    df, _ = _blob_df(rng)
+    plain = KMeans(k=6, maxIter=8, tol=0.0, seed=3).fit(df)
+    core_mod.config["checkpoint_every_iters"] = 2
+    ckpted = KMeans(k=6, maxIter=8, tol=0.0, seed=3).fit(df)
+    np.testing.assert_array_equal(plain.cluster_centers_, ckpted.cluster_centers_)
+
+
+def test_logistic_interrupted_fit_resumes_bit_identical(rng):
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+
+    df, x = _blob_df(rng)
+    y = (x @ rng.normal(size=x.shape[1]) > 0).astype(float)
+    df = df.assign(label=y)
+    core_mod.config["checkpoint_every_iters"] = 4
+
+    clean = LogisticRegression(maxIter=20).fit(df)
+    chaos.set_fault_plan("fail:stage=solve:times=1")
+    telemetry.registry().reset()
+    resumed = LogisticRegression(maxIter=20).fit(df)
+    snap = _counters()
+    np.testing.assert_array_equal(resumed.coef_, clean.coef_)
+    np.testing.assert_array_equal(resumed.intercept_, clean.intercept_)
+    assert resumed.n_iter_ == clean.n_iter_
+    assert snap["checkpoint.restores"] >= 1
+
+
+def test_elasticnet_interrupted_fit_resumes_bit_identical(rng):
+    # the OWL-QN (L1) segmented loop shares the driver; pin it separately
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+
+    df, x = _blob_df(rng)
+    y = (x @ rng.normal(size=x.shape[1]) > 0).astype(float)
+    df = df.assign(label=y)
+    core_mod.config["checkpoint_every_iters"] = 4
+
+    def make():
+        return LogisticRegression(maxIter=20, regParam=0.05, elasticNetParam=0.5)
+
+    clean = make().fit(df)
+    chaos.set_fault_plan("fail:stage=solve:times=1")
+    telemetry.registry().reset()
+    resumed = make().fit(df)
+    np.testing.assert_array_equal(resumed.coef_, clean.coef_)
+    assert _counters()["checkpoint.restores"] >= 1
+
+
+def test_glm_segment_boundaries_are_lossless(rng):
+    # THE segmentation contract: boundary host round-trips never change the
+    # math. A 5-iteration cadence (5 boundaries) must be BIT-identical to a
+    # cadence larger than maxIter (one segment, zero mid-solve boundaries) —
+    # same traced body, same compiled segment program, lossless fetches.
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+
+    df, x = _blob_df(rng)
+    y = (x @ rng.normal(size=x.shape[1]) > 0).astype(float)
+    df = df.assign(label=y)
+    core_mod.config["checkpoint_every_iters"] = 100  # > maxIter: one segment
+    one_seg = LogisticRegression(maxIter=25).fit(df)
+    core_mod.config["checkpoint_every_iters"] = 5
+    many_seg = LogisticRegression(maxIter=25).fit(df)
+    assert many_seg.n_iter_ == one_seg.n_iter_
+    np.testing.assert_array_equal(many_seg.coef_, one_seg.coef_)
+    np.testing.assert_array_equal(many_seg.intercept_, one_seg.intercept_)
+
+
+def test_glm_segmented_matches_monolithic(rng):
+    # checkpointed (segmented) vs one-program solver: identical closures and
+    # iteration count, but DIFFERENT compiled programs (the monolithic loop
+    # wraps the body in freeze_when_done inside one lax.while_loop; the
+    # segmented driver jits the body with a seg_end bound), so XLA may
+    # reassociate f32 reductions differently and the batched Armijo line
+    # search can pick a different step when candidates differ by an ulp.
+    # The documented contract (docs/robustness.md "Elastic recovery") is
+    # numerical equivalence on a well-conditioned problem — bit-identity is
+    # only promised segmented-vs-segmented (pinned above and by the
+    # interrupted-resume tests).
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+
+    df, x = _blob_df(rng)
+    # noisy labels + ridge keep the minimizer finite and the comparison
+    # well-conditioned (a separable unregularized fit amplifies ulp noise
+    # exponentially — coefficients diverge, only their direction converges)
+    y = ((x @ rng.normal(size=x.shape[1]) + rng.normal(size=len(x))) > 0).astype(float)
+    df = df.assign(label=y)
+
+    def make():
+        return LogisticRegression(maxIter=25, regParam=0.01)
+
+    plain = make().fit(df)
+    core_mod.config["checkpoint_every_iters"] = 5
+    seg = make().fit(df)
+    assert seg.n_iter_ == plain.n_iter_
+    np.testing.assert_allclose(seg.coef_, plain.coef_, rtol=0, atol=5e-3)
+
+
+def test_linear_retry_reuses_retained_stats(rng):
+    # linear-family checkpoint = the sufficient statistics: an interrupted
+    # fit's retry must SKIP the data pass (stats_reuses) and produce a
+    # bit-identical model
+    from spark_rapids_ml_tpu.models.regression import LinearRegression
+
+    df, x = _blob_df(rng)
+    df = df.assign(label=(x @ rng.normal(size=x.shape[1])).astype(np.float32))
+    core_mod.config["checkpoint_every_iters"] = 1
+
+    clean = LinearRegression().fit(df)
+    chaos.set_fault_plan("fail:stage=solve:times=1")
+    telemetry.registry().reset()
+    resumed = LinearRegression().fit(df)
+    snap = _counters()
+    np.testing.assert_array_equal(
+        np.asarray(resumed.coef_), np.asarray(clean.coef_)
+    )
+    assert snap["checkpoint.stats_reuses"] >= 1
+    assert snap["fit.retries"] == 1
+
+
+def test_pca_retry_reuses_retained_stats(rng):
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    df, _ = _blob_df(rng)
+    core_mod.config["checkpoint_every_iters"] = 1
+    clean = PCA(k=3).fit(df)
+    chaos.set_fault_plan("fail:stage=solve:times=1")
+    telemetry.registry().reset()
+    resumed = PCA(k=3).fit(df)
+    np.testing.assert_array_equal(resumed.components_, clean.components_)
+    assert _counters()["checkpoint.stats_reuses"] >= 1
+
+
+def test_checkpoint_disabled_by_default_costs_nothing(rng):
+    # cadence 0 (the default): no store interaction, no counters, identical fit
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    df, _ = _blob_df(rng)
+    assert core_mod.config["checkpoint_every_iters"] == 0
+    KMeans(k=4, maxIter=5, seed=1).fit(df)
+    snap = _counters()
+    assert "checkpoint.saves" not in snap
+    assert "checkpoint.restores" not in snap
+
+
+# ------------------------------------------------- recoverable_stage (unit) --
+
+
+def test_recoverable_stage_reforms_and_resumes_local():
+    # 3 thread-ranks; rank 2 dies at round 1 of "iteration" traffic. The
+    # survivors must reform to a 2-rank group, re-enter the stage, and
+    # complete — with the recovery counters advancing and the checkpoint
+    # store surviving the epoch.
+    nranks = 3
+    rvs = LocalRendezvous.create(nranks, timeout_s=15.0)
+    results = [None] * nranks
+    core_mod.config["recovery_max_rank_losses"] = 1
+
+    def work(r):
+        holder = {"rdv": rvs[r]}
+
+        def fit(attempt):
+            rdv = holder["rdv"]
+            store = ckpt.active_store()
+            saved = store.load("it") if store is not None else None
+            start = 0 if saved is None else int(saved.iteration)
+            for it in range(start, 4):
+                if r == 2 and it == 1:
+                    # rank 2 "dies": publish and unwind (the graceful-death
+                    # shape; SIGKILL needs processes — tests/test_chaos.py)
+                    rdv.abort("rank 2 died")
+                    raise RuntimeError("rank 2 died")
+                rdv.allgather(f"{rdv.rank}:{it}")
+                store.save("it", ckpt.SolverCheckpoint(
+                    solver="unit", iteration=it + 1, state={}
+                ))
+            return ("done", rdv.nranks, list(rdv.live_ranks), start)
+
+        try:
+            results[r] = core_mod.recoverable_stage(
+                fit, stage="fit", rendezvous=rvs[r],
+                on_recover=lambda new, gen, dead: holder.update(rdv=new),
+            )
+        except Exception as e:  # noqa: BLE001 - asserted below
+            results[r] = e
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(nranks)]
+    [t.start() for t in threads]
+    [t.join(timeout=60) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+
+    # the dead rank raised its own error; survivors completed on the
+    # reformed 2-rank group, RESUMING from their checkpoints (start > 0)
+    assert isinstance(results[2], RuntimeError)
+    for r in (0, 1):
+        status, n, live, start = results[r]
+        assert status == "done"
+        assert n == 2 and live == [0, 1]
+        assert start >= 1, "survivor restarted from scratch instead of resuming"
+    snap = _counters()
+    assert snap["fit.recoveries"] == 2  # one per survivor
+    assert snap["recovery.epochs"] == 2
+    assert snap["rendezvous.reforms"] == 2
+
+
+def test_recoverable_stage_exhaustion_degrades_to_typed_failure():
+    # recovery budget 0: the RankFailedError propagates exactly as before,
+    # stamped with how far recovery got (never opened here)
+    core_mod.config["recovery_max_rank_losses"] = 0
+    rdv = LocalRendezvous.create(1, timeout_s=5.0)[0]
+
+    def fit(attempt):
+        raise RankFailedError(0, "peer gone")
+
+    with pytest.raises(RankFailedError) as ei:
+        core_mod.recoverable_stage(fit, stage="fit", rendezvous=rdv)
+    assert ei.value.recovery_exhausted is False
+    assert ei.value.recovery_generations == 0
+
+
+def test_recoverable_stage_unreformable_substrate_degrades():
+    class _NoReform:
+        rank, nranks = 0, 2
+        can_reform = False
+
+        def begin_epoch(self, e):
+            pass
+
+    def fit(attempt):
+        raise RankFailedError(1, "dead")
+
+    with pytest.raises(RankFailedError):
+        core_mod.recoverable_stage(fit, stage="fit", rendezvous=_NoReform())
+
+
+def test_recoverable_stage_bounded_losses():
+    # every epoch loses another rank; the budget must bound the loop and the
+    # final error must carry the exhaustion stamp
+    core_mod.config["recovery_max_rank_losses"] = 2
+    nranks = 4
+    rvs = LocalRendezvous.create(nranks, timeout_s=10.0)
+    attempts = []
+
+    def work(r):
+        holder = {"rdv": rvs[r]}
+
+        def fit(attempt):
+            rdv = holder["rdv"]
+            attempts.append(rdv.nranks)
+            # the highest-numbered CURRENT rank always dies
+            if rdv.rank == rdv.nranks - 1:
+                rdv.abort("serial failure")
+                raise RuntimeError("died")
+            rdv.allgather(f"{rdv.rank}")
+            rdv.allgather(f"{rdv.rank}")
+            raise RankFailedError(rdv.nranks - 1, "peer still dying")
+
+        try:
+            return core_mod.recoverable_stage(
+                fit, stage="fit", rendezvous=rvs[r],
+                on_recover=lambda new, gen, dead: holder.update(rdv=new),
+            )
+        except Exception as e:  # noqa: BLE001
+            return e
+
+    out = [None] * nranks
+    threads = [
+        threading.Thread(target=lambda rr=r: out.__setitem__(rr, work(rr)))
+        for r in range(nranks)
+    ]
+    [t.start() for t in threads]
+    [t.join(timeout=60) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+    # rank 0 survived every epoch; after 2 losses the budget is exhausted
+    assert isinstance(out[0], RankFailedError)
+    assert out[0].recovery_exhausted is True
+    assert out[0].recovery_generations == 2
+
+
+# ------------------------------------------------- FileRendezvous reform ----
+
+
+def test_file_reform_survivors_agree(tmp_path):
+    nranks = 3
+    rvs = [
+        FileRendezvous(r, nranks, str(tmp_path), timeout_s=10.0, run_id="t",
+                       heartbeat_interval_s=0.2)
+        for r in range(nranks)
+    ]
+    out = [None, None]
+
+    def work(r):
+        out[r] = rvs[r].reform(dead_ranks={2}, generation=1)
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in (0, 1)]
+    [t.start() for t in threads]
+    [t.join(timeout=30) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+    for r in (0, 1):
+        assert out[r].nranks == 2
+        assert out[r].live_ranks == [0, 1]
+        assert out[r].orig_rank == r
+        assert out[r].reform_generation == 1
+    # the reformed plane works end to end
+    res = [None, None]
+
+    def gather(r):
+        res[r] = out[r].allgather(f"hello{r}")
+
+    threads = [threading.Thread(target=gather, args=(r,)) for r in (0, 1)]
+    [t.start() for t in threads]
+    [t.join(timeout=30) for t in threads]
+    assert res[0] == res[1] == ["hello0", "hello1"]
+    for r in rvs + out:
+        r.close()
+
+
+def test_file_reform_admits_respawned_rank(tmp_path):
+    # survivors hold the window open (rejoin grace); a respawned incarnation
+    # of the dead rank votes inside it and joins at the epoch boundary
+    saved = core_mod.config["recovery_rejoin_grace_s"]
+    core_mod.config["recovery_rejoin_grace_s"] = 1.5
+    nranks = 3
+    rvs = [
+        FileRendezvous(r, nranks, str(tmp_path), timeout_s=15.0, run_id="t",
+                       heartbeat_interval_s=0.2)
+        for r in range(nranks)
+    ]
+    out = {}
+
+    def survivor(r):
+        out[r] = rvs[r].reform(dead_ranks={2}, generation=1)
+
+    def respawn():
+        time.sleep(0.3)  # arrives after the window opened
+        fresh = FileRendezvous(2, nranks, str(tmp_path), timeout_s=15.0,
+                               run_id="t", heartbeat_interval_s=0.2)
+        out[2] = fresh.rejoin()
+
+    threads = [threading.Thread(target=survivor, args=(r,)) for r in (0, 1)]
+    threads.append(threading.Thread(target=respawn))
+    try:
+        [t.start() for t in threads]
+        [t.join(timeout=60) for t in threads]
+        assert not any(t.is_alive() for t in threads)
+        for r in range(3):
+            assert out[r].nranks == 3, f"rank {r} saw {out[r].nranks} members"
+            assert out[r].live_ranks == [0, 1, 2]
+            assert out[r].orig_rank == r
+    finally:
+        core_mod.config["recovery_rejoin_grace_s"] = saved
+        for r in list(out.values()) + rvs:
+            r.close()
+
+
+def test_file_reform_declares_silent_rank_dead(tmp_path):
+    # a peer that neither votes nor heartbeats within the staleness window is
+    # declared dead by the reform round; the lone survivor gets a 1-rank group
+    r0 = FileRendezvous(0, 2, str(tmp_path), timeout_s=2.0, run_id="t",
+                        heartbeat_interval_s=0.2)
+    try:
+        new = r0.reform(dead_ranks={1}, generation=1)
+        assert new.live_ranks == [0]
+        assert new.nranks == 1
+    finally:
+        r0.close()
+
+
+def test_survivor_mesh_drops_dead_process_devices():
+    import jax
+
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, survivor_mesh
+
+    mesh = get_mesh(4)
+    # CPU test topology: every device is process 0 — excluding a fictional
+    # dead process keeps everything; excluding process 0 must raise
+    same = survivor_mesh(mesh, {7})
+    assert same.devices.size == mesh.devices.size
+    with pytest.raises(ValueError):
+        survivor_mesh(mesh, {0})
+
+
+# --------------------------------------- host-retained re-placement (core) --
+
+
+def test_replacement_reuses_host_blocks_after_mesh_change(rng):
+    # one fit on an 8-device mesh, then the "survivor mesh" shape: the same
+    # data on a 4-device mesh inside one scope. The second fit must skip
+    # ingest entirely (host blocks retained) and only re-run layout.
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    df, _ = _blob_df(rng)
+    with core_mod.device_dataset_scope():
+        KMeans(k=4, maxIter=3, seed=1, num_workers=8).fit(df)
+        snap1 = _counters()
+        KMeans(k=4, maxIter=3, seed=1, num_workers=4).fit(df)
+        snap2 = _counters()
+    assert snap1.get("fit.device_dataset_builds") == 1
+    assert snap2.get("recovery.replacements") == 1
+    assert snap2.get("recovery.rows_replaced") == 600
+    # ingest ran ONCE: the dataset counter did not advance on the re-placement
+    assert snap2.get("ingest.datasets") == snap1.get("ingest.datasets")
+
+
+# ------------------------------------------------------ sweep ledger (CV) ---
+
+
+class _Evaluator:
+    def getMetricName(self):
+        return "accuracy"
+
+    def isLargerBetter(self):
+        return True
+
+
+def _cv_setup(rng, fail_at_fit=None):
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    x = rng.normal(size=(300, 5)).astype(np.float32)
+    y = (x @ rng.normal(size=5) > 0).astype(float)
+    pdf = pd.DataFrame({"features": list(x), "label": y})
+
+    state = {"n": 0}
+
+    class FlakyLR(LogisticRegression):
+        def _fit_internal(self, *a, **kw):
+            state["n"] += 1
+            if fail_at_fit is not None and state["n"] == fail_at_fit:
+                raise RankFailedError(1, "injected rank loss mid-sweep")
+            return super()._fit_internal(*a, **kw)
+
+    lr = FlakyLR(maxIter=10)
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.1]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=3, seed=1,
+    )
+    return cv, pdf, state
+
+
+def test_cv_sweep_resumes_at_first_incomplete_fit(rng):
+    # acceptance: a CV sweep losing a rank mid-flight resumes at the first
+    # incomplete fit and redoes ZERO completed (fold, paramMap) fits —
+    # asserted from the ledger telemetry counters alone
+    cv, pdf, state = _cv_setup(rng, fail_at_fit=3)  # dies entering fold 2
+    model = cv.fit(pdf)
+    snap = _counters()
+    assert snap["sweep.resumes"] == 1
+    assert snap["sweep.fits_completed"] == 6  # 3 folds x 2 maps, each ONCE
+    assert snap["sweep.fits_skipped"] == 4  # folds 0-1 ledger-served on resume
+    # fold fits actually performed: 2 clean + 1 failed + 1 resumed + 1 refit
+    assert state["n"] == 5
+    assert model.bestModel is not None
+    assert len(model.avgMetrics) == 2
+
+
+def test_cv_sweep_clean_run_has_no_resumes(rng):
+    cv, pdf, state = _cv_setup(rng)
+    cv.fit(pdf)
+    snap = _counters()
+    assert snap["sweep.fits_completed"] == 6
+    assert "sweep.resumes" not in snap
+    assert "sweep.fits_skipped" not in snap
+
+
+def test_cv_sweep_resume_metrics_match_clean_run():
+    rng_a = np.random.default_rng(5)
+    cv, pdf, _ = _cv_setup(rng_a, fail_at_fit=2)
+    resumed = cv.fit(pdf)
+    rng_b = np.random.default_rng(5)
+    cv2, pdf2, _ = _cv_setup(rng_b)
+    clean = cv2.fit(pdf2)
+    np.testing.assert_allclose(resumed.avgMetrics, clean.avgMetrics)
+
+
+def test_cv_sweep_resume_budget_exhaustion():
+    rng = np.random.default_rng(6)
+    core_mod.config["sweep_max_resumes"] = 0
+    cv, pdf, _ = _cv_setup(rng, fail_at_fit=2)
+    with pytest.raises(RankFailedError):
+        cv.fit(pdf)
+
+
+def test_sweep_ledger_registry_lookup():
+    from spark_rapids_ml_tpu import tuning
+
+    ledger = tuning._register_ledger(tuning.SweepLedger("trace-xyz", 2, 2))
+    ledger.complete(0, 0, 0.5)
+    ledger.complete(0, 1, 0.7)
+    got = tuning.sweep_ledger("trace-xyz")
+    assert got is ledger
+    assert got.fold_done(0) and not got.fold_done(1)
+    np.testing.assert_allclose(got.fold_metrics(0), [0.5, 0.7])
+    assert len(got) == 2
+
+
+def test_cv_ledger_drops_models_without_collect_sub(rng):
+    # the ledger only ever reads models back for subModels restoration —
+    # without collectSubModels it must not pin a sweep's worth of them in
+    # the retained registry entry
+    from spark_rapids_ml_tpu import tuning
+
+    cv, pdf, _ = _cv_setup(rng)
+    cv.fit(pdf)
+    ledgers = list(tuning._LEDGERS.values())
+    assert ledgers, "sweep did not register a ledger"
+    assert all(not led._models for led in ledgers)
+
+
+def _tvs_setup(rng, fail_at_fit=None, evaluator=None):
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.tuning import ParamGridBuilder, TrainValidationSplit
+
+    x = rng.normal(size=(300, 5)).astype(np.float32)
+    y = (x @ rng.normal(size=5) > 0).astype(float)
+    pdf = pd.DataFrame({"features": list(x), "label": y})
+    state = {"n": 0}
+
+    class FlakyLR(LogisticRegression):
+        def _fit_internal(self, *a, **kw):
+            state["n"] += 1
+            if fail_at_fit is not None and state["n"] == fail_at_fit:
+                raise RankFailedError(1, "injected rank loss mid-sweep")
+            return super()._fit_internal(*a, **kw)
+
+    lr = FlakyLR(maxIter=10)
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"), [0.0, 0.1]).build()
+    tvs = TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=evaluator or MulticlassClassificationEvaluator(metricName="accuracy"),
+        trainRatio=0.75, seed=1,
+    )
+    return tvs, pdf, state
+
+
+def test_tvs_engine_sweep_resumes_mid_grid(rng):
+    # same elastic contract as CV (docs claim CV AND TVS): a mid-flight
+    # control-plane failure resumes the sweep instead of failing it
+    tvs, pdf, state = _tvs_setup(rng, fail_at_fit=1)
+    model = tvs.fit(pdf)
+    snap = _counters()
+    assert snap["sweep.resumes"] == 1
+    assert snap["sweep.fits_completed"] == 2
+    assert "sweep.fits_skipped" not in snap  # died before any fit finished
+    assert state["n"] == 3  # failed grid + resumed grid + best refit
+    assert model.bestModel is not None
+    assert len(model.validationMetrics) == 2
+
+
+class _PandasAccuracyEvaluator:
+    # deliberately NOT a framework evaluator (unsupported metric name):
+    # forces the fallback per-model TVS path, where the ledger works at
+    # (paramMap) granularity
+    def getMetricName(self):
+        return "pandas_accuracy"
+
+    def isLargerBetter(self):
+        return True
+
+    def evaluate(self, df):
+        return float((df["prediction"] == df["label"]).mean())
+
+
+def test_tvs_fallback_resumes_at_first_incomplete_map(rng):
+    tvs, pdf, state = _tvs_setup(
+        rng, fail_at_fit=2, evaluator=_PandasAccuracyEvaluator()
+    )
+    model = tvs.fit(pdf)
+    snap = _counters()
+    assert snap["sweep.resumes"] == 1
+    assert snap["sweep.fits_completed"] == 2
+    assert snap["sweep.fits_skipped"] == 1  # map 0 ledger-served on resume
+    assert state["n"] == 4  # map 0 + failed map 1 + resumed map 1 + refit
+    assert model.bestModel is not None
+    assert len(model.validationMetrics) == 2
+
+
+# ------------------------------------------- multi-generation file reform ---
+
+
+def test_file_reform_dirs_anchor_at_original_root(tmp_path):
+    # generation N+1's window must open under the ORIGINAL run root — never
+    # nested under the g<N> plane — or a respawned rank constructing over
+    # the original root can only ever discover generation 1
+    import os
+
+    r = FileRendezvous(0, 1, str(tmp_path), timeout_s=10.0, run_id="t",
+                       heartbeat_interval_s=0.2)
+    anchor = r.root
+    g1 = r.reform(dead_ranks=(), generation=1)
+    assert g1.root == os.path.join(anchor, "reform_g1", "plane")
+    g2 = g1.reform(dead_ranks=(), generation=2)
+    assert g2.root == os.path.join(anchor, "reform_g2", "plane")
+    # the respawn's view: a fresh instance over the original root sees the
+    # latest window, and the rejoin marker lands where g2 survivors scan it
+    respawn = FileRendezvous(0, 1, str(tmp_path), timeout_s=5.0, run_id="t",
+                             heartbeat_interval_s=0)
+    assert respawn.latest_generation() == 2
+    assert respawn._rejoin_wait_path(0) == g2._rejoin_wait_path(0)
+    for rv in (r, g1, g2, respawn):
+        rv.close()
+
+
+def test_rejoin_marker_raises_current_index(tmp_path):
+    # the rejoin-marker failure path must raise the CURRENT rank index like
+    # the abort/heartbeat paths do — recoverable_stage maps failed_rank
+    # through live_ranks exactly once, so an original id here would be
+    # double-mapped after a prior reform and blame an innocent survivor
+    rv = FileRendezvous(0, 2, str(tmp_path), timeout_s=5.0,
+                        heartbeat_interval_s=0, live_ranks=[0, 2])
+    with open(rv._rejoin_wait_path(2), "w") as f:
+        f.write("{}")
+    with pytest.raises(RankFailedError) as ei:
+        rv._check_failures({1}, round_index=0)
+    assert ei.value.failed_rank == 1  # current index of original rank 2
+    assert "original rank 2" in ei.value.reason
+    rv.close()
+
+
+def test_stale_reform_dirs_cleaned_on_run_id_less_reuse(tmp_path):
+    # a crashed previous run's reform tree in a reused run_id-less root
+    # would close this run's first window instantly with the wrong live set;
+    # construction removes trees with no recent file activity and keeps
+    # fresh ones (a live window another rank just opened)
+    import os
+
+    stale = tmp_path / "reform_g1"
+    (stale / "plane" / "round_0").mkdir(parents=True)
+    (stale / "member_rank_0").write_text("{}")
+    (stale / "plane" / "round_0" / "rank_0").write_text("old")
+    old = time.time() - 7200
+    for dirpath, dirnames, filenames in os.walk(stale, topdown=False):
+        for name in filenames:
+            os.utime(os.path.join(dirpath, name), (old, old))
+        os.utime(dirpath, (old, old))
+    fresh = tmp_path / "reform_g2"
+    fresh.mkdir()
+    (fresh / "member_rank_1").write_text("{}")
+    rv = FileRendezvous(0, 2, str(tmp_path), timeout_s=5.0,
+                        heartbeat_interval_s=0)
+    assert not stale.exists()  # stale tree removed
+    assert fresh.exists()  # live window untouched
+    rv.close()
+
+
+# -------------------------------------------------------- postmortem epoch --
+
+
+def test_postmortem_names_recovery_epochs(tmp_path):
+    from spark_rapids_ml_tpu import diagnostics
+
+    # simulate what recoverable_stage + reform record on a survivor
+    events = [
+        dict(kind="rdv_enter", round=4),
+        dict(kind="error", error="RankFailedError", failed_rank=2, round_index=4),
+        dict(kind="recovery_epoch_begin", generation=1, failed_rank=2,
+             dead_ranks=[2]),
+        dict(kind="recovery_reform", generation=1, survivors=[0, 1], dead=[2]),
+    ]
+    import json
+    import os
+
+    dump = tmp_path / "flightrec_rank_0.jsonl"
+    with open(dump, "w") as f:
+        for i, ev in enumerate(events):
+            f.write(json.dumps(dict(ev, t=float(i), rank=0)) + "\n")
+    pm = diagnostics.assemble_postmortem(str(tmp_path), nranks=3)
+    assert pm["failed_rank"] == 2
+    assert pm["recovery_epochs"] == [
+        {"generation": 1, "survivors": [0, 1], "dead": [2]}
+    ]
+    rendered = diagnostics.render_postmortem(pm)
+    assert "recovery epoch g1" in rendered
+    assert "survivors [0, 1]" in rendered
