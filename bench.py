@@ -66,6 +66,17 @@ CV_COLS = int(os.environ.get("BENCH_CV_COLS", 500))
 CV_FOLDS = int(os.environ.get("BENCH_CV_FOLDS", 3))
 CV_GRID = int(os.environ.get("BENCH_CV_GRID", 4))
 
+# Optional out-of-core streaming lane (BENCH_OOCORE=1): the same dataset fit
+# resident and demoted to the streaming path (benchmark/bench_oocore.py) —
+# reports streaming rows/sec, the streaming/resident ratio, and the measured
+# ingest.overlap_fraction (the double-buffer acceptance gauge). Own @RESULT
+# line; NOT part of the headline geomean until the lane history stabilizes
+# (no BASELINES entry).
+OOCORE_ALGO = "oocore_stream"
+OOCORE_ROWS = int(os.environ.get("BENCH_OOCORE_ROWS", 400_000))
+OOCORE_COLS = int(os.environ.get("BENCH_OOCORE_COLS", 500))
+OOCORE_CHUNK = int(os.environ.get("BENCH_OOCORE_CHUNK", 65_536))
+
 
 def bench_algos() -> tuple:
     extra: tuple = ()
@@ -77,6 +88,10 @@ def bench_algos() -> tuple:
     if os.environ.get("BENCH_CV"):
         # CV lane also ahead of the dense block, for the same HBM reason
         extra += (CV_ALGO,)
+    if os.environ.get("BENCH_OOCORE"):
+        # streaming lane ahead of the dense block too: its resident baseline
+        # fit is freed before the protocol X lands
+        extra += (OOCORE_ALGO,)
     return extra + ALGOS
 
 # Parent retry policy (override for tests): attempts x per-attempt timeout,
@@ -232,6 +247,25 @@ def bench_cv_lane() -> float:
     return out["solves"] * CV_ROWS / out["fit"]
 
 
+def bench_oocore_lane() -> float:
+    """Streaming-vs-resident fit over one host dataset: reports streaming
+    rows/sec (the lane metric), the throughput ratio, the double-buffer
+    overlap fraction, and the live parity delta (~1e-9). Counters ride the
+    @TELEMETRY snapshot."""
+    from benchmark.bench_oocore import run_oocore_fit
+
+    out = run_oocore_fit(OOCORE_ROWS, OOCORE_COLS, chunk_rows=OOCORE_CHUNK)
+    _log(
+        f"oocore_stream: {out['stream_s']:.2f}s streamed vs "
+        f"{out['resident_s']:.2f}s resident "
+        f"(ratio {out['stream_vs_resident']:.2f}, "
+        f"overlap {out['overlap_fraction']:.2f} over "
+        f"{int(out['stream_chunks'])} chunks, "
+        f"max_rel_diff {out['max_rel_diff']:.2e})"
+    )
+    return out["stream_rows_per_sec"]
+
+
 def _phase(name: str) -> None:
     """Structured heartbeat to the parent watchdog: `@PHASE <name>` on stdout.
     Any phase line counts as PROGRESS — the parent only kills a child whose
@@ -291,6 +325,7 @@ def run_child() -> int:
     runners = {
         SPARSE_ALGO: lambda: bench_sparse_logreg(mesh),
         CV_ALGO: lambda: bench_cv_lane(),
+        OOCORE_ALGO: lambda: bench_oocore_lane(),
         "pca": lambda: bench_pca(dense_data()["X"], dense_data()["w"], mesh),
         "logreg": lambda: bench_logreg(
             dense_data()["X"], dense_data()["w"], dense_data()["y_idx"]

@@ -21,6 +21,7 @@ from .bench_kmeans import BenchmarkKMeans
 from .bench_linear_regression import BenchmarkLinearRegression
 from .bench_logistic_regression import BenchmarkLogisticRegression
 from .bench_nearest_neighbors import BenchmarkNearestNeighbors
+from .bench_oocore import BenchmarkOOCore
 from .bench_pca import BenchmarkPCA
 from .bench_random_forest import BenchmarkRandomForest
 from .bench_umap import BenchmarkUMAP
@@ -29,6 +30,7 @@ from .utils import log
 ALGORITHMS = {
     "cv": BenchmarkCV,
     "ingest": BenchmarkIngest,
+    "oocore": BenchmarkOOCore,
     "pca": BenchmarkPCA,
     "kmeans": BenchmarkKMeans,
     "linear_regression": BenchmarkLinearRegression,

@@ -1,13 +1,22 @@
 #
-# Chaos smoke lane (ci/test.sh): one tiny kill+recover fit, end to end.
+# Chaos smoke lane (ci/test.sh): two tiny end-to-end fault scenarios.
 #
-# Launches a 3-process FileRendezvous `recover`-mode fit (tests/chaos_worker.py
-# — a distributed Lloyd loop under core.recoverable_stage with solver
-# checkpoints on), SIGKILLs rank 2 mid-solve via SRML_FAULT_PLAN, and asserts
-# the elastic-recovery contract held: survivors reform to a 2-rank group,
-# resume from the checkpoint, finish clean, and the assembled post-mortem
-# NAMES the killed rank and the recovery epoch. The full parametrized sweep
-# lives in tests/test_chaos.py; this is the pre-merge canary.
+# (1) kill+recover: a 3-process FileRendezvous `recover`-mode fit
+# (tests/chaos_worker.py — a distributed Lloyd loop under
+# core.recoverable_stage with solver checkpoints on), SIGKILLs rank 2
+# mid-solve via SRML_FAULT_PLAN, and asserts the elastic-recovery contract
+# held: survivors reform to a 2-rank group, resume from the checkpoint,
+# finish clean, and the assembled post-mortem NAMES the killed rank and the
+# recovery epoch.
+#
+# (2) oom-demotion: a single-process fit under an `oom:budget=` chaos plan
+# (tests/oom_worker.py) must complete via the RESIDENT -> STREAM demotion
+# ladder — fit.demotions == 1, overlap measured, model matching the clean
+# resident fit the same process runs once the plan is spent (docs/
+# robustness.md "Memory safety").
+#
+# The full parametrized sweeps live in tests/test_chaos.py +
+# tests/test_oocore.py; this is the pre-merge canary.
 #
 import json
 import os
@@ -19,6 +28,7 @@ import uuid
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "chaos_worker.py")
+OOM_WORKER = os.path.join(REPO, "tests", "oom_worker.py")
 
 NRANKS = 3
 ITERS = 6
@@ -30,6 +40,50 @@ PLAN = "kill:rank=2:round=8"
 def fail(msg: str) -> None:
     print(f"chaos smoke: FAIL — {msg}")
     sys.exit(1)
+
+
+def oom_demotion_case(tmp: str) -> None:
+    """An injected-budget OOM at fit entry completes the fit via demotion."""
+    out = os.path.join(tmp, "oom_demote.json")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SRML_FAULT_PLAN"] = "oom:budget=16000"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the 16000-byte budget is calibrated per device over the same 8-device
+    # CPU mesh the pytest harness forces (tests/conftest.py): demote the
+    # resident placement, admit the streaming working set
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, OOM_WORKER, "demote", out],
+        env=env, capture_output=True, timeout=240,
+    )
+    if proc.returncode != 0:
+        fail(
+            "oom worker exited "
+            f"{proc.returncode}:\n{proc.stdout.decode()}{proc.stderr.decode()}"
+        )
+    with open(out) as f:
+        res = json.load(f)
+    if res["error"] is not None:
+        fail(f"oom worker raised {res['error']}: {res.get('detail')}")
+    if res["admission_faulted"].get("verdict") != "stream":
+        fail(f"faulted fit was not demoted: {res['admission_faulted']}")
+    if res["admission_clean"].get("verdict") != "resident":
+        fail(f"clean fit did not run resident: {res['admission_clean']}")
+    if res["counters"].get("fit.demotions") != 1:
+        fail(f"fit.demotions == {res['counters'].get('fit.demotions')}, expected 1")
+    if not res["gauges"].get("ingest.overlap_fraction", 0) > 0:
+        fail("no double-buffer overlap measured on the demoted fit")
+    if not res["max_rel_center_diff"] < 1e-9:
+        fail(f"streamed centers diverged: {res['max_rel_center_diff']}")
+    print(
+        "chaos smoke: OK — injected-budget OOM demoted to streaming "
+        f"(overlap {res['gauges']['ingest.overlap_fraction']:.2f}), "
+        "model matches resident"
+    )
 
 
 def main() -> None:
@@ -91,6 +145,7 @@ def main() -> None:
         "chaos smoke: OK — rank 2 SIGKILLed, survivors resumed from "
         f"checkpoint, post-mortem names rank 2 and epoch g{epochs[0]['generation']}"
     )
+    oom_demotion_case(tmp)
 
 
 if __name__ == "__main__":

@@ -59,6 +59,19 @@ _SLEEP_TREE = "spark_rapids_ml_tpu"
 _SLEEP_EXEMPT_FILES: set = set()
 _SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 
+# HBM accounting goes through the admission budgeter (memory.py — capacity
+# resolution, chaos-injected budgets, config override order) and the
+# telemetry watermark sampler (telemetry.record_device_memory). A direct
+# `Device.memory_stats()` call elsewhere bypasses the `hbm_budget_bytes`
+# override and the chaos `oom:budget=` injection, so the code under test
+# budgets against a DIFFERENT capacity than the admission controller —
+# exactly the split-brain the memory-safety plane exists to prevent (docs/
+# robustness.md "Memory safety"). A genuinely read-only probe carries a
+# `# hbm-ok` waiver naming why it must not flow through memory.py.
+_MEMSTATS_TREE = "spark_rapids_ml_tpu"
+_MEMSTATS_EXEMPT_FILES = {"memory.py", "telemetry.py"}
+_MEMSTATS_RE = re.compile(r"\.memory_stats\s*\(")
+
 # Transform/serving code pads batches through the bucket ladder
 # (parallel/mesh.py bucket_rows), never raw pad_rows: an exact-shape pad
 # mints one compiled `predict` program per distinct tail shape — tens of
@@ -123,6 +136,19 @@ for target in TARGETS:
                     f"{path}:{lineno}: bare time.sleep in the framework — "
                     "sleeping belongs to the retry-backoff/heartbeat/poll "
                     "owners; bound it and mark `# sleep-ok: <why>`"
+                )
+            if (
+                target == _MEMSTATS_TREE
+                and path.name not in _MEMSTATS_EXEMPT_FILES
+                and _MEMSTATS_RE.search(line)
+                and "# hbm-ok" not in line
+            ):
+                failures.append(
+                    f"{path}:{lineno}: direct memory_stats() in the framework — "
+                    "HBM capacity flows through the admission budgeter "
+                    "(memory.device_capacity_bytes: honors hbm_budget_bytes + "
+                    "chaos budgets) or the telemetry watermark sampler; use "
+                    "them or mark `# hbm-ok: <why>`"
                 )
             if (
                 target == _PAD_ROWS_TREE

@@ -10,6 +10,7 @@
 __version__ = "0.1.0"
 
 from .errors import (  # noqa: F401
+    HbmBudgetError,
     IngestValidationError,
     RankFailedError,
     RendezvousTimeoutError,
@@ -37,6 +38,7 @@ __all__ = [
     "RendezvousTimeoutError",
     "SolverDivergedError",
     "IngestValidationError",
+    "HbmBudgetError",
     "device_dataset_scope",
     "__version__",
 ]

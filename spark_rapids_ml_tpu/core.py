@@ -92,6 +92,21 @@ config: Dict[str, Any] = {
     # (chunked under ingest_chunk_bytes); raises IngestValidationError
     # naming the column instead of feeding NaNs to a solver
     "validate_ingest": False,
+    # --- memory safety (docs/robustness.md "Memory safety") ---------------
+    # per-device HBM capacity override for the admission budgeter
+    # (spark_rapids_ml_tpu/memory.py). None = use the device-reported
+    # bytes_limit where the backend exposes it (TPU/GPU); CPU has none, so
+    # fits stay unbudgeted there unless this is set.
+    "hbm_budget_bytes": None,
+    # fraction of the capacity RESERVED (not budgeted) for the transform
+    # bucket ladder, compiled-program scratch, and allocator fragmentation:
+    # the admission budget is capacity * (1 - this)
+    "hbm_headroom_fraction": 0.1,
+    # rows per out-of-core streaming chunk (the double-buffered host->HBM
+    # pipeline's unit). 0 = auto: sized so two in-flight chunks + the solver
+    # workspace fit the budget (floor 256 rows; 65536 when no capacity
+    # information bounds it).
+    "stream_chunk_rows": 0,
     # --- multi-fit execution engine (docs/performance.md) ----------------
     # XLA persistent compilation cache directory: compiled programs (the
     # transform bucket ladder, batched sweep solvers) survive process
@@ -136,6 +151,26 @@ alias = namedtuple("alias", ("data", "label", "weight", "row_number"))(
 
 
 @dataclass
+class StreamPlan:
+    """Out-of-core execution plan attached to a demoted fit's `FitInputs`
+    (docs/robustness.md "Memory safety"): the host-retained extracted blocks
+    plus the ADMITTED chunk size. Streaming solver drivers (ops/streaming.py)
+    cut row chunks from `extracted`, validate them per block when
+    ``config["validate_ingest"]`` asked for it, and feed them through the
+    double-buffered host->HBM pipeline. Mutable bookkeeping: `validated_rows`
+    (per-block validation watermark — later passes over scanned rows are
+    free) and the once-per-fit CSR->ELL block cache."""
+
+    extracted: Any  # host ExtractedData (dense np block or scipy CSR)
+    chunk_rows: int
+    validate: bool = False
+    admission: Any = None  # the memory.AdmissionDecision that demoted the fit
+    validated_rows: int = 0
+    ell_blocks: Any = None  # once-per-fit CSR->ELL host blocks (global k_max)
+    ell_k_max: int = 0
+
+
+@dataclass
 class FitInputs:
     """Device-resident inputs handed to every algorithm's fit function.
 
@@ -160,6 +195,9 @@ class FitInputs:
     # fit (None = all). Set by `with_row_mask`; fit funcs that derive host
     # statistics from raw columns (label class sets) must respect it.
     host_mask: Any = None
+    # out-of-core execution plan (a demoted fit): X is NOT placed — y/w are
+    # HOST arrays and solvers stream row chunks via ops/streaming.py
+    stream: Optional["StreamPlan"] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def put_rows(self, host_rows: np.ndarray, weights: Optional[np.ndarray] = None) -> Any:
@@ -224,7 +262,8 @@ class FitInputs:
             raise ValueError(
                 f"row mask has {m.shape[0]} entries for {self.n_valid} rows"
             )
-        if self.X_sparse is not None:  # sparse path carries host weights
+        if self.X_sparse is not None or self.stream is not None:
+            # sparse and streaming paths carry host weights
             w_masked = np.asarray(self.w) * m
         else:
             w_masked = self.w * self.put_rows(m)  # padding rows stay 0
@@ -460,6 +499,10 @@ class DeviceDataset:
     extracted: ExtractedData
     inputs: FitInputs
     source: Any = None
+    # the memory.AdmissionDecision that admitted this placement — re-stamped
+    # on fits served from the scope cache, so every fit's model carries its
+    # verdict, not just the cache-miss one
+    admission: Any = None
 
 
 class DeviceDatasetScope:
@@ -537,8 +580,16 @@ class _TpuCommon(_TpuParams):
     # "float32" unless the solver's numeric contract tolerates fewer passes.
     _matmul_precision: str = "float32"
 
-    def _pre_process_data(self, dataset: Any, for_fit: bool = True) -> ExtractedData:
-        """Column selection + dense/CSR extraction (reference core.py:458-557)."""
+    def _pre_process_data(
+        self, dataset: Any, for_fit: bool = True, defer_validation: bool = False
+    ) -> ExtractedData:
+        """Column selection + dense/CSR extraction (reference core.py:458-557).
+
+        ``defer_validation=True`` skips the eager opt-in NaN/Inf scan — the
+        fit driver must run it itself (`data.run_deferred_validation`): full
+        scan before a RESIDENT layout, per row-block on the STREAMING path
+        (where re-materializing the dataset just to validate it would defeat
+        the memory budget)."""
         input_col, input_cols = self._get_input_columns()
         label_col = None
         if for_fit and self._supervised:
@@ -570,6 +621,7 @@ class _TpuCommon(_TpuParams):
             id_col=id_col,
             float32_inputs=self._float32_inputs,
             enable_sparse_data_optim=sparse_optim,
+            validate=not defer_validation,
         )
         if for_fit and extracted.n_rows == 0:
             # reference raises the same way when a rank gets no rows (core.py:762-765)
@@ -584,6 +636,27 @@ class _TpuCaller(_TpuCommon):
     # (all host-side statistics either rendezvous-merged or absent). Estimators
     # flip this as they are proven by the multiprocess test harness.
     _supports_multiprocess: bool = False
+
+    # Whether this estimator's fit function can run OUT-OF-CORE (an
+    # inputs.stream plan routed to ops/streaming.py). Estimators whose solver
+    # state is accumulable over row chunks (linear/PCA sufficient stats,
+    # logistic full-batch gradients, k-means center sums) flip this; for the
+    # rest an over-budget fit raises HbmBudgetError instead of demoting.
+    _supports_streaming_fit: bool = False
+
+    # the memory.AdmissionDecision of the most recent fit attempt (stamped
+    # onto model._fit_metrics by _call_fit_func)
+    _last_admission: Any = None
+
+    def _solver_workspace_terms(
+        self, rows_per_device: int, n_cols: int, params: Dict[str, Any], itemsize: int
+    ) -> Dict[str, int]:
+        """Per-solver HBM workspace estimate hook for the admission budgeter
+        (spark_rapids_ml_tpu/memory.py): named byte terms BEYOND the data
+        placement — gram/covariance blocks, GLM logits + L-BFGS history,
+        k-means tile buffers. Per device; {} (default) = no modeled
+        workspace. Formulas are pinned by tests/test_memory.py."""
+        return {}
 
     def _build_fit_inputs(self, extracted: ExtractedData, ctx: Any) -> FitInputs:
         """Lay the host blocks out on the mesh (pad-and-mask; SURVEY.md §7).
@@ -706,21 +779,131 @@ class _TpuCaller(_TpuCommon):
             tuple(int(d.id) for d in ctx.mesh.devices.flatten()),
         )
 
-    def _device_dataset(self, dataset: Any, ctx: Any, stage_logger: Any) -> DeviceDataset:
-        """Ingest + layout, or a cache hit inside an active
+    def _admit_and_layout(
+        self,
+        extracted: ExtractedData,
+        ctx: Any,
+        stage_logger: Any,
+        force_stream: bool = False,
+        key: Optional[tuple] = None,
+        source: Any = None,
+        attempt: int = 0,
+    ) -> DeviceDataset:
+        """Admission verdict + the matching data plane (docs/robustness.md
+        "Memory safety"): RESIDENT fits validate eagerly and lay out in HBM
+        as before; an over-budget fit DEMOTES to the streaming plan
+        (`fit.demotions`, reason logged and stamped on ``model._fit_metrics``)
+        with per-block validation deferred to the pipeline; even-streaming-
+        doesn't-fit raises the typed `HbmBudgetError` from `memory.admit_fit`.
+        Streamed datasets return with ``key=None`` — NON-cacheable: there is
+        no HBM placement to reuse, and a later attempt must re-budget."""
+        from . import memory as _memory
+        from . import telemetry
+        from .data import run_deferred_validation
+        from .parallel import chaos
+
+        adm = _memory.admit_fit(self, extracted, ctx, force_stream=force_stream)
+        self._last_admission = adm
+        if adm.verdict == _memory.STREAM:
+            if telemetry.enabled():
+                reg = telemetry.registry()
+                reg.inc("memory.admission_stream")
+                reg.inc("fit.demotions")
+            get_logger(type(self)).warning(
+                "fit demoted RESIDENT -> STREAM: %s (chunk_rows=%d)",
+                adm.reason, adm.chunk_rows,
+            )
+            plan = StreamPlan(
+                extracted=extracted,
+                chunk_rows=adm.chunk_rows,
+                validate=bool(config.get("validate_ingest", False)),
+                admission=adm,
+            )
+            inputs = self._build_stream_inputs(extracted, ctx, plan)
+            return DeviceDataset(
+                key=None, extracted=extracted, inputs=inputs, source=source,
+                admission=adm,
+            )
+        if telemetry.enabled():
+            telemetry.registry().inc("memory.admission_resident")
+        # the deferred opt-in NaN/Inf scan runs eagerly (full, chunked) before
+        # any placement — resident semantics unchanged
+        run_deferred_validation(extracted)
+        # index = the retry/recovery attempt: `oom:stage=placement:round=1`
+        # targets the RE-placement of a recovery attempt, not the first layout
+        chaos.maybe_fail_oom("placement", attempt)
+        with telemetry.span("layout", logger=stage_logger):
+            inputs = self._build_fit_inputs(extracted, ctx)
+        telemetry.record_device_memory()  # HBM watermark after placement
+        return DeviceDataset(
+            key=key, extracted=extracted, inputs=inputs, source=source,
+            admission=adm,
+        )
+
+    def _build_stream_inputs(
+        self, extracted: ExtractedData, ctx: Any, plan: StreamPlan
+    ) -> FitInputs:
+        """`FitInputs` for an out-of-core fit: NOTHING is placed — X is None,
+        y/w are the HOST columns, and `stream` carries the plan the streaming
+        solver drivers consume. Solvers treat host w == 0 rows as padding,
+        so `with_row_mask` fold reuse works unchanged."""
+        from .parallel import PartitionDescriptor
+
+        mesh = ctx.mesh
+        n_dev = mesh.devices.size
+        dtype = np.float32 if self._float32_inputs else np.float64
+        desc = PartitionDescriptor.build(
+            [
+                extracted.n_rows // n_dev + (1 if i < extracted.n_rows % n_dev else 0)
+                for i in range(n_dev)
+            ],
+            extracted.n_cols,
+        )
+        w = extracted.weight
+        w_np = (
+            np.asarray(w, dtype=dtype)
+            if w is not None
+            else np.ones(extracted.n_rows, dtype=dtype)
+        )
+        return FitInputs(
+            mesh=mesh,
+            X=None,
+            y=extracted.label,
+            w=w_np,
+            n_valid=desc.m,
+            n_cols=extracted.n_cols,
+            desc=desc,
+            dtype=dtype,
+            X_sparse=extracted.features if extracted.is_sparse else None,
+            ctx=ctx,
+            stream=plan,
+        )
+
+    def _device_dataset(
+        self,
+        dataset: Any,
+        ctx: Any,
+        stage_logger: Any,
+        force_stream: bool = False,
+        attempt: int = 0,
+    ) -> DeviceDataset:
+        """Ingest + admission + layout, or a cache hit inside an active
         `device_dataset_scope` — the ingest/layout spans (and their cost)
         exist only on a miss, which is how a numFolds x paramMaps
-        CrossValidator fit performs exactly ONE ingest and ONE layout."""
+        CrossValidator fit performs exactly ONE ingest and ONE layout.
+        Streamed (demoted) datasets are never cached; a cached entry is by
+        construction a RESIDENT placement that already passed admission."""
         from . import telemetry
 
         scope = _DDS_SCOPE.get()
-        if scope is None or ctx.is_spmd:
+        if scope is None or ctx.is_spmd or force_stream:
             with telemetry.span("ingest", logger=stage_logger):
-                extracted = self._pre_process_data(dataset, for_fit=True)
-            with telemetry.span("layout", logger=stage_logger):
-                inputs = self._build_fit_inputs(extracted, ctx)
-            telemetry.record_device_memory()  # HBM watermark after placement
-            return DeviceDataset(key=None, extracted=extracted, inputs=inputs)
+                extracted = self._pre_process_data(
+                    dataset, for_fit=True, defer_validation=True
+                )
+            return self._admit_and_layout(
+                extracted, ctx, stage_logger, force_stream, attempt=attempt
+            )
         key = self._device_dataset_key(dataset, ctx)
         with scope.lock:  # one builder per scope: a cache-miss build is
             # never duplicated by a concurrent fit sharing the scope
@@ -728,13 +911,19 @@ class _TpuCaller(_TpuCommon):
             if dds is not None:
                 scope.cache[key] = scope.cache.pop(key)  # LRU: move to newest
                 telemetry.registry().inc("fit.device_dataset_reuses")
+                if dds.admission is not None:
+                    # a cache hit skipped _admit_and_layout: re-stamp the
+                    # verdict that admitted the reused placement
+                    self._last_admission = dds.admission
             else:
                 # host-retained re-placement (docs/robustness.md "Elastic
                 # recovery"): a cached entry for the SAME data on a DIFFERENT
                 # mesh — the survivor re-mesh shape, where the device set
                 # changed under one fit — still holds the right host blocks.
                 # Reuse them: the ingest pass is skipped entirely and only
-                # the layout runs against the new mesh.
+                # the admission + layout run against the new mesh (fewer
+                # chips shrink the budget: a resident fit may legitimately
+                # RESUME AS A STREAMING FIT here).
                 from .data import same_ingest_identity
 
                 retained = next(
@@ -746,26 +935,26 @@ class _TpuCaller(_TpuCommon):
                     reg = telemetry.registry()
                     reg.inc("recovery.replacements")
                     reg.inc("recovery.rows_replaced", int(extracted.n_rows))
-                    with telemetry.span("layout", logger=stage_logger):
-                        inputs = self._build_fit_inputs(extracted, ctx)
-                    telemetry.record_device_memory()
-                    dds = DeviceDataset(
-                        key=key, extracted=extracted, inputs=inputs,
-                        source=retained.source,
+                    dds = self._admit_and_layout(
+                        extracted, ctx, stage_logger, key=key,
+                        source=retained.source, attempt=attempt,
                     )
-                    scope.cache[key] = dds
                 else:
                     with telemetry.span("ingest", logger=stage_logger):
-                        extracted = self._pre_process_data(dataset, for_fit=True)
-                    with telemetry.span("layout", logger=stage_logger):
-                        inputs = self._build_fit_inputs(extracted, ctx)
-                    telemetry.record_device_memory()
+                        extracted = self._pre_process_data(
+                            dataset, for_fit=True, defer_validation=True
+                        )
                     # `source=dataset` pins the object so its id() — the
                     # heart of the cache key — cannot be recycled while the
                     # entry lives
-                    dds = DeviceDataset(key=key, extracted=extracted, inputs=inputs, source=dataset)
+                    dds = self._admit_and_layout(
+                        extracted, ctx, stage_logger, key=key, source=dataset,
+                        attempt=attempt,
+                    )
+                    if dds.key is not None:
+                        telemetry.registry().inc("fit.device_dataset_builds")
+                if dds.key is not None:  # streamed datasets are non-cacheable
                     scope.cache[key] = dds
-                    telemetry.registry().inc("fit.device_dataset_builds")
                 # bounded retention: a scope around a loop over FRESH dataset
                 # objects (per-fold slices on a non-engine path) must not
                 # stack HBM placements — evict least-recently-used entries
@@ -804,6 +993,7 @@ class _TpuCaller(_TpuCommon):
         from . import telemetry
 
         logger = get_logger(type(self))
+        self._last_admission = None  # per-fit; stamped onto _fit_metrics below
         verbose = bool(self._solver_params.get("verbose"))
         stage_logger = logger if verbose else None
         # Opt-in tracing (the NVTX/xprof analog, SURVEY.md §5): when
@@ -843,13 +1033,27 @@ class _TpuCaller(_TpuCommon):
             # solvers resume from the checkpoint store
             rows = recoverable_stage(
                 lambda attempt: self._call_fit_func_traced(
-                    dataset, param_maps, logger, stage_logger, row_mask
+                    dataset, param_maps, logger, stage_logger, row_mask,
+                    attempt=attempt,
                 ),
                 stage="fit",
                 ctx=active,
                 logger=logger,
             )
         self._last_fit_metrics = tele_scope["metrics"]
+        adm = getattr(self, "_last_admission", None)
+        if (
+            adm is not None
+            and isinstance(self._last_fit_metrics, dict)
+            and (telemetry.enabled() or adm.demoted)
+        ):
+            # stamp the admission verdict (and a demotion's reason) onto the
+            # per-fit metrics so models carry WHY they streamed. A DEMOTED
+            # fit stamps even with telemetry off — the reason a fit streamed
+            # is robustness state, not a metric — while a plain resident fit
+            # keeps the disabled-telemetry contract: _fit_metrics == {}
+            self._last_fit_metrics = dict(self._last_fit_metrics)
+            self._last_fit_metrics["admission"] = adm.stamp()
         return rows
 
     def _call_fit_func_traced(
@@ -859,6 +1063,75 @@ class _TpuCaller(_TpuCommon):
         logger: Any,
         stage_logger: Any,
         row_mask: Optional[np.ndarray] = None,
+        attempt: int = 0,
+    ) -> List[Dict[str, Any]]:
+        """One recoverable attempt, with the OOM conversion ladder wrapped
+        around it: a REAL backend out-of-memory failure at placement or solve
+        (XLA RESOURCE_EXHAUSTED — or the chaos `oom` injection shaped like
+        one) is converted to the typed `HbmBudgetError` and retried ONCE on
+        the out-of-core streaming path. The retry re-ingests and streams; if
+        it OOMs too (or the estimator has no streaming path / runs SPMD), the
+        typed error propagates — a raw XLA error never does. `attempt` is the
+        retry/recovery attempt index — the chaos `oom:stage=placement` index,
+        so a plan can target the RE-placement of a recovery attempt
+        (`round=1`) rather than the first layout."""
+        from . import memory as _memory
+        from . import telemetry
+        from .parallel import TpuContext
+
+        try:
+            return self._call_fit_func_attempt(
+                dataset, param_maps, logger, stage_logger, row_mask,
+                attempt=attempt,
+            )
+        except Exception as e:
+            if not _memory.is_oom_error(e):
+                raise
+            if telemetry.enabled():
+                telemetry.registry().inc("memory.oom_caught")
+            active = TpuContext.current()
+            if not getattr(self, "_supports_streaming_fit", False) or (
+                active is not None and active.is_spmd
+            ):
+                raise _memory.as_hbm_budget_error(e) from e
+            logger.warning(
+                "backend out-of-memory during fit (%s); converting to "
+                "HbmBudgetError and retrying ONCE on the out-of-core "
+                "streaming path", e,
+            )
+        # the retry runs OUTSIDE the except handler: the handler's traceback
+        # pins the failed attempt's frames — and with them the dead resident
+        # placement's device arrays — for as long as `e` lives; Python drops
+        # `e` at handler exit, so by here that HBM is release-able. Any
+        # placements cached by an enclosing device_dataset_scope are evicted
+        # too: under a real allocation failure, a cache hit is worth less
+        # than the streaming retry having room to run.
+        scope = _DDS_SCOPE.get()
+        if scope is not None:
+            with scope.lock:
+                n_evicted = len(scope.cache)
+                scope.cache.clear()
+            if n_evicted and telemetry.enabled():
+                telemetry.registry().inc("fit.device_dataset_evictions", n_evicted)
+        try:
+            return self._call_fit_func_attempt(
+                dataset, param_maps, logger, stage_logger, row_mask,
+                attempt=attempt, force_stream=True,
+            )
+        except Exception as e2:
+            if _memory.is_oom_error(e2):
+                raise _memory.as_hbm_budget_error(e2) from e2
+            raise
+
+    def _call_fit_func_attempt(
+        self,
+        dataset: Any,
+        param_maps: Optional[List[Dict[Param, Any]]],
+        logger: Any,
+        stage_logger: Any,
+        row_mask: Optional[np.ndarray] = None,
+        attempt: int = 0,
+        force_stream: bool = False,
     ) -> List[Dict[str, Any]]:
         import contextlib
 
@@ -889,7 +1162,10 @@ class _TpuCaller(_TpuCommon):
         with ctx_mgr as ctx, dtype_scope(
             np.float32 if self._float32_inputs else np.float64, self._matmul_precision
         ):
-            dds = self._device_dataset(dataset, ctx, stage_logger)
+            dds = self._device_dataset(
+                dataset, ctx, stage_logger, force_stream=force_stream,
+                attempt=attempt,
+            )
             extracted, inputs = dds.extracted, dds.inputs
             fit_func = self._get_tpu_fit_func(extracted)
             if row_mask is not None:
@@ -958,11 +1234,19 @@ class _TpuCaller(_TpuCommon):
         classic sequential loop. `fit.solves_batched` / `fit.solves_sequential`
         count how each param set was dispatched."""
         from . import telemetry
+        from .parallel import chaos
 
+        chaos.maybe_fail_oom("solve")  # round-less `oom:stage=solve` plans
         n_sets = len(solver_param_sets)
         rows: List[Optional[Dict[str, Any]]] = [None] * n_sets
         solve_times: List[float] = []
-        batched_fn = self._get_tpu_batched_fit_func(extracted) if n_sets > 1 else None
+        # streaming fits solve sequentially: the batched sweeps are compiled
+        # over the RESIDENT placement (inputs.X / one placed ELL set)
+        batched_fn = (
+            self._get_tpu_batched_fit_func(extracted)
+            if n_sets > 1 and inputs.stream is None
+            else None
+        )
 
         groups: Dict[Any, List[int]] = {}
         order: List[Any] = []

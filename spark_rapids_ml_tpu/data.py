@@ -36,6 +36,11 @@ class ExtractedData:
     row_id: Optional[np.ndarray] = None
     feature_kind: str = "array"  # "vector" | "array" | "multi_cols"
     feature_names: List[str] = field(default_factory=list)
+    # source column names for validation error attribution (the streaming
+    # path validates per row-block long after extraction, so the names must
+    # ride along with the data)
+    label_name: Optional[str] = None
+    weight_name: Optional[str] = None
 
     @property
     def n_rows(self) -> int:
@@ -130,39 +135,49 @@ def _first_nonfinite_row(block: np.ndarray, lo: int) -> int:
     return lo + int(np.argmin(finite_rows))
 
 
-def _validate_ingest(
-    extracted: "ExtractedData", label_col=None, weight_col=None
+def validate_extracted(
+    extracted: "ExtractedData",
+    label_col=None,
+    weight_col=None,
+    lo: int = 0,
+    hi: Optional[int] = None,
 ) -> None:
-    """Opt-in NaN/Inf scan over the ingested blocks (``config["validate_ingest"]``).
+    """NaN/Inf scan over rows ``[lo, hi)`` of the ingested blocks.
 
     Chunked under the same ``ingest_chunk_bytes`` bound as the ingest itself,
     so validation temporaries (the per-chunk finite mask) never scale with
     the dataset. Raises `IngestValidationError` NAMING the offending column
-    (and first bad row) — the alternative is a NaN surfacing iterations later
-    inside a solver as a divergence with no pointer back to the data."""
+    and the ABSOLUTE first bad row — the alternative is a NaN surfacing
+    iterations later inside a solver as a divergence with no pointer back to
+    the data. The full-range call is the eager fit-entry scan; the streaming
+    fit path calls it PER ROW-BLOCK as chunks enter the pipeline, so the
+    dataset is never host-materialized a second time just to validate it."""
     from .core import config
     from .errors import IngestValidationError
 
-    if not config.get("validate_ingest", False):
-        return
-
     feats = extracted.features
+    n = extracted.n_rows
+    hi = n if hi is None else min(int(hi), n)
+    lo = max(0, int(lo))
     if extracted.is_sparse:
-        # CSR: only the stored values can be non-finite; chunk the data array
-        # and map the first bad element back to its row through indptr
+        # CSR: only the stored values can be non-finite; chunk the row range's
+        # data slice and map the first bad element back to its ABSOLUTE row
+        # through indptr
+        indptr = feats.indptr
+        e_lo, e_hi = int(indptr[lo]), int(indptr[hi])
         data = feats.data
         step = max(1, int(config.get("ingest_chunk_bytes", 128 << 20)) // max(1, data.itemsize))
-        for lo in range(0, len(data), step):
-            chunk = data[lo : lo + step]
+        for elo in range(e_lo, e_hi, step):
+            chunk = data[elo : min(elo + step, e_hi)]
             if not np.isfinite(chunk).all():
-                elem = lo + int(np.argmin(np.isfinite(chunk)))
-                row = int(np.searchsorted(feats.indptr, elem, side="right") - 1)
+                elem = elo + int(np.argmin(np.isfinite(chunk)))
+                row = int(np.searchsorted(indptr, elem, side="right") - 1)
                 raise IngestValidationError(extracted.feature_names[0], row)
     else:
         row_bytes = feats.shape[1] * feats.itemsize if feats.ndim > 1 else feats.itemsize
         step = ingest_chunk_rows(row_bytes)
-        for lo in range(0, feats.shape[0], step):
-            chunk = np.asarray(feats[lo : lo + step])
+        for clo in range(lo, hi, step):
+            chunk = np.asarray(feats[clo : min(clo + step, hi)])
             if np.isfinite(chunk).all():
                 continue
             if extracted.feature_kind == "multi_cols" and chunk.ndim > 1:
@@ -170,28 +185,62 @@ def _validate_ingest(
                 bad_cols = ~np.isfinite(chunk).all(axis=0)
                 name = extracted.feature_names[int(np.argmax(bad_cols))]
                 col = chunk[:, int(np.argmax(bad_cols))]
-                raise IngestValidationError(name, lo + int(np.argmin(np.isfinite(col))))
+                raise IngestValidationError(name, clo + int(np.argmin(np.isfinite(col))))
             raise IngestValidationError(
-                extracted.feature_names[0], _first_nonfinite_row(chunk, lo)
+                extracted.feature_names[0], _first_nonfinite_row(chunk, clo)
             )
     for name, arr in ((label_col, extracted.label), (weight_col, extracted.weight)):
         if arr is None:
             continue
-        if not np.isfinite(arr).all():
+        part = arr[lo:hi]
+        if not np.isfinite(part).all():
             raise IngestValidationError(
-                str(name), int(np.argmin(np.isfinite(arr)))
+                str(name), lo + int(np.argmin(np.isfinite(part)))
             )
 
 
-def _record_ingest(
+def run_deferred_validation(
+    extracted: "ExtractedData", lo: int = 0, hi: Optional[int] = None
+) -> None:
+    """`validate_extracted` gated on ``config["validate_ingest"]``, with the
+    column names taken from the extraction record — the entry point for the
+    fit driver (eager full scan on the resident path) and the streaming
+    pipeline (per row-block)."""
+    from .core import config
+
+    if not config.get("validate_ingest", False):
+        return
+    validate_extracted(
+        extracted, extracted.label_name, extracted.weight_name, lo=lo, hi=hi
+    )
+
+
+def _validate_ingest(
     extracted: "ExtractedData", label_col=None, weight_col=None
+) -> None:
+    """Opt-in eager NaN/Inf scan at extraction (``config["validate_ingest"]``)."""
+    from .core import config
+
+    if not config.get("validate_ingest", False):
+        return
+    validate_extracted(extracted, label_col, weight_col)
+
+
+def _record_ingest(
+    extracted: "ExtractedData", label_col=None, weight_col=None, validate: bool = True
 ) -> "ExtractedData":
-    """Validation (opt-in) + telemetry counters for a completed extraction:
-    rows and host bytes staged (CSR counts its data+index arrays). The
-    telemetry half is a flag-checked no-op when disabled."""
+    """Validation (opt-in, deferrable) + telemetry counters for a completed
+    extraction: rows and host bytes staged (CSR counts its data+index
+    arrays). The telemetry half is a flag-checked no-op when disabled.
+    ``validate=False`` DEFERS the NaN/Inf scan to the caller (the fit driver:
+    eager full scan on the resident path, per row-block on the streaming
+    path — `run_deferred_validation`)."""
     from . import telemetry
 
-    _validate_ingest(extracted, label_col=label_col, weight_col=weight_col)
+    extracted.label_name = None if label_col is None else str(label_col)
+    extracted.weight_name = None if weight_col is None else str(weight_col)
+    if validate:
+        _validate_ingest(extracted, label_col=label_col, weight_col=weight_col)
     if telemetry.enabled():
         feats = extracted.features
         if extracted.is_sparse:
@@ -298,11 +347,14 @@ def extract_dataset(
     id_col: Optional[str] = None,
     float32_inputs: bool = True,
     enable_sparse_data_optim: Optional[bool] = None,
+    validate: bool = True,
 ) -> ExtractedData:
     """Extract features (+label/weight/id) as contiguous blocks.
 
     ``enable_sparse_data_optim``: None autodetects (CSR kept sparse); True requires
     a sparse input (raises otherwise); False densifies (reference params.py:44-65).
+    ``validate=False`` defers the opt-in NaN/Inf scan to the caller (see
+    `_record_ingest`).
     """
     dtype = np.float32 if float32_inputs else np.float64
 
@@ -346,7 +398,7 @@ def extract_dataset(
             row_id=_dict_scalar(id_col, np.int64),
             feature_kind=kind,
             feature_names=[input_col],
-        ), label_col=label_col, weight_col=weight_col)
+        ), label_col=label_col, weight_col=weight_col, validate=validate)
 
     pdf = as_pandas(dataset)
 
@@ -393,7 +445,7 @@ def extract_dataset(
         row_id=_scalar(id_col, np.int64),
         feature_kind=kind,
         feature_names=names,
-    ), label_col=label_col, weight_col=weight_col)
+    ), label_col=label_col, weight_col=weight_col, validate=validate)
 
 
 def vectors_to_pandas_column(matrix: np.ndarray) -> list:

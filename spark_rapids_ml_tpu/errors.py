@@ -17,12 +17,19 @@
 #   ├── SolverDivergedError      permanent — a solver produced non-finite
 #   │                            state; carries the last-good iterate so
 #   │                            callers can resume/diagnose
-#   └── IngestValidationError    permanent — NaN/Inf found in an input column
-#                                (config["validate_ingest"]); names the column
+#   ├── IngestValidationError    permanent — NaN/Inf found in an input column
+#   │                            (config["validate_ingest"]); names the column
+#   └── HbmBudgetError           permanent — the fit's working set cannot fit
+#                                device memory even on the out-of-core
+#                                streaming path (or a real backend OOM was
+#                                caught and the streaming retry is impossible
+#                                or also failed); carries the estimate, the
+#                                capacity, and the largest term so the fix
+#                                points at WHAT doesn't fit
 #
 # Multiple inheritance keeps old call sites working: RendezvousTimeoutError
 # IS-A TimeoutError (FileRendezvous raised bare TimeoutError before),
-# IngestValidationError IS-A ValueError.
+# IngestValidationError IS-A ValueError, HbmBudgetError IS-A MemoryError.
 #
 from __future__ import annotations
 
@@ -34,6 +41,7 @@ __all__ = [
     "RankFailedError",
     "SolverDivergedError",
     "IngestValidationError",
+    "HbmBudgetError",
     "is_transient",
 ]
 
@@ -158,6 +166,56 @@ class IngestValidationError(SrmlError, ValueError):
             f"input column {column!r} contains {kind} values{at}; "
             "clean the data or disable config['validate_ingest']"
         )
+
+
+class HbmBudgetError(SrmlError, MemoryError):
+    """A fit's working set does not fit device memory — decided either by the
+    PREFLIGHT HBM budgeter (`spark_rapids_ml_tpu.memory`: even the streaming
+    working set of double-buffered chunks + solver workspace exceeds the
+    per-device budget), or by a REAL backend allocation failure caught at
+    placement/solve when the one-shot streaming retry is impossible or also
+    failed. PERMANENT: retrying the same fit on the same devices cannot help —
+    shrink the data/model, raise ``config["hbm_budget_bytes"]``, or add chips.
+
+    Carries the per-device byte accounting so the message (and post-mortems)
+    name WHAT doesn't fit: ``estimate_bytes`` (total working set),
+    ``capacity_bytes`` (per-device budget it was checked against),
+    ``largest_term`` / ``largest_term_bytes`` (the dominant line item, e.g.
+    ``placement.X`` or ``workspace.gram``), and the full ``terms`` dict."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        estimate_bytes: Optional[int] = None,
+        capacity_bytes: Optional[int] = None,
+        largest_term: Optional[str] = None,
+        largest_term_bytes: Optional[int] = None,
+        terms: Optional[Dict[str, int]] = None,
+    ):
+        # attributes BEFORE super().__init__: the flight-recorder hook fires
+        # inside it and records whatever diagnostic fields are already set
+        self.estimate_bytes = None if estimate_bytes is None else int(estimate_bytes)
+        self.capacity_bytes = None if capacity_bytes is None else int(capacity_bytes)
+        self.largest_term = largest_term
+        self.largest_term_bytes = (
+            None if largest_term_bytes is None else int(largest_term_bytes)
+        )
+        self.terms: Dict[str, int] = dict(terms) if terms else {}
+        parts = [message]
+        if estimate_bytes is not None and capacity_bytes is not None:
+            parts.append(
+                f"(estimated {self.estimate_bytes} bytes/device against a "
+                f"{self.capacity_bytes}-byte budget)"
+            )
+        if largest_term is not None:
+            lt = (
+                f"largest term: {largest_term}"
+                if largest_term_bytes is None
+                else f"largest term: {largest_term} = {self.largest_term_bytes} bytes"
+            )
+            parts.append(f"[{lt}]")
+        super().__init__(" ".join(parts))
 
 
 def is_transient(exc: BaseException) -> bool:

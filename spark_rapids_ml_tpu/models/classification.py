@@ -279,6 +279,75 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
     # CSR input fits via the padded-ELL sparse program (ops/sparse.py) without
     # densifying — the reference's sparse qn path (classification.py:975-1098)
     _supports_sparse_input = True
+    # full-batch gradients accumulate over row chunks: an over-HBM dataset
+    # demotes to ops/streaming.logistic_fit_streaming (smooth L2 path; the
+    # L1/elastic-net OWL-QN solver has no out-of-core form and raises the
+    # typed HbmBudgetError instead — docs/robustness.md "Memory safety")
+    _supports_streaming_fit = True
+
+    def _solver_workspace_terms(
+        self, rows_per_device: int, n_cols: int, params: Dict[str, Any], itemsize: int
+    ) -> Dict[str, int]:
+        # GLM working set: the per-row logits held TWICE (z at the iterate +
+        # z along the search direction) and the circular L-BFGS (S, Y)
+        # history over the flat parameter vector. Class count is unknown
+        # before the fit sees labels: binomial/auto estimate with k_out=1,
+        # an explicit multinomial family with a documented floor of 2.
+        # (`family` is a Spark param, not a solver param — query it directly.)
+        try:
+            family = self.getOrDefault("family")
+        except Exception:
+            family = "auto"
+        k_out = 2 if family == "multinomial" else 1
+        n_flat = n_cols * k_out + k_out
+        mem = int(params.get("lbfgs_memory", 10))
+        return {
+            "glm_logits": 2 * rows_per_device * k_out * itemsize,
+            "lbfgs_history": 2 * mem * n_flat * itemsize,
+        }
+
+    def _fit_streaming(
+        self, inputs: FitInputs, params: Dict[str, Any], classes, labels_host,
+        alpha: float, l1_ratio: float,
+    ) -> Dict[str, Any]:
+        """Out-of-core logistic fit (docs/robustness.md "Memory safety"):
+        streamed full-batch GLM quasi-Newton. L1/elastic-net has no
+        out-of-core path — OWL-QN's pseudo-gradient projection is not a
+        chunk-accumulable reduction — so a demoted L1 fit fails typed."""
+        from ..errors import HbmBudgetError
+        from ..ops.streaming import logistic_fit_streaming
+
+        if alpha * l1_ratio > 0:
+            raise HbmBudgetError(
+                "logistic L1/elastic-net fit does not fit device memory and "
+                "the OWL-QN solver has no out-of-core streaming path "
+                "(set elasticNetParam=0 or raise the budget)",
+                largest_term="solver.owlqn",
+            )
+        multinomial, y_idx_host = self._fit_geometry_host(classes, labels_host)
+        statics = self._solver_statics(params)
+        common = dict(
+            k=len(classes),
+            multinomial=multinomial,
+            lam_l2=alpha,
+            lam_l1=0.0,
+            use_l1=False,
+            **statics,
+        )
+        state = logistic_fit_streaming(
+            inputs, y_idx_host,
+            k=len(classes), multinomial=multinomial, lam_l2=alpha,
+            fit_intercept=statics["fit_intercept"],
+            standardize=statics["standardize"],
+            max_iter=statics["max_iter"], tol=statics["tol"],
+            lbfgs_memory=statics["lbfgs_memory"],
+            # param-identifying key, mirroring the resident checkpointed
+            # fit's "logistic:<params>" — a static key would let sequential
+            # param sets of one demoted sweep resume EACH OTHER'S trajectories
+            ckpt_key="logistic_stream:" + repr(sorted(common.items())),
+        )
+        state = {k_: np.asarray(v) for k_, v in state.items()}
+        return self._finalize_state(state, classes, inputs, common)
 
     def _resolve_classes(self, labels_host: np.ndarray, inputs: FitInputs) -> np.ndarray:
         """Sorted global class values for THIS fit's rows. Honors a fold's
@@ -306,9 +375,10 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             "dtype": np.dtype(inputs.dtype).name,
         }
 
-    def _fit_geometry(self, classes: np.ndarray, labels_host: np.ndarray, inputs: FitInputs):
-        """(multinomial, y_idx device array) shared by the sequential and
-        batched solve paths."""
+    def _fit_geometry_host(self, classes: np.ndarray, labels_host: np.ndarray):
+        """(multinomial, y_idx HOST array) — the label geometry both the
+        resident paths (which place y_idx) and the streaming path (which
+        slices it per chunk) derive from."""
         family = self.getOrDefault("family")
         k = len(classes)
         multinomial = family == "multinomial" or (family == "auto" and k > 2)
@@ -321,6 +391,12 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
         y_idx_host = np.clip(
             np.searchsorted(classes, labels_host), 0, k - 1
         ).astype(np.int32)
+        return multinomial, y_idx_host
+
+    def _fit_geometry(self, classes: np.ndarray, labels_host: np.ndarray, inputs: FitInputs):
+        """(multinomial, y_idx device array) shared by the sequential and
+        batched solve paths."""
+        multinomial, y_idx_host = self._fit_geometry_host(classes, labels_host)
         return multinomial, inputs.put_rows(y_idx_host)
 
     @staticmethod
@@ -378,6 +454,10 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             classes = self._resolve_classes(labels_host, inputs)
             if len(classes) == 1:
                 return self._degenerate_single_class(classes, inputs)
+            if inputs.stream is not None:
+                return self._fit_streaming(
+                    inputs, params, classes, labels_host, alpha, l1_ratio
+                )
             multinomial, y_idx = self._fit_geometry(classes, labels_host, inputs)
             common = dict(
                 k=len(classes),

@@ -99,6 +99,22 @@ class KMeans(_KMeansParams, _TpuEstimator):
     # the Lloyd loop is one pure SPMD program; the only host-side state — the
     # init centers — is computed from a rendezvous-gathered row sample below
     _supports_multiprocess = True
+    # per-chunk assignment + center accumulation: an over-HBM dataset demotes
+    # to ops/streaming.kmeans_fit_streaming (same host loop, same checkpoints)
+    _supports_streaming_fit = True
+
+    def _solver_workspace_terms(
+        self, rows_per_device: int, n_cols: int, params: Dict[str, Any], itemsize: int
+    ) -> Dict[str, int]:
+        # per-device tile buffers of the assignment scan: the [b, k] distance
+        # + one-hot blocks for batch_rows-row tiles, plus the (k, d) centers
+        # and sums (replicated)
+        k = int(params.get("n_clusters", 8))
+        b = min(int(params.get("max_samples_per_batch", 32768)), max(1, rows_per_device))
+        return {
+            "tile_buffers": 2 * b * k * itemsize,
+            "centers": 2 * k * n_cols * itemsize,
+        }
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -182,16 +198,42 @@ class KMeans(_KMeansParams, _TpuEstimator):
             else:  # small k: classic k-means++ (exactness-friendly for tests)
                 centers0 = kmeans_plus_plus_init(x_init, k, seed, w_init)
             centers0 = centers0.astype(inputs.dtype)
-            state = kmeans_fit(
-                inputs.X,
-                inputs.w,
-                centers0,
-                mesh=inputs.mesh,
-                max_iter=int(params["max_iter"]),
-                tol=float(params["tol"]),
-                batch_rows=int(params.get("max_samples_per_batch", 32768)),
-                precision_mode=str(params.get("distance_precision", "fast")),
-            )
+            if inputs.stream is not None:
+                # out-of-core: per-chunk assignment + center accumulation
+                # under the SAME deferred-convergence host loop and the SAME
+                # checkpoint key as the resident fit. Runs at full (ambient)
+                # precision — `distance_precision="fast"` applies to the
+                # resident in-loop matmuls only.
+                from ..ops.streaming import kmeans_fit_streaming
+
+                # the streaming kernel materializes its [chunk_dev, k]
+                # distance/one-hot buffers UNTILED, while the workspace
+                # estimate charges tiles of at most max_samples_per_batch
+                # rows — clamp the chunk so the per-device slice never
+                # exceeds the tile the admission verdict budgeted for
+                # (smaller chunks only shrink the admitted working set)
+                b = int(params.get("max_samples_per_batch", 32768))
+                n_dev = int(inputs.mesh.devices.size)
+                inputs.stream.chunk_rows = max(
+                    1, min(int(inputs.stream.chunk_rows), b * n_dev)
+                )
+                state = kmeans_fit_streaming(
+                    inputs,
+                    centers0,
+                    max_iter=int(params["max_iter"]),
+                    tol=float(params["tol"]),
+                )
+            else:
+                state = kmeans_fit(
+                    inputs.X,
+                    inputs.w,
+                    centers0,
+                    mesh=inputs.mesh,
+                    max_iter=int(params["max_iter"]),
+                    tol=float(params["tol"]),
+                    batch_rows=int(params.get("max_samples_per_batch", 32768)),
+                    precision_mode=str(params.get("distance_precision", "fast")),
+                )
             return {
                 "cluster_centers_": np.asarray(state["cluster_centers_"]),
                 "inertia_": float(state["inertia_"]),

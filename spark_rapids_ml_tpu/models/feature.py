@@ -61,6 +61,19 @@ class PCA(_PCAParams, _TpuEstimator):
 
     # fit is one pure SPMD program over (X, w): correct under multi-process
     _supports_multiprocess = True
+    # the (mean, covariance) statistics are accumulable over row chunks: an
+    # over-HBM dataset demotes to ops/streaming.pca_fit_streaming
+    _supports_streaming_fit = True
+
+    def _solver_workspace_terms(
+        self, rows_per_device: int, n_cols: int, params: Dict[str, Any], itemsize: int
+    ) -> Dict[str, int]:
+        # replicated d x d covariance (+ eigenvector output of equal size)
+        # and the mean / variance d-vectors
+        return {
+            "covariance": 2 * n_cols * n_cols * itemsize,
+            "vectors": 2 * n_cols * itemsize,
+        }
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
@@ -94,6 +107,18 @@ class PCA(_PCAParams, _TpuEstimator):
                 raise ValueError(f"k must be >= 1, got {k}")
             if k > inputs.n_cols:
                 raise ValueError(f"k={k} exceeds the number of features {inputs.n_cols}")
+            if inputs.stream is not None:
+                # out-of-core: two streamed passes (mean, then centered
+                # covariance), same finish kernel as the resident fit
+                from ..ops.streaming import pca_fit_streaming
+
+                state = pca_fit_streaming(inputs, k=k)
+                out = {name: np.asarray(v) for name, v in state.items()}
+                check_pca_state(out, k=k)
+                record_pca_fit(out, k=k)
+                out["n_cols"] = inputs.n_cols
+                out["dtype"] = np.dtype(inputs.dtype).name
+                return out
             # elastic recovery: retain the (mean, covariance) statistics so a
             # transient retry (or a k sweep in this stage) skips the data pass
             use_ckpt = _ckpt.solver_checkpoints_active() and (

@@ -196,6 +196,19 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
     # linear_fit_ell) with full dense parity — centering happens on the
     # sufficient statistics, never the data
     _supports_sparse_input = True
+    # sufficient statistics are accumulable over row chunks: an over-HBM
+    # dataset demotes to ops/streaming.linear_fit_streaming (dense + ELL)
+    _supports_streaming_fit = True
+
+    def _solver_workspace_terms(
+        self, rows_per_device: int, n_cols: int, params: Dict[str, Any], itemsize: int
+    ) -> Dict[str, int]:
+        # the replicated normal-equation solve: gram (d,d) + the handful of
+        # d-vectors of the sufficient-statistics tuple (sx, c, scale, coef)
+        return {
+            "gram": n_cols * n_cols * itemsize,
+            "vectors": 4 * n_cols * itemsize,
+        }
 
     def _get_tpu_fit_func(self, extracted: ExtractedData):
         from .. import checkpoint as _ckpt
@@ -219,6 +232,19 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
             )
+            if inputs.stream is not None:
+                # out-of-core: one streamed statistics pass, same replicated
+                # solve (docs/robustness.md "Memory safety")
+                from ..ops.streaming import linear_fit_streaming
+
+                state = linear_fit_streaming(inputs, **common)
+                return {
+                    "coef_": np.asarray(state["coef_"]),
+                    "intercept_": float(state["intercept_"]),
+                    "n_iter_": int(state["n_iter_"]),
+                    "n_cols": inputs.n_cols,
+                    "dtype": np.dtype(inputs.dtype).name,
+                }
             # elastic recovery: retain the sufficient statistics (the one
             # data pass) on host so a transient retry — and every further
             # sequential param set in this fit stage — solves without

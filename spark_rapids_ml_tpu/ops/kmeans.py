@@ -218,6 +218,44 @@ def _lloyd_step_1dev(X, w, centers, batch_rows, fast=False):
 _ONE_DISPATCH_MAX_BYTES = 2 << 30
 
 
+@jax.jit
+def block_assign_accumulate(xb: jax.Array, wb: jax.Array, centers: jax.Array):
+    """One streaming chunk's Lloyd contribution: (sums [k,d], counts [k],
+    inertia) — the same assignment + one-hot accumulation math as the
+    resident tile step (`_tile_assign_accumulate`), over ONE placed row
+    block. The out-of-core driver (ops/streaming.py) sums these per-chunk
+    partials across the double-buffered pipeline; padding rows carry zero
+    weight, so they contribute nothing — exactly the resident pad contract."""
+    k = centers.shape[0]
+    c_sq = jnp.sum(centers * centers, axis=1)
+    d2 = c_sq[None, :] - 2.0 * (xb @ centers.T)
+    assign = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.min(d2, axis=1) + jnp.sum(xb * xb, axis=1)
+    oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
+    return (
+        oh.T @ xb,
+        jnp.sum(oh, axis=0),
+        jnp.sum(jnp.maximum(min_d2, 0.0) * wb),
+    )
+
+
+def kmeans_ckpt_key(init_centers, max_iter: int, tol: float) -> str:
+    """Trajectory-identifying checkpoint key shared by the resident and
+    streaming Lloyd loops: init-centers digest + shape + loop statics. ONE
+    format for both, so a resident fit's checkpoint resumes a streaming
+    retry (the OOM-demotion ladder) and vice versa — centers are replicated,
+    fully portable state."""
+    import hashlib
+
+    import numpy as np
+
+    init_digest = hashlib.sha1(
+        np.ascontiguousarray(np.asarray(init_centers)).tobytes()
+    ).hexdigest()[:12]
+    shape = tuple(np.shape(init_centers))
+    return f"kmeans:{shape}:{init_digest}:{max_iter}:{tol}"
+
+
 def _raise_diverged(iteration: int, last_good_centers, detail: str) -> None:
     """Typed divergence error off the already-fetched per-iteration shift:
     carries the iterate that ENTERED the diverging update (still finite)."""
@@ -314,14 +352,7 @@ def kmeans_fit(
         # fingerprint (one tiny host fetch, once per fit) plus the loop
         # statics pin the trajectory; tol/maxIter only move the STOP point
         # on it, but keying them too keeps the entries disjoint and cheap.
-        import hashlib
-
-        init_digest = hashlib.sha1(
-            np.ascontiguousarray(np.asarray(init_centers)).tobytes()
-        ).hexdigest()[:12]
-        ckpt_key = (
-            f"kmeans:{tuple(jnp.shape(centers))}:{init_digest}:{max_iter}:{tol}"
-        )
+        ckpt_key = kmeans_ckpt_key(init_centers, max_iter, tol)
         saved = ckpt_store.load(ckpt_key)
         if saved is not None and tuple(saved.state["centers"].shape) == tuple(
             jnp.shape(centers)
@@ -365,11 +396,14 @@ def kmeans_fit(
                     "last_good": np.asarray(last_good),
                 },
             ))
-            # mid-solve fault injection point (`fail:stage=solve` plans):
-            # fires AFTER the boundary checkpoint landed, so a retried fit
-            # provably resumes instead of restarting Lloyd from scratch
+            # mid-solve fault injection points (`fail:stage=solve` and
+            # `oom:stage=solve` plans): both fire AFTER the boundary
+            # checkpoint landed, so a retried fit — bounded transient retry
+            # or the OOM demotion to the streaming path — provably resumes
+            # instead of restarting Lloyd from scratch
             from ..parallel import chaos
 
+            chaos.maybe_fail_oom("solve", n_iter)
             chaos.maybe_fail_stage("solve", n_iter)
     if telemetry.enabled():
         telemetry.record_solver_result("kmeans", n_iter=n_iter)

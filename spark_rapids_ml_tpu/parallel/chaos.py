@@ -21,6 +21,24 @@
 #                                  consults `maybe_fail_stage`) — the injected
 #                                  "transient rendezvous fault" of the
 #                                  retry-to-bit-identical acceptance test
+#   oom:budget=1048576             SHRINK the HBM budget: memory.admit_fit
+#                                  consults `injected_hbm_budget()` and budgets
+#                                  the next admission against this many bytes —
+#                                  the fit-entry demotion ladder (RESIDENT ->
+#                                  STREAM -> HbmBudgetError) testable without a
+#                                  real TPU
+#   oom:stage=solve:round=2        simulated ALLOCATION FAILURE: raise a
+#                                  RESOURCE_EXHAUSTED-shaped RuntimeError at
+#                                  the named stage — `placement` fires before
+#                                  layout (its round= index is the
+#                                  retry/recovery ATTEMPT, so round=1 targets
+#                                  the re-placement of a recovery attempt),
+#                                  `solve` fires at solver checkpoint
+#                                  boundaries (round= = the iteration) —
+#                                  exercising the catch-convert-retry-
+#                                  streaming path end to end. rank= restricts
+#                                  either oom form to one process
+#                                  (diagnostics process rank).
 #
 # Every entry fires at most `times` times (default 1), so a retried attempt
 # runs clean — exactly the transient-fault shape the fit driver retries.
@@ -46,10 +64,12 @@ __all__ = [
     "clear_fault_plan",
     "active_plan",
     "maybe_fail_stage",
+    "maybe_fail_oom",
+    "injected_hbm_budget",
     "ChaosRendezvous",
 ]
 
-_KINDS = {"kill", "abort", "delay", "drop", "fail"}
+_KINDS = {"kill", "abort", "delay", "drop", "fail", "oom"}
 
 
 @dataclass
@@ -67,6 +87,9 @@ class Fault:
     # in-process injector (the kill itself is identical); consumed by
     # subprocess harnesses (tests/chaos_worker.py, ci/chaos_smoke.py).
     respawn: int = 0
+    # `oom` faults: injected per-device HBM budget in bytes (0 = this entry is
+    # a simulated allocation failure at stage/round instead)
+    budget: int = 0
     fired: int = field(default=0)
 
     def spent(self) -> bool:
@@ -107,11 +130,18 @@ def parse_fault_plan(spec: str) -> List[Fault]:
                 fault.times = int(v)
             elif k == "respawn":
                 fault.respawn = int(v)
+            elif k == "budget":
+                fault.budget = int(v)
             else:
                 raise ValueError(f"unknown fault field {k!r} in plan entry {entry!r}")
         if fault.kind == "fail":
             if fault.stage is None:
                 raise ValueError(f"fail fault needs stage=<name>: {entry!r}")
+        elif fault.kind == "oom":
+            if fault.budget <= 0 and fault.stage is None:
+                raise ValueError(
+                    f"oom fault needs budget=<bytes> or stage=<name>: {entry!r}"
+                )
         elif fault.rank is None or fault.round is None:
             raise ValueError(f"{fault.kind} fault needs rank= and round=: {entry!r}")
         faults.append(fault)
@@ -146,6 +176,63 @@ def clear_fault_plan() -> None:
     global _PLAN, _PLAN_LOADED
     _PLAN = []
     _PLAN_LOADED = True
+
+
+def _rank_matches(f: Fault) -> bool:
+    """`rank=`-restricted oom faults fire only on the named process (the
+    diagnostics process rank — TpuContext rank or SRML_RANK/set_process_rank
+    where no context exists). An unset rank matches every process."""
+    if f.rank is None:
+        return True
+    from .. import diagnostics
+
+    return diagnostics._rank() == f.rank
+
+
+def injected_hbm_budget() -> Optional[int]:
+    """The shrunken per-device HBM budget injected by an un-spent
+    `oom:budget=<bytes>` fault, consuming one firing — or None. Consulted by
+    `memory.device_capacity_bytes` ahead of every other capacity source, so a
+    plan entry demotes exactly `times` admissions."""
+    from .. import diagnostics
+
+    for f in active_plan():
+        if f.kind == "oom" and f.budget > 0 and not f.spent() and _rank_matches(f):
+            f.fired += 1
+            diagnostics.record_event(
+                "chaos_injection", fault="oom", budget=f.budget
+            )
+            return f.budget
+    return None
+
+
+def maybe_fail_oom(stage: str, index: int = 0) -> None:
+    """Simulated allocation failure: an un-spent `oom:stage=<s>` fault whose
+    `round=` (when set) matches `index` raises a RESOURCE_EXHAUSTED-shaped
+    RuntimeError — indistinguishable to `memory.is_oom_error` from a real
+    backend OOM, so the catch-convert-retry-streaming ladder is exercised end
+    to end. Call sites: core layout (`placement`, index 0) and the solver
+    checkpoint boundaries (`solve`, index = iteration)."""
+    from .. import diagnostics
+
+    for f in active_plan():
+        if (
+            f.kind != "oom"
+            or f.budget > 0
+            or f.stage != stage
+            or f.spent()
+            or (f.round is not None and f.round != index)
+            or not _rank_matches(f)
+        ):
+            continue
+        f.fired += 1
+        diagnostics.record_event(
+            "chaos_injection", fault="oom", stage=stage, index=index
+        )
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: chaos injected allocation failure at stage "
+            f"{stage!r} (index {index})"
+        )
 
 
 def maybe_fail_stage(stage: str, attempt: int) -> None:
@@ -193,7 +280,7 @@ class ChaosRendezvous(Rendezvous):
             # same round of the recovery attempt — a second loss that
             # exhausts the budget (found by the kill-at-every-round sweep).
             if (
-                f.kind == "fail"
+                f.kind in ("fail", "oom")  # stage/budget hooks, not rdv rounds
                 or f.spent()
                 or f.rank != self.orig_rank
                 or f.round != round_index

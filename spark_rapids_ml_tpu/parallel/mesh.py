@@ -394,6 +394,71 @@ def place_rows(
     return place_row_shards(mesh, x)
 
 
+def stream_place_blocks(mesh: Mesh, host_blocks):
+    """Double-buffered host->HBM chunk pipeline — the out-of-core fits'
+    transfer engine (docs/robustness.md "Memory safety").
+
+    `host_blocks` is an iterator of dicts of SAME-row-count host arrays (one
+    streaming chunk: features + labels + weights + ...); each is placed
+    row-sharded over `mesh` via `place_rows` (numpy's zero tail-padding makes
+    padded weight rows weightless for free) and yielded as the same-keyed
+    dict of device arrays. The pipeline dispatches chunk N+1's `device_put`
+    BEFORE yielding chunk N, so the H2D transfer of the next chunk is in
+    flight while the caller computes on the current one — two chunks resident
+    at once, never the dataset.
+
+    Telemetry (per drained pass): `ingest.stream_chunks`/`ingest.stream_rows`
+    counters, a `device.{peak_,}bytes_in_use` watermark sample at every chunk
+    boundary (so out-of-core peaks are visible, not just post-layout/post-
+    solve ones), and the `ingest.overlap_fraction` gauge — the fraction of
+    prefetched chunks whose transfer had COMPLETED by the time the caller
+    finished computing on the previous chunk, probed via `Array.is_ready`
+    where the backend exposes it (dispatch-order fallback otherwise: the
+    transfer was at least in flight during the compute). (n-1)/n when fully
+    pipelined; the acceptance assertion is simply > 0 on any multi-chunk
+    fit, and ~0 there means the transfer is slower than the compute — a
+    broken (serialized) pipeline, or chunks too small to amortize."""
+    it = iter(host_blocks)
+
+    def _place(d: dict) -> dict:
+        return {k: place_rows(mesh, np.ascontiguousarray(v)) for k, v in d.items()}
+
+    def _transfer_done(placed: dict) -> bool:
+        try:
+            return all(bool(a.is_ready()) for a in placed.values())
+        except Exception:
+            return True  # no is_ready on this backend: dispatch-order fallback
+
+    try:
+        cur_host = next(it)
+    except StopIteration:
+        return
+    total = overlapped = 0
+    rows = 0
+    cur = _place(cur_host)
+    rows += next(iter(cur_host.values())).shape[0]
+    for nxt_host in it:
+        # dispatch N+1 BEFORE handing N to the caller: the generator resumes
+        # after the yield only once the caller finished computing on chunk N,
+        # so the prefetched transfer runs concurrently with that compute
+        nxt = _place(nxt_host)
+        rows += next(iter(nxt_host.values())).shape[0]
+        total += 1
+        telemetry.record_device_memory()  # out-of-core watermark sample
+        yield cur
+        if _transfer_done(nxt):  # finished while the caller computed
+            overlapped += 1
+        cur = nxt
+    total += 1
+    telemetry.record_device_memory()
+    yield cur
+    if telemetry.enabled():
+        reg = telemetry.registry()
+        reg.inc("ingest.stream_chunks", total)
+        reg.inc("ingest.stream_rows", rows)
+        reg.gauge("ingest.overlap_fraction", overlapped / total)
+
+
 def make_global_rows(
     mesh: Mesh,
     x: np.ndarray,

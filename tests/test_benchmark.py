@@ -23,6 +23,8 @@ SMOKE = {
                           "--num_queries", "64"],
     "approximate_nearest_neighbors": ["--num_rows", "1000", "--num_cols", "16", "--k", "4",
                                       "--num_queries", "64", "--nlist", "16", "--nprobe", "4"],
+    "oocore": ["--num_rows", "4000", "--num_cols", "16", "--chunk_rows", "1024",
+               "--maxIter", "3"],
     "dbscan": ["--num_rows", "500", "--num_cols", "8", "--eps", "3.0"],
     "umap": ["--num_rows", "400", "--num_cols", "8", "--n_epochs", "30"],
 }
