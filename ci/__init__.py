@@ -1,0 +1,2 @@
+# CI tooling package root — makes `python -m ci.analysis` resolvable from the
+# repo root (ci/test.sh and the ci/lint.py shim both run from there).

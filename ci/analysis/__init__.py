@@ -1,0 +1,31 @@
+#
+# ci.analysis — framework-aware static analysis gate. AST engine + rule
+# catalog replacing ci/lint.py's line regexes (that file is now a thin shim
+# over this package). Entry points:
+#
+#   python -m ci.analysis              # analyze the repo, exit 1 on new findings
+#   python -m ci.analysis --json       # machine-readable verdict on stdout
+#   python -m ci.analysis --json-out F # verdict artifact for CI (ci/test.sh)
+#   python -m ci.analysis --write-baseline   # freeze/shrink the ratchet
+#
+# docs/development.md: rule catalog, waiver policy, baseline workflow.
+#
+from .cli import main
+from .engine import (
+    FileContext,
+    Finding,
+    RegistrySources,
+    Run,
+    RuleBase,
+    analyze_source,
+)
+
+__all__ = [
+    "main",
+    "Finding",
+    "FileContext",
+    "RegistrySources",
+    "Run",
+    "RuleBase",
+    "analyze_source",
+]

@@ -1,0 +1,80 @@
+#
+# Baseline ratchet: a new rule lands with its known findings FROZEN in
+# ci/analysis/baseline.json (counts per `<path>:<rule-id>` — line numbers
+# drift with unrelated edits, so positions are not pinned) and ratcheted
+# down from there. Semantics:
+#
+#   * a finding whose key count exceeds the baseline is NEW -> gate fails;
+#   * findings at or under their baselined count pass (reported as
+#     "baselined", never silently dropped);
+#   * when a file gets BETTER (count drops, incl. to zero) the stale
+#     entries are reported and `--write-baseline` shrinks the file — the
+#     ratchet only ever tightens.
+#
+# The acceptance state for this repo is an EMPTY baseline: every finding is
+# fixed or carries a reasoned waiver at the line itself.
+#
+from __future__ import annotations
+
+import json
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .engine import Finding
+
+VERSION = 1
+
+
+def load(path: str) -> Dict[str, int]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    counts = data.get("counts", {})
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def dump(path: str, counts: Dict[str, int]) -> None:
+    payload = {
+        "version": VERSION,
+        "counts": {k: v for k, v in sorted(counts.items()) if v > 0},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+@dataclass
+class Verdict:
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale: Dict[str, int] = field(default_factory=dict)  # key -> unused slack
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def apply(findings: List[Finding], baseline: Dict[str, int]) -> Verdict:
+    """Split findings into new vs baselined. Within one key, the EARLIEST
+    findings (file order) consume the baseline budget — deterministic, so
+    the same tree always reports the same new findings."""
+    verdict = Verdict()
+    budget = dict(baseline)
+    for f in findings:  # findings arrive sorted by (path, line, col, rule)
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            verdict.baselined.append(f)
+        else:
+            verdict.new.append(f)
+    current = Counter(f.key for f in findings)
+    for key, allowed in sorted(baseline.items()):
+        if current.get(key, 0) < allowed:
+            verdict.stale[key] = allowed - current.get(key, 0)
+    return verdict
+
+
+def current_counts(findings: List[Finding]) -> Dict[str, int]:
+    return dict(Counter(f.key for f in findings))

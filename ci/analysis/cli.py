@@ -1,0 +1,221 @@
+#
+# CLI for the analysis gate. Text mode prints `file:line:col [rule-id]
+# message` per NEW finding; `--json` / `--json-out` emit the machine-
+# readable verdict (the artifact ci/test.sh stores next to the perf
+# regression gate's). Exit 0 iff no new findings and the import smoke
+# passes.
+#
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from . import baseline as baseline_mod
+from .engine import Run
+
+DEFAULT_TARGETS = ("spark_rapids_ml_tpu", "benchmark", "tests")
+# import-time breakage must fail the gate (the old lint.py contract)
+IMPORT_SMOKE = ("spark_rapids_ml_tpu", "benchmark.benchmark_runner")
+VERDICT_VERSION = 1
+# finding ids emitted by the engine itself, outside any registered rule —
+# listed so the verdict's catalog covers every id a finding can carry
+ENGINE_RULE_IDS = (
+    ("syntax-error", "file fails the in-memory compile() check"),
+    ("encoding", "file is not valid utf-8"),
+)
+
+
+def _catalog(run: Run):
+    rows = []
+    for r in run.rules:
+        rows.append({"id": r.id, "waiver": r.waiver, "description": r.description})
+        for sub_id, sub_desc in getattr(r, "sub_ids", ()):
+            rows.append({"id": sub_id, "waiver": r.waiver, "description": sub_desc})
+    for rule_id, desc in ENGINE_RULE_IDS:
+        rows.append({"id": rule_id, "waiver": None, "description": desc})
+    return rows
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _import_smoke(root: str) -> Dict[str, str]:
+    results: Dict[str, str] = {}
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    for mod in IMPORT_SMOKE:
+        try:
+            importlib.import_module(mod)
+            results[mod] = "ok"
+        except Exception as e:
+            results[mod] = f"error: {e!r}"
+    return results
+
+
+def build_verdict(
+    run: Run,
+    verdict: baseline_mod.Verdict,
+    baseline_path: str,
+    imports: Dict[str, str],
+) -> Dict:
+    ok = (
+        verdict.ok
+        and not run.missing_targets
+        and all(v == "ok" for v in imports.values())
+    )
+    findings = [dict(f.as_dict(), status="new") for f in verdict.new] + [
+        dict(f.as_dict(), status="baselined") for f in verdict.baselined
+    ]
+    findings.sort(key=lambda d: (d["path"], d["line"], d["col"], d["rule"]))
+    return {
+        "version": VERDICT_VERSION,
+        "verdict": "pass" if ok else "fail",
+        "files_scanned": run.files_scanned,
+        "missing_targets": list(run.missing_targets),
+        "rules": _catalog(run),
+        "findings": findings,
+        "baseline": {
+            "path": baseline_path,
+            "stale": verdict.stale,
+            "counts": baseline_mod.current_counts(run.findings),
+        },
+        "imports": imports,
+        "dynamic_metric_names": sorted(run.dynamic_names),
+        "skipped_paths": sorted(run.skipped),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ci.analysis",
+        description="framework-aware AST static analysis gate (docs/development.md)",
+    )
+    ap.add_argument("targets", nargs="*", default=list(DEFAULT_TARGETS),
+                    help=f"trees to analyze under --root (default: {' '.join(DEFAULT_TARGETS)})")
+    ap.add_argument("--root", default=None, help="repo root (default: this checkout)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: ci/analysis/baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings (ratchet: shrink or "
+                         "hold; growth is refused without --allow-baseline-growth)")
+    ap.add_argument("--allow-baseline-growth", action="store_true",
+                    help="let --write-baseline add keys / raise counts — ONLY for "
+                         "landing a new rule with its known findings frozen")
+    ap.add_argument("--json", action="store_true", help="print the JSON verdict on stdout")
+    ap.add_argument("--json-out", default=None, help="also write the JSON verdict here")
+    ap.add_argument("--no-imports", action="store_true",
+                    help="skip the package import smoke (fixture runs)")
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root or _repo_root())
+    run = Run(root, targets=args.targets)
+
+    if args.list_rules:
+        for row in _catalog(run):
+            waiver = f"# {row['waiver']}-ok: <reason>" if row["waiver"] else "(no waiver)"
+            print(f"{row['id']:24s} {waiver:28s} {row['description']}")
+        return 0
+
+    baseline_path = args.baseline or os.path.join(
+        root, "ci", "analysis", "baseline.json"
+    )
+    run.analyze()
+    baseline = baseline_mod.load(baseline_path)
+    verdict = baseline_mod.apply(run.findings, baseline)
+
+    if args.write_baseline:
+        if run.missing_targets:
+            for t in run.missing_targets:
+                print(f"analysis: target `{t}` does not exist under {root} — refusing to write a baseline from a partial scan")
+            return 1
+        counts = baseline_mod.current_counts(run.findings)
+        # a subset run (explicit sub-targets) must not erase entries for
+        # trees it never scanned: preserve baseline keys for paths OUTSIDE
+        # every scanned target prefix, ratchet only what this run covered
+        # (a deleted file under a scanned target is covered — its entry
+        # drops, as it should)
+        # normalize the CLI spelling ('./spark_rapids_ml_tpu', trailing /)
+        # to the repo-relative form finding paths use
+        scanned = [
+            os.path.normpath(t).replace(os.sep, "/") for t in run.targets
+        ]
+        # the registry rules' finalize pass emits findings at the schema/doc
+        # paths on EVERY run, so those are covered (ratchetable) even though
+        # they sit outside the scanned code trees
+        finalize_paths = {
+            run.sources.config_schema_relpath,
+            run.sources.config_docs_relpath,
+            run.sources.metric_docs_relpath,
+        }
+
+        def covered(path: str) -> bool:
+            return path in finalize_paths or any(
+                path == t or path.startswith(t + "/") for t in scanned
+            )
+
+        counts = dict(
+            {k: v for k, v in baseline.items() if not covered(k.rsplit(":", 1)[0])},
+            **counts,
+        )
+        grown = {
+            k: (baseline.get(k, 0), v)
+            for k, v in sorted(counts.items())
+            if v > baseline.get(k, 0)
+        }
+        if grown and not args.allow_baseline_growth:
+            # the ratchet only tightens: new violations are fixed or waived,
+            # never parked — growth is reserved for landing a new rule
+            for key, (old, new) in grown.items():
+                print(f"analysis: refusing to grow baseline {key}: {old} -> {new}")
+            print(
+                "analysis: --write-baseline would GROW the baseline; fix/waive "
+                "the findings above, or pass --allow-baseline-growth when "
+                "landing a new rule (docs/development.md)"
+            )
+            return 1
+        baseline_mod.dump(baseline_path, counts)
+        print(
+            f"analysis: baseline written to {baseline_path} "
+            f"({len(run.findings)} finding(s) across {len(counts)} key(s))"
+        )
+        return 0
+
+    imports = {} if args.no_imports else _import_smoke(root)
+    payload = build_verdict(run, verdict, baseline_path, imports)
+
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        for f_ in verdict.new:
+            print(f_.render())
+        for t in run.missing_targets:
+            print(f"analysis: target `{t}` does not exist under {root} — nothing scanned")
+        for mod, status in imports.items():
+            if status != "ok":
+                print(f"import {mod}: {status}")
+        if verdict.stale:
+            stale = ", ".join(f"{k} (-{v})" for k, v in sorted(verdict.stale.items()))
+            print(
+                f"analysis: baseline is stale — findings fixed under: {stale}; "
+                "run `python -m ci.analysis --write-baseline` to ratchet down"
+            )
+        n_new = len(verdict.new) + len(run.missing_targets)
+        n_imp = sum(1 for v in imports.values() if v != "ok")
+        if payload["verdict"] == "pass":
+            print(
+                f"analysis: OK ({run.files_scanned} files, {len(run.rules)} rules, "
+                f"{len(verdict.baselined)} baselined finding(s))"
+            )
+        else:
+            print(f"analysis: {n_new + n_imp} issue(s)")
+    return 0 if payload["verdict"] == "pass" else 1

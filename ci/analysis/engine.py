@@ -1,0 +1,387 @@
+#
+# AST lint engine: the framework-aware replacement for ci/lint.py's line
+# regexes. One pass per file — explicit utf-8 read, in-memory `compile()`
+# syntax check (no __pycache__ litter), tokenize for comments/waivers,
+# `ast.parse` for structure — then every rule walks the module with full
+# scope/import context. Findings are structured (`file:line:col rule-id
+# message`) so the CLI can render text or a machine-readable JSON verdict,
+# and a checked-in baseline (ci/analysis/baseline.json) lets a new rule land
+# with known findings frozen and ratcheted down (docs/development.md).
+#
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# A waiver comment must START with the tag (a mention inside prose — e.g. a
+# doc comment quoting "`# hbm-ok` waiver" — is not a waiver) and must carry a
+# `: <reason>` suffix to actually suppress; a bare tag is itself a finding
+# (rules/hygiene.py `waiver-missing-reason`).
+_WAIVER_RE = re.compile(r"^#\s*([a-z][a-z0-9_]*(?:-[a-z0-9_]+)*)-ok\b(:?)\s*(.*)$")
+
+# Paths the gate never analyzes: bytecode caches, generated trees, and
+# notebook exports (mechanical .ipynb conversions carry cell magics and
+# duplicated output the rules would false-positive on).
+_SKIP_DIR_NAMES = {"__pycache__", "generated", "_generated", ".ipynb_checkpoints"}
+_SKIP_FILE_SUFFIXES = ("_nb.py", ".nbconvert.py", "_nb_export.py")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline ratchet key: line numbers drift with unrelated edits, so
+        the baseline counts findings per (file, rule) instead of pinning
+        exact positions."""
+        return f"{self.path}:{self.rule}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+def build_imports(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> dotted origin, so rules match CALLS not spellings:
+    `import time as t; t.sleep(...)` and `from time import sleep` both
+    resolve to `time.sleep`. Relative imports keep their tail (`from ..core
+    import config` -> `core.config`) — rules match on suffixes."""
+    imports: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    imports[a.asname] = a.name
+                else:
+                    # `import a.b.c` binds only `a` locally
+                    root = a.name.split(".", 1)[0]
+                    imports[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                origin = f"{mod}.{a.name}" if mod else a.name
+                imports[a.asname or a.name] = origin
+    return imports
+
+
+def dotted(node: ast.AST, imports: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """Resolve an attribute chain to a dotted path with import aliases
+    applied; None when the chain is rooted in something dynamic (a call, a
+    subscript)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = node.id
+    if imports:
+        root = imports.get(root, root)
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def node_span(node: ast.AST) -> Tuple[int, int]:
+    line = getattr(node, "lineno", 1)
+    return line, getattr(node, "end_lineno", None) or line
+
+
+class FileContext:
+    """Everything a rule may ask about the file under analysis."""
+
+    def __init__(self, run: "Run", path: str, relpath: str, target: str, source: str):
+        self.run = run
+        self.path = path
+        self.relpath = relpath
+        self.target = target  # top-level tree the file was discovered under
+        self.filename = os.path.basename(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.imports: Dict[str, str] = {}
+        # lineno -> full comment text (one comment token per line in Python)
+        self.comments: Dict[int, str] = {}
+        # lineno -> {tag: reason}; reason == "" means the bare (invalid) form
+        self.waivers: Dict[int, Dict[str, str]] = {}
+        self.findings: List[Finding] = []
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+                    m = _WAIVER_RE.match(tok.string)
+                    if m:
+                        tag, colon, reason = m.group(1), m.group(2), m.group(3).strip()
+                        self.waivers.setdefault(tok.start[0], {})[tag] = (
+                            reason if colon else ""
+                        )
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # the compile() check reports the syntax error itself
+
+    def waived(self, tag: Optional[str], node: ast.AST) -> bool:
+        """A finding is waived when a line its node spans carries
+        `# <tag>-ok: <reason>`. For statements WITH a body (While/If/Try/
+        FunctionDef) only the header lines count — otherwise a waiver
+        written for one call deep inside a loop body would silently waive
+        the loop-level finding too. A reason-less waiver does NOT suppress —
+        the waiver itself is the finding then."""
+        if tag is None:
+            return False
+        lo, hi = node_span(node)
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body and hasattr(body[0], "lineno"):
+            hi = max(lo, body[0].lineno - 1)
+        for ln in range(lo, hi + 1):
+            reason = self.waivers.get(ln, {}).get(tag)
+            if reason:
+                return True
+        return False
+
+    def emit(self, rule: "RuleBase", node: ast.AST, message: str) -> None:
+        if self.waived(rule.waiver, node):
+            return
+        self.findings.append(
+            Finding(
+                path=self.relpath,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0) + 1,
+                rule=rule.id,
+                message=message,
+            )
+        )
+
+    def emit_at(self, rule_id: str, line: int, col: int, message: str) -> None:
+        self.findings.append(
+            Finding(path=self.relpath, line=line, col=col, rule=rule_id, message=message)
+        )
+
+
+class RuleBase:
+    """One invariant. `check_module` walks a parsed file (rules own their
+    traversal — structural rules need custom context the shared walker can't
+    anticipate); `finalize` runs once after every file, for cross-file rules
+    (the registries). docs/development.md documents the catalog + how to add
+    one."""
+
+    id: str = ""
+    waiver: Optional[str] = None  # waiver tag; comment form `# <tag>-ok: <reason>`
+    tree_scope: Tuple[str, ...] = ("spark_rapids_ml_tpu",)
+    exempt_files: frozenset = frozenset()
+    description: str = ""
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.target in self.tree_scope and ctx.filename not in self.exempt_files
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        raise NotImplementedError
+
+    def finalize(self, run: "Run") -> List[Finding]:
+        return []
+
+
+@dataclass
+class RegistrySources:
+    """The declared-schema side of the registry rules, injectable so fixture
+    tests can run them against synthetic schemas/docs."""
+
+    config_schema_keys: Dict[str, int] = field(default_factory=dict)  # key -> lineno
+    config_schema_relpath: str = "spark_rapids_ml_tpu/core.py"
+    config_docs_text: str = ""
+    config_docs_relpath: str = "docs/configuration.md"
+    metric_docs_text: str = ""
+    metric_docs_relpath: str = "docs/observability.md"
+    # relpaths load() expected but could not read: a moved/renamed schema or
+    # doc must FAIL the registry rules, never silently disable them (fixture
+    # sources constructed directly leave this empty on purpose)
+    missing: List[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, root: str) -> "RegistrySources":
+        src = cls()
+        schema_path = os.path.join(root, src.config_schema_relpath)
+        if os.path.exists(schema_path):
+            with open(schema_path, encoding="utf-8") as f:
+                src.config_schema_keys = extract_config_schema(f.read())
+        else:
+            src.missing.append(src.config_schema_relpath)
+        for attr, rel in (
+            ("config_docs_text", src.config_docs_relpath),
+            ("metric_docs_text", src.metric_docs_relpath),
+        ):
+            p = os.path.join(root, rel)
+            if os.path.exists(p):
+                with open(p, encoding="utf-8") as f:
+                    setattr(src, attr, f.read())
+            else:
+                src.missing.append(rel)
+        return src
+
+
+def extract_config_schema(core_source: str) -> Dict[str, int]:
+    """String keys (with line numbers) of the module-level `config = {...}`
+    literal in core.py — the one declared schema the config-key rule checks
+    usages against."""
+    keys: Dict[str, int] = {}
+    tree = ast.parse(core_source)
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            target = node.target.id
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            target = node.targets[0].id
+        if target == "config" and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys[k.value] = k.lineno
+    return keys
+
+
+class Run:
+    """One analysis invocation: discover files, run rules, collect findings."""
+
+    def __init__(
+        self,
+        root: str,
+        targets: Sequence[str] = ("spark_rapids_ml_tpu", "benchmark", "tests"),
+        rules: Optional[Sequence[RuleBase]] = None,
+        sources: Optional[RegistrySources] = None,
+    ):
+        if rules is None:
+            from .rules import default_rules
+
+            rules = default_rules()
+        self.root = os.path.abspath(root)
+        self.targets = list(targets)
+        self.rules = list(rules)
+        self.sources = sources if sources is not None else RegistrySources.load(self.root)
+        self.findings: List[Finding] = []
+        self.files_scanned = 0
+        self.skipped: List[str] = []
+        self.missing_targets: List[str] = []
+        # names metric/config rules could not check statically (f-strings,
+        # variables) — surfaced in the verdict so dynamic names are a visible
+        # gap, not a silent one
+        self.dynamic_names: List[str] = []
+
+    def discover(self) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        for target in self.targets:
+            base = os.path.join(self.root, target)
+            if os.path.isfile(base) and base.endswith(".py"):
+                out.append((target, base))
+                continue
+            if not os.path.isdir(base):
+                # a typo'd/renamed target must FAIL the gate, not produce a
+                # green zero-file pass
+                self.missing_targets.append(target)
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIR_NAMES and d != "notebooks"
+                )
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    if fn.endswith(_SKIP_FILE_SUFFIXES):
+                        self.skipped.append(
+                            os.path.relpath(os.path.join(dirpath, fn), self.root)
+                        )
+                        continue
+                    out.append((target, os.path.join(dirpath, fn)))
+        return out
+
+    def analyze_file(self, target: str, path: str) -> List[Finding]:
+        relpath = os.path.relpath(path, self.root).replace(os.sep, "/")
+        with open(path, "rb") as f:
+            raw = f.read()
+        try:
+            # explicit: no locale-dependent reads in CI; -sig strips a BOM,
+            # which CPython accepts but compile(str) would reject as U+FEFF
+            source = raw.decode("utf-8-sig")
+        except UnicodeDecodeError as e:
+            return [Finding(relpath, 1, 1, "encoding", f"not valid utf-8: {e}")]
+        return self.analyze_one(path, relpath, source)
+
+    def analyze_one(self, path: str, relpath: str, source: str) -> List[Finding]:
+        """One file through the whole pipeline — compile gate, parse, rule
+        dispatch, text-only fallback. Shared by the tree scan and the
+        fixture entry point so they cannot drift."""
+        # rules scope on the TOP-LEVEL tree, not the CLI spelling: a sub-path
+        # target (`python -m ci.analysis spark_rapids_ml_tpu/ops`) must run
+        # the same rules as the full tree, never a silently rule-less pass
+        target = relpath.split("/", 1)[0]
+        ctx = FileContext(self, path, relpath, target, source)
+        try:
+            # hermetic syntax gate: in-memory compile, no __pycache__ litter
+            compile(source, path, "exec", dont_inherit=True)
+            ctx.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            ctx.emit_at("syntax-error", e.lineno or 1, (e.offset or 0) + 1, e.msg or "syntax error")
+        except ValueError as e:
+            # e.g. a NUL byte: valid utf-8, but compile() rejects it — a
+            # per-file finding, never a gate crash (py_compile parity)
+            ctx.emit_at("syntax-error", 1, 1, str(e) or "uncompilable source")
+        if ctx.tree is not None:
+            ctx.imports = build_imports(ctx.tree)
+            for rule in self.rules:
+                if rule.applies(ctx):
+                    rule.check_module(ctx.tree, ctx)
+        else:
+            # text-level hygiene still runs on unparsable files
+            for rule in self.rules:
+                if getattr(rule, "text_only", False) and rule.applies(ctx):
+                    rule.check_module(None, ctx)  # type: ignore[arg-type]
+        return ctx.findings
+
+    def analyze(self) -> List[Finding]:
+        for target, path in self.discover():
+            self.findings.extend(self.analyze_file(target, path))
+            self.files_scanned += 1
+        for rule in self.rules:
+            self.findings.extend(rule.finalize(self))
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self.findings
+
+
+def analyze_source(
+    source: str,
+    relpath: str = "spark_rapids_ml_tpu/snippet.py",
+    rules: Optional[Sequence[RuleBase]] = None,
+    sources: Optional[RegistrySources] = None,
+    root: str = "/",
+) -> List[Finding]:
+    """Fixture-test entry point: run rules over one in-memory snippet as if
+    it lived at `relpath` under the repo root — the exact same pipeline as
+    the tree scan (analyze_one), so fixtures cannot drift from production
+    behavior."""
+    run = Run(root, targets=(), rules=rules, sources=sources or RegistrySources())
+    findings = list(run.analyze_one(relpath, relpath, source))
+    for rule in run.rules:
+        findings.extend(rule.finalize(run))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,63 @@
+#
+# Rule catalog (docs/development.md has the rationale per invariant). Two
+# tiers: AST ports of the six regex-era rules, and the framework-aware
+# detectors regexes cannot express. `default_rules()` returns FRESH
+# instances — the registry rules accumulate per-run state.
+#
+from __future__ import annotations
+
+from typing import List
+
+from ..engine import RuleBase
+from .blocking import BlockingRule
+from .hostsync import HostSyncRule
+from .hygiene import KNOWN_WAIVER_TAGS, HygieneRule
+from .jsonl import JsonlRule
+from .memstats import MemStatsRule
+from .padrows import PadRowsRule
+from .purity import TracedImpurityRule
+from .registries import ConfigKeyRule, MetricNameRule
+from .sleeps import SleepRule
+from .spmd import SpmdDivergenceRule
+from .timing import PerfCounterRule
+
+
+def default_rules() -> List[RuleBase]:
+    rules: List[RuleBase] = [
+        HygieneRule(),
+        # --- AST ports of the regex-era gate -----------------------------
+        PerfCounterRule(),
+        BlockingRule(),
+        JsonlRule(),
+        SleepRule(),
+        MemStatsRule(),
+        PadRowsRule(),
+        # --- framework-aware detectors -----------------------------------
+        SpmdDivergenceRule(),
+        HostSyncRule(),
+        TracedImpurityRule(),
+        ConfigKeyRule(),
+        MetricNameRule(),
+    ]
+    # the hygiene waiver-form check must know every tag the catalog uses
+    tags = {r.waiver for r in rules if r.waiver}
+    missing = tags - KNOWN_WAIVER_TAGS
+    assert not missing, f"rules/hygiene.KNOWN_WAIVER_TAGS is missing {missing}"
+    return rules
+
+
+__all__ = [
+    "default_rules",
+    "HygieneRule",
+    "PerfCounterRule",
+    "BlockingRule",
+    "JsonlRule",
+    "SleepRule",
+    "MemStatsRule",
+    "PadRowsRule",
+    "SpmdDivergenceRule",
+    "HostSyncRule",
+    "TracedImpurityRule",
+    "ConfigKeyRule",
+    "MetricNameRule",
+]

@@ -1,0 +1,64 @@
+#
+# AST port of the unbounded-blocking rule: `while True` poll loops and bare
+# `.wait()` calls with no timeout are how a dead peer becomes a HUNG process
+# instead of a typed RankFailedError/RendezvousTimeoutError
+# (docs/robustness.md "Guard rails"). All bounded waiting lives in
+# parallel/context.py — the one deadline owner; anywhere else a blocking
+# construct must carry `# blocking-ok: <reason>` naming its bound. The AST
+# form no longer trips on `while True` inside strings/comments, and —
+# unlike the regex — `.wait(timeout)` with a positional bound passes.
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase
+
+
+class BlockingRule(RuleBase):
+    id = "unbounded-blocking"
+    waiver = "blocking"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    exempt_files = frozenset({"context.py"})  # the deadline owner
+    description = "while-True loops and timeout-less .wait() outside the deadline owner"
+
+    @staticmethod
+    def _unbounded_wait(node: ast.Call) -> bool:
+        """Bare `.wait()` — and the spelled-out equivalents `.wait(None)` /
+        `.wait(timeout=None)`, which block forever just the same."""
+        if not node.args and not node.keywords:
+            return True
+        args = [a for a in node.args] + [k.value for k in node.keywords]
+        if len(args) != 1:
+            return False
+        (arg,) = args
+        return isinstance(arg, ast.Constant) and arg.value is None
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.While):
+                test = node.test
+                if isinstance(test, ast.Constant) and bool(test.value) is True:
+                    ctx.emit(
+                        self,
+                        node,
+                        "unbounded `while True` in the framework — a dead peer "
+                        "must raise a typed error, not hang; bound it with a "
+                        "deadline (see parallel/context.py) or mark "
+                        "`# blocking-ok: <reason>`",
+                    )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "wait"
+                    and self._unbounded_wait(node)
+                ):
+                    ctx.emit(
+                        self,
+                        node,
+                        "`.wait()` with no timeout in the framework — a dead "
+                        "peer must raise a typed error, not hang; pass a "
+                        "deadline (see parallel/context.py) or mark "
+                        "`# blocking-ok: <reason>`",
+                    )

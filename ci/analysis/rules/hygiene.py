@@ -1,0 +1,62 @@
+#
+# Text-level hygiene carried over from the regex-era gate (tabs, trailing
+# whitespace) plus the waiver-form contract: every `# <tag>-ok` waiver must
+# carry a `: <reason>` suffix — a reason-less waiver suppresses nothing and
+# is itself a finding, so the rationale for every exemption lives next to it.
+#
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..engine import FileContext, RuleBase
+
+# tags whose `<tag>-ok` comments are waivers (kept in sync with the rule
+# catalog by rules/__init__.default_rules, which unions in every rule.waiver)
+KNOWN_WAIVER_TAGS = {
+    "telemetry",
+    "blocking",
+    "sink",
+    "sleep",
+    "hbm",
+    "bucket",
+    "spmd",
+    "host-fetch",
+    "traced",
+    "config",
+    "metric",
+}
+
+
+class HygieneRule(RuleBase):
+    id = "hygiene"
+    waiver = None
+    tree_scope = ("spark_rapids_ml_tpu", "benchmark", "tests")
+    text_only = True  # runs even when the file fails to parse
+    description = "tabs, trailing whitespace, and reason-less waiver comments"
+    # the ids this rule actually emits findings under (verdict catalog rows)
+    sub_ids = (
+        ("tab", "tab character"),
+        ("trailing-whitespace", "trailing whitespace"),
+        ("waiver-missing-reason", "`# <tag>-ok` waiver without the required `: <reason>`"),
+    )
+
+    def check_module(self, tree: Optional[ast.Module], ctx: FileContext) -> None:
+        for lineno, line in enumerate(ctx.lines, 1):
+            if "\t" in line:
+                ctx.emit_at("tab", lineno, line.index("\t") + 1, "tab character")
+            if line != line.rstrip():
+                ctx.emit_at(
+                    "trailing-whitespace", lineno, len(line.rstrip()) + 1, "trailing whitespace"
+                )
+        for lineno, tags in sorted(ctx.waivers.items()):
+            for tag, reason in tags.items():
+                if tag in KNOWN_WAIVER_TAGS and not reason:
+                    ctx.emit_at(
+                        "waiver-missing-reason",
+                        lineno,
+                        1,
+                        f"`# {tag}-ok` waiver without a reason — the required "
+                        f"form is `# {tag}-ok: <reason>` (docs/development.md); "
+                        "a bare waiver suppresses nothing",
+                    )

@@ -1,0 +1,69 @@
+#
+# AST port of the JSONL-bypass rule: framework JSONL emission goes through
+# the telemetry sink (`telemetry._sink_write`) or the flight recorder
+# (`diagnostics.FlightRecorder.dump`) — the two owners that tag records with
+# rank + trace ids and keep per-rank files from interleaving. A hand-rolled
+# `f.write(json.dumps(...) + "\n")` elsewhere produces records the trace
+# merge and post-mortem assemblers cannot correlate. The AST form matches a
+# real `.write(...)` call whose payload contains a `json.dumps` call, or a
+# `json.dumps(...) + "\n"` concatenation — never the pattern quoted in a
+# docstring. Non-JSONL json uses (json.dump to a metadata file, bare
+# json.dumps control-plane payloads) don't match.
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase, dotted
+
+
+def _contains_json_dumps(node: ast.AST, imports) -> bool:
+    return any(
+        isinstance(sub, ast.Call) and dotted(sub.func, imports) == "json.dumps"
+        for sub in ast.walk(node)
+    )
+
+
+class JsonlRule(RuleBase):
+    id = "jsonl-bypass"
+    waiver = "sink"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    exempt_files = frozenset({"telemetry.py", "diagnostics.py"})  # the two sink owners
+    description = "hand-rolled JSONL emission outside the telemetry/flight-recorder sinks"
+
+    _MSG = (
+        "hand-rolled JSONL emission in the framework — records must flow "
+        "through the telemetry sink or flight recorder (rank + trace-id "
+        "tagging, per-rank files) or mark `# sink-ok: <reason>`"
+    )
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        # nodes already covered by a flagged `.write(...)` — the BinOp
+        # branch must not double-report the same violation
+        inside_flagged_write: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "write"
+                    and node.args
+                    and _contains_json_dumps(node.args[0], ctx.imports)
+                ):
+                    ctx.emit(self, node, self._MSG)
+                    inside_flagged_write.update(id(n) for n in ast.walk(node.args[0]))
+        for node in ast.walk(tree):
+            if id(node) in inside_flagged_write:
+                continue
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+                sides = (node.left, node.right)
+                has_dumps = any(
+                    isinstance(s, ast.Call)
+                    and dotted(s.func, ctx.imports) == "json.dumps"
+                    for s in sides
+                )
+                has_newline = any(
+                    isinstance(s, ast.Constant) and s.value == "\n" for s in sides
+                )
+                if has_dumps and has_newline:
+                    ctx.emit(self, node, self._MSG)

@@ -1,0 +1,41 @@
+#
+# AST port of the direct-memstats rule: HBM accounting goes through the
+# admission budgeter (memory.py — capacity resolution, chaos-injected
+# budgets, config override order) and the telemetry watermark sampler
+# (telemetry.record_device_memory). A direct `Device.memory_stats()` call
+# elsewhere bypasses the `hbm_budget_bytes` override and the chaos
+# `oom:budget=` injection, so the code under test budgets against a
+# DIFFERENT capacity than the admission controller — exactly the
+# split-brain the memory-safety plane exists to prevent
+# (docs/robustness.md "Memory safety").
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase
+
+
+class MemStatsRule(RuleBase):
+    id = "direct-memstats"
+    waiver = "hbm"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    exempt_files = frozenset({"memory.py", "telemetry.py"})  # budgeter + watermark sampler
+    description = "direct Device.memory_stats() outside the admission budgeter"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "memory_stats"
+            ):
+                ctx.emit(
+                    self,
+                    node,
+                    "direct memory_stats() in the framework — HBM capacity "
+                    "flows through the admission budgeter "
+                    "(memory.device_capacity_bytes: honors hbm_budget_bytes + "
+                    "chaos budgets) or the telemetry watermark sampler; use "
+                    "them or mark `# hbm-ok: <why>`",
+                )

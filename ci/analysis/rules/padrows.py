@@ -1,0 +1,38 @@
+#
+# AST port of the raw-pad-rows rule: transform/serving code pads batches
+# through the bucket ladder (parallel/mesh.py bucket_rows), never raw
+# pad_rows — an exact-shape pad mints one compiled `predict` program per
+# distinct tail shape (tens of seconds each on a TPU backend) where the
+# ladder compiles once per bucket (docs/performance.md "Multi-fit engine").
+# pad_rows stays legal inside mesh.py itself (the ladder is built on it) and
+# on lines carrying `# bucket-ok: <reason>` (fit-side layout code, where
+# every fit pads to ONE shape anyway).
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase, dotted
+
+
+class PadRowsRule(RuleBase):
+    id = "raw-pad-rows"
+    waiver = "bucket"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    exempt_files = frozenset({"mesh.py"})  # the ladder is built on pad_rows
+    description = "raw pad_rows outside the bucket ladder"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func, ctx.imports)
+            if name is not None and name.split(".")[-1] == "pad_rows":
+                ctx.emit(
+                    self,
+                    node,
+                    "raw pad_rows in the framework — serving batches pad "
+                    "through the bucket ladder (mesh.bucket_rows: one compile "
+                    "per bucket, not per tail shape); use it or mark "
+                    "`# bucket-ok: <reason>`",
+                )

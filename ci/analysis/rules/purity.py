@@ -1,0 +1,181 @@
+#
+# Traced-impurity detector: Python side effects inside functions that jax
+# TRACES — jit/vmap targets, `lax.while_loop`/`scan`/`fori_loop`/`cond`
+# bodies — run exactly once, at trace time, and never again for the
+# compiled program's lifetime. A `print`, a `time.*` read, a telemetry call,
+# or a closure-list `.append` inside a solver body therefore records one
+# stale value per COMPILE instead of one per iteration — silently. The
+# sanctioned escape hatch is `jax.debug.callback`/`jax.debug.print` (how
+# ops/owlqn.py and ops/logistic.py stream per-iteration convergence points,
+# gated at trace time behind SRML_TRACE_CONVERGENCE); anything else is a
+# finding.
+#
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..engine import FileContext, RuleBase, dotted
+
+# call targets whose function-valued arguments are traced
+_TRACING_TAILS = {
+    "jit",
+    "vmap",
+    "pmap",
+    "while_loop",
+    "scan",
+    "fori_loop",
+    "cond",
+    "switch",
+    "map",
+    "remat",
+    "checkpoint",
+    "shard_map",
+    "grad",
+    "value_and_grad",
+}
+# side-effect escape hatches: their argument subtrees are host callbacks by
+# design, not trace-time effects
+_ESCAPE_TAILS = {"callback", "print", "pure_callback", "io_callback", "host_callback"}
+_MUTATORS = {"append", "extend", "insert", "add"}
+
+
+def _is_jax_call(name: Optional[str]) -> bool:
+    return name is not None and (
+        name.startswith(("jax.", "lax.", "jnp.")) or name in ("jit", "vmap", "shard_map")
+    )
+
+
+def _is_tracing_call(name: Optional[str]) -> bool:
+    return _is_jax_call(name) and name.split(".")[-1] in _TRACING_TAILS
+
+
+def _is_escape_call(name: Optional[str]) -> bool:
+    return _is_jax_call(name) and name.split(".")[-1] in _ESCAPE_TAILS
+
+
+class TracedImpurityRule(RuleBase):
+    id = "traced-impurity"
+    waiver = "traced"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    description = "Python side effects inside jit/vmap/while_loop/scan bodies (run once at trace time)"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        traced: Set[int] = set()  # id() of traced function nodes
+        traced_nodes: List[ast.AST] = []
+
+        def mark(fn: ast.AST) -> None:
+            if id(fn) not in traced:
+                traced.add(id(fn))
+                traced_nodes.append(fn)
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._decorator_traces(dec, ctx):
+                        mark(node)
+            if isinstance(node, ast.Call) and _is_tracing_call(dotted(node.func, ctx.imports)):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        mark(arg)
+                    elif isinstance(arg, ast.Name):
+                        for fn in defs.get(arg.id, []):
+                            mark(fn)
+
+        # a local function CALLED from a traced body is traced too
+        idx = 0
+        while idx < len(traced_nodes):
+            fn = traced_nodes[idx]
+            idx += 1
+            body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+            for sub in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                    for cand in defs.get(sub.func.id, []):
+                        mark(cand)
+
+        for fn in traced_nodes:
+            self._check_traced(fn, ctx)
+
+    def _decorator_traces(self, dec: ast.AST, ctx: FileContext) -> bool:
+        name = dotted(dec, ctx.imports)
+        if _is_tracing_call(name):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_tracing_call(dotted(dec.func, ctx.imports)):
+                return True  # @jax.jit(static_argnums=...)
+            fname = dotted(dec.func, ctx.imports)
+            if fname is not None and fname.split(".")[-1] == "partial" and dec.args:
+                return _is_tracing_call(dotted(dec.args[0], ctx.imports))
+        return False
+
+    def _check_traced(self, fn: ast.AST, ctx: FileContext) -> None:
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        local_names: Set[str] = set()
+        args = fn.args
+        for p in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            local_names.add(p.arg)
+        for sub in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.NamedExpr, ast.For)):
+                target = getattr(sub, "targets", None) or [getattr(sub, "target")]
+                for t in target:
+                    for s in ast.walk(t):
+                        if isinstance(s, ast.Name):
+                            local_names.add(s.id)
+        for stmt in body:
+            self._scan(stmt, ctx, local_names)
+
+    def _scan(self, node: ast.AST, ctx: FileContext, local_names: Set[str]) -> None:
+        if isinstance(node, ast.Call):
+            name = dotted(node.func, ctx.imports)
+            if _is_escape_call(name):
+                return  # jax.debug.callback(...) subtree: the sanctioned hatch
+            if isinstance(node.func, ast.Name) and node.func.id == "print":
+                ctx.emit(
+                    self,
+                    node,
+                    "print() inside a traced function runs once at trace "
+                    "time, not per execution — use jax.debug.print, or mark "
+                    "`# traced-ok: <reason>`",
+                )
+            elif name is not None and name.startswith("time."):
+                ctx.emit(
+                    self,
+                    node,
+                    f"`{name}` inside a traced function reads the clock once "
+                    "at trace time and bakes the value into the compiled "
+                    "program — time on the host side, or mark "
+                    "`# traced-ok: <reason>`",
+                )
+            elif name is not None and (
+                name.startswith("telemetry.") or ".telemetry." in f".{name}"
+            ):
+                ctx.emit(
+                    self,
+                    node,
+                    f"`{name}` called directly inside a traced function "
+                    "records once at trace time — route per-iteration "
+                    "telemetry through jax.debug.callback (see "
+                    "ops/owlqn.py), or mark `# traced-ok: <reason>`",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id not in local_names
+            ):
+                ctx.emit(
+                    self,
+                    node,
+                    f"`.{node.func.attr}()` on closed-over "
+                    f"`{node.func.value.id}` inside a traced function "
+                    "mutates it once at trace time, not per execution — "
+                    "carry state through the loop carry / return value, or "
+                    "mark `# traced-ok: <reason>`",
+                )
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, ctx, local_names)

@@ -1,0 +1,263 @@
+#
+# Registry-drift detectors: the framework's stringly-typed surfaces —
+# `config["..."]` keys and `"<subsystem>.<name>"` metric strings — are held
+# in sync with their declared schemas by CI instead of by review.
+#
+# config-key: every `config["..."]` / `config.get("...")` read or write in
+# the framework + benchmark trees must name a key declared in the
+# module-level `config = {...}` literal in spark_rapids_ml_tpu/core.py, and
+# every declared key must appear in docs/configuration.md's table (and vice
+# versa). A typo'd key silently reads a default or creates a dead entry;
+# this makes it a CI failure instead of a review catch.
+#
+# metric-name: every constant counter/gauge/histogram/convergence name
+# handed to the telemetry registry must appear in docs/observability.md,
+# and undocumented names are checked against the documented set for
+# near-miss typos (edit distance 1 — `ingest.row` vs `ingest.rows`).
+# Dynamic names (f-strings like f"{solver}.fits") cannot be checked
+# statically; they are counted in the verdict's `dynamic_names` so the gap
+# is visible, never silent.
+#
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import FileContext, Finding, RuleBase, Run, dotted
+
+_METRIC_METHODS = {"inc", "gauge", "gauge_max", "observe"}
+_CONVERGENCE_FUNCS = {"record_convergence_point", "record_convergence"}
+_DOC_NAME_RE = re.compile(r"\b[a-z0-9_]+(?:\.[a-z0-9_]+)+\b")
+_DOC_QUOTED_RE = re.compile(r"\"([a-z0-9_.]+)\"")
+_DOC_TABLE_KEY_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+
+
+def _edit_distance_le_1(a: str, b: str) -> bool:
+    if a == b:
+        return True
+    la, lb = len(a), len(b)
+    if abs(la - lb) > 1:
+        return False
+    if la > lb:
+        a, b, la, lb = b, a, lb, la
+    # la <= lb; one substitution (equal length) or one insertion into a
+    i = j = diffs = 0
+    while i < la and j < lb:
+        if a[i] == b[j]:
+            i += 1
+            j += 1
+            continue
+        diffs += 1
+        if diffs > 1:
+            return False
+        if la == lb:
+            i += 1
+        j += 1
+    return diffs + (lb - j) + (la - i) <= 1
+
+
+class ConfigKeyRule(RuleBase):
+    id = "config-key"
+    waiver = "config"
+    tree_scope = ("spark_rapids_ml_tpu", "benchmark")
+    description = "config[...] keys checked against the core.config schema and docs/configuration.md"
+
+    def __init__(self) -> None:
+        # (key, relpath, line, col)
+        self.usages: List[Tuple[str, str, int, int]] = []
+
+    def _is_core_config(self, node: ast.AST, ctx: FileContext) -> bool:
+        name = dotted(node, ctx.imports)
+        if name is None:
+            return False
+        if name == "config":
+            # an UNRESOLVED bare `config` is the schema dict only inside the
+            # module that defines it; elsewhere it is a local/parameter of
+            # that name (imports of the real dict resolve to core.config)
+            return ctx.filename == "core.py" and ctx.relpath.startswith(
+                "spark_rapids_ml_tpu/"
+            )
+        return name.endswith("core.config")
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            key_node: Optional[ast.Constant] = None
+            if isinstance(node, ast.Subscript) and self._is_core_config(node.value, ctx):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    key_node = sl
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "setdefault", "pop")
+                and self._is_core_config(node.func.value, ctx)
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                key_node = node.args[0]
+            if key_node is not None and not ctx.waived(self.waiver, node):
+                self.usages.append(
+                    (key_node.value, ctx.relpath, node.lineno, node.col_offset + 1)
+                )
+
+    def finalize(self, run: Run) -> List[Finding]:
+        out: List[Finding] = []
+        schema = run.sources.config_schema_keys
+        docs = run.sources.config_docs_text
+        if self.usages:
+            # a moved/renamed schema or doc must fail, not silently disable
+            # the checks for the usages this run collected
+            for rel in (
+                run.sources.config_schema_relpath,
+                run.sources.config_docs_relpath,
+            ):
+                if rel in run.sources.missing:
+                    out.append(
+                        Finding(
+                            rel,
+                            1,
+                            1,
+                            self.id,
+                            f"registry source `{rel}` is missing — "
+                            f"{len(self.usages)} config-key usage(s) cannot be "
+                            "checked; a silently disabled registry rule is a "
+                            "green pass that checks nothing",
+                        )
+                    )
+        for key, relpath, line, col in self.usages:
+            if key not in schema:
+                out.append(
+                    Finding(
+                        relpath,
+                        line,
+                        col,
+                        self.id,
+                        f"unknown config key `{key}` — not declared in the "
+                        f"{run.sources.config_schema_relpath} `config` schema; a typo "
+                        "here silently reads a default (or creates a dead "
+                        "entry) instead of the knob you meant",
+                    )
+                )
+        if docs:
+            doc_keys: Dict[str, int] = {}
+            for lineno, line_text in enumerate(docs.splitlines(), 1):
+                m = _DOC_TABLE_KEY_RE.match(line_text)
+                if m:
+                    doc_keys.setdefault(m.group(1), lineno)
+            for key, schema_line in sorted(schema.items()):
+                if f"`{key}`" not in docs:
+                    out.append(
+                        Finding(
+                            run.sources.config_schema_relpath,
+                            schema_line,
+                            1,
+                            self.id,
+                            f"config key `{key}` is declared in the schema but "
+                            f"undocumented in {run.sources.config_docs_relpath} — "
+                            "registry drift",
+                        )
+                    )
+            for key, doc_line in sorted(doc_keys.items()):
+                if key not in schema:
+                    out.append(
+                        Finding(
+                            run.sources.config_docs_relpath,
+                            doc_line,
+                            1,
+                            self.id,
+                            f"documented config key `{key}` does not exist in the "
+                            f"{run.sources.config_schema_relpath} `config` schema — "
+                            "registry drift",
+                        )
+                    )
+        return out
+
+
+class MetricNameRule(RuleBase):
+    id = "metric-name"
+    waiver = "metric"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    description = "telemetry metric names checked against docs/observability.md (+ near-miss typos)"
+
+    def __init__(self) -> None:
+        self.usages: List[Tuple[str, str, int, int]] = []
+
+    def _collect(self, name_node: ast.AST, at: ast.AST, ctx: FileContext) -> None:
+        if isinstance(name_node, ast.Constant) and isinstance(name_node.value, str):
+            if not ctx.waived(self.waiver, at):
+                self.usages.append(
+                    (name_node.value, ctx.relpath, at.lineno, at.col_offset + 1)
+                )
+        else:
+            ctx.run.dynamic_names.append(f"{ctx.relpath}:{at.lineno}")
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_METHODS
+                and node.args
+            ):
+                self._collect(node.args[0], node, ctx)
+                continue
+            name = dotted(func, ctx.imports)
+            tail = name.split(".")[-1] if name else None
+            if tail in _CONVERGENCE_FUNCS and node.args:
+                self._collect(node.args[0], node, ctx)
+            elif tail == "partial" and len(node.args) >= 2:
+                inner = dotted(node.args[0], ctx.imports)
+                if inner and inner.split(".")[-1] in _CONVERGENCE_FUNCS:
+                    self._collect(node.args[1], node, ctx)
+
+    def finalize(self, run: Run) -> List[Finding]:
+        docs = run.sources.metric_docs_text
+        if self.usages and run.sources.metric_docs_relpath in run.sources.missing:
+            return [
+                Finding(
+                    run.sources.metric_docs_relpath,
+                    1,
+                    1,
+                    self.id,
+                    f"registry source `{run.sources.metric_docs_relpath}` is "
+                    f"missing — {len(self.usages)} metric name(s) cannot be "
+                    "checked; a silently disabled registry rule is a green "
+                    "pass that checks nothing",
+                )
+            ]
+        if not docs:
+            return []
+        declared: Set[str] = set(_DOC_NAME_RE.findall(docs))
+        declared.update(_DOC_QUOTED_RE.findall(docs))
+        used_names = {u[0] for u in self.usages}
+        out: List[Finding] = []
+        for name, relpath, line, col in self.usages:
+            if name in declared:
+                continue
+            near = sorted(
+                n
+                for n in declared | (used_names - {name})
+                if _edit_distance_le_1(name, n)
+            )
+            hint = (
+                f" — near-miss of `{near[0]}` (typo?)"
+                if near
+                else ""
+            )
+            out.append(
+                Finding(
+                    relpath,
+                    line,
+                    col,
+                    self.id,
+                    f"metric name `{name}` is not documented in "
+                    f"{run.sources.metric_docs_relpath}{hint}; every registry "
+                    "name ships with its meaning, or dashboards and the "
+                    "regression gate's counter lanes drift",
+                )
+            )
+        return out

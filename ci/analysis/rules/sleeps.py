@@ -1,0 +1,34 @@
+#
+# AST port of the bare-sleep rule: `time.sleep` in the framework is either a
+# poll loop that should be event/deadline-driven or an ad-hoc delay that
+# stretches failure detection past its documented budget
+# (docs/robustness.md "Guard rails"). Sleeping is legal only for the
+# retry-backoff, heartbeat-pacing, and rendezvous-poll owners — every such
+# line carries `# sleep-ok: <reason>` naming its bound. The AST form matches
+# the resolved call through any alias (`from time import sleep`,
+# `import time as t`) and never a mention in a comment or string.
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase, dotted
+
+
+class SleepRule(RuleBase):
+    id = "bare-sleep"
+    waiver = "sleep"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    exempt_files = frozenset()  # no file-level owner: every sleep is waived by line
+    description = "bare time.sleep outside the retry/heartbeat/poll owners"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and dotted(node.func, ctx.imports) == "time.sleep":
+                ctx.emit(
+                    self,
+                    node,
+                    "bare time.sleep in the framework — sleeping belongs to "
+                    "the retry-backoff/heartbeat/poll owners; bound it and "
+                    "mark `# sleep-ok: <why>`",
+                )

@@ -1,0 +1,182 @@
+#
+# SPMD-divergence detector — the invariant PRs 3/5/6 detect at runtime
+# (deadline timeouts, flight-recorder post-mortems naming the blocked
+# round), caught before it ships: a control-plane collective (`allgather`,
+# `barrier`, `reform`, or the `allgather_concat` helper) that only SOME
+# ranks reach. Under the barrier-clique design (PAPER.md L4) every rank must
+# enter every round in lockstep; a collective guarded by a rank-identity
+# test (`rank`, `orig_rank`, `process_index`) or placed inside an `except`
+# handler (only ranks whose try body raised get there) hangs the survivors
+# until the round deadline, then kills the fit with
+# RendezvousTimeoutError. Rank-dependent PAYLOADS are fine (every rank still
+# calls the collective); rank-dependent REACHABILITY is the bug.
+#
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from ..engine import FileContext, RuleBase, dotted
+
+RANK_IDENTIFIERS = {"rank", "orig_rank", "process_index"}
+COLLECTIVE_ATTRS = {"allgather", "barrier", "reform"}
+COLLECTIVE_NAMES = {"allgather_concat"}
+
+
+def _mentions_rank(test: ast.AST) -> Optional[str]:
+    """The rank identifier a conditional tests, if any."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Name) and sub.id in RANK_IDENTIFIERS:
+            return sub.id
+        if isinstance(sub, ast.Attribute) and sub.attr in RANK_IDENTIFIERS:
+            return sub.attr
+    return None
+
+
+def _collective_name(node: ast.Call, imports) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in COLLECTIVE_ATTRS:
+        return func.attr
+    name = dotted(func, imports)
+    if name is not None and name.split(".")[-1] in COLLECTIVE_NAMES:
+        return name.split(".")[-1]
+    return None
+
+
+def _collectives_in(stmts, imports) -> List[str]:
+    """Ordered collective calls in a branch (nested functions excluded) —
+    used to recognize SYMMETRIC conditionals, where every arm performs the
+    same collective sequence and lockstep is preserved."""
+    out: List[str] = []
+    for stmt in stmts:
+        stack = [stmt]
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                name = _collective_name(node, imports)
+                if name is not None:
+                    out.append(name)
+            stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _has_early_exit(stmts, in_nested_loop: bool = False) -> bool:
+    """Does this branch body leave the enclosing block (return/raise, or a
+    continue/break at this loop level), making everything AFTER the
+    conditional unreachable for the ranks that took it? Nested functions
+    don't count (they exit the nested scope), and a break/continue inside a
+    NESTED loop only exits that inner loop, not the guarded block."""
+    for node in stmts:
+        if isinstance(node, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(node, (ast.Continue, ast.Break)) and not in_nested_loop:
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        nested = in_nested_loop or isinstance(
+            node, (ast.For, ast.AsyncFor, ast.While)
+        )
+        for field in ("body", "orelse", "finalbody"):
+            if _has_early_exit(getattr(node, field, []) or [], nested):
+                return True
+        for handler in getattr(node, "handlers", []) or []:
+            if _has_early_exit(handler.body, nested):
+                return True
+    return False
+
+
+class SpmdDivergenceRule(RuleBase):
+    id = "spmd-divergence"
+    waiver = "spmd"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    description = "collectives reachable by only some ranks (rank-conditional or except-handler)"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        self._visit_block(tree.body, ctx, [])
+
+    def _visit_block(
+        self, stmts, ctx: FileContext, stack: List[Tuple[str, int]]
+    ) -> None:
+        """Visit a statement SEQUENCE: a rank-guarded early exit
+        (`if rank != 0: return`) makes every later statement in the block
+        divergent-reachable too — the other failure spelling of the same
+        hang, where the collective sits in straight-line code below the
+        guard instead of inside it."""
+        stack = list(stack)
+        for stmt in stmts:
+            self._visit(stmt, ctx, stack)
+            if isinstance(stmt, ast.If):
+                rank_id = _mentions_rank(stmt.test)
+                if rank_id and (
+                    _has_early_exit(stmt.body) or _has_early_exit(stmt.orelse)
+                ):
+                    stack.append(
+                        (
+                            f"rank-identity conditional on `{rank_id}` with an "
+                            "early exit",
+                            stmt.lineno,
+                        )
+                    )
+
+    def _visit(self, node: ast.AST, ctx: FileContext, stack: List[Tuple[str, int]]) -> None:
+        # a nested function body does not execute under the enclosing
+        # conditional — it executes wherever it is CALLED — so the
+        # divergence context resets at every function boundary
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(node, ast.Lambda):
+                self._visit(node.body, ctx, [])
+            else:
+                self._visit_block(node.body, ctx, [])
+            return
+        if isinstance(node, ast.Call):
+            name = _collective_name(node, ctx.imports)
+            if name is not None and stack:
+                kind, line = stack[-1]
+                ctx.emit(
+                    self,
+                    node,
+                    f"collective `{name}` reachable by only some ranks — "
+                    f"{kind} (line {line}) lets ranks skip it, hanging peers "
+                    "in the round until the rendezvous deadline; hoist the "
+                    "collective so every rank reaches it (keep the payload "
+                    "rank-dependent instead) or mark `# spmd-ok: <reason>`",
+                )
+        if isinstance(node, (ast.If, ast.While)):
+            rank_id = _mentions_rank(node.test)
+            frame = (f"rank-identity conditional on `{rank_id}`", node.lineno)
+            self._visit(node.test, ctx, stack)
+            inner = stack + [frame] if rank_id else stack
+            if rank_id and isinstance(node, ast.If) and node.orelse:
+                # symmetric conditional: every arm performs the SAME
+                # collective sequence, so every rank still enters every
+                # round — only the payload is rank-dependent, which is the
+                # documented correct pattern
+                body_c = _collectives_in(node.body, ctx.imports)
+                else_c = _collectives_in(node.orelse, ctx.imports)
+                if body_c and body_c == else_c:
+                    inner = stack
+            self._visit_block(node.body, ctx, inner)
+            self._visit_block(node.orelse, ctx, inner)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._visit(node.iter, ctx, stack)
+            self._visit_block(node.body, ctx, stack)
+            self._visit_block(node.orelse, ctx, stack)
+            return
+        if isinstance(node, ast.Try):
+            self._visit_block(node.body, ctx, stack)
+            for handler in node.handlers:
+                frame = ("except handler", handler.lineno)
+                self._visit_block(handler.body, ctx, stack + [frame])
+            self._visit_block(node.orelse, ctx, stack)
+            self._visit_block(node.finalbody, ctx, stack)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self._visit(item.context_expr, ctx, stack)
+            self._visit_block(node.body, ctx, stack)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, ctx, stack)

@@ -1,179 +1,21 @@
 #
-# Minimal lint gate (the reference runs mypy+black+isort via ci/lint_python.py;
-# none of those are baked into this image, so the gate checks what the
-# toolchain supports everywhere: every source file compiles, has no tabs, no
-# trailing whitespace, and the package + benchmark roots import cleanly).
+# Thin shim over the AST analysis gate (ci/analysis/) so existing
+# `python ci/lint.py` invocations keep working. The regex-era rules this
+# file used to implement are now AST rules with exact call/attribute
+# matching — `.wait()` in a comment or string no longer trips, and every
+# waiver must carry a `: <reason>` suffix. Rule catalog, waiver policy, and
+# the baseline ratchet: docs/development.md.
 #
 from __future__ import annotations
 
 import pathlib
-import py_compile
-import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGETS = ["spark_rapids_ml_tpu", "benchmark", "tests"]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))  # the script lives in ci/, the package resolves from the repo root
 
-# Stage timing inside the framework goes through telemetry spans
-# (spark_rapids_ml_tpu/telemetry.py), not hand-rolled perf_counter deltas —
-# ad-hoc timing is invisible to the registry/JSONL sinks and drifts from the
-# span taxonomy. perf_counter is allowed in telemetry.py itself (the one
-# clock owner) and on lines carrying an explicit `# telemetry-ok` waiver
-# (none needed today; the allowlist mechanism exists for genuinely
-# non-telemetry uses, e.g. a future jitter probe).
-_PERF_COUNTER_TREE = "spark_rapids_ml_tpu"
-_PERF_COUNTER_EXEMPT_FILES = {"telemetry.py"}
+from ci.analysis import main
 
-# Unbounded blocking waits (`while True` poll loops, bare `Barrier.wait()` /
-# `Event.wait()` with no timeout) are how a dead peer becomes a HUNG process
-# instead of a typed RankFailedError/RendezvousTimeoutError (docs/
-# robustness.md). All bounded waiting lives in parallel/context.py — the one
-# deadline owner; anywhere else in the framework a blocking wait must carry a
-# `# blocking-ok` waiver explaining its bound.
-_BLOCKING_TREE = "spark_rapids_ml_tpu"
-_BLOCKING_EXEMPT_FILES = {"context.py"}
-_BLOCKING_RE = re.compile(r"while\s+True\b|\.wait\(\s*\)")
-
-# Framework JSONL emission goes through the telemetry/diagnostics sinks
-# (telemetry._sink_write, diagnostics.FlightRecorder.dump) — the two owners
-# that tag records with rank + trace ids and keep per-rank files from
-# interleaving. A hand-rolled `f.write(json.dumps(...) + "\n")` elsewhere
-# produces records the trace merge and post-mortem assemblers cannot
-# correlate. Non-JSONL json uses (model save metadata via json.dump,
-# control-plane payloads via bare json.dumps) don't match; a genuinely
-# non-telemetry JSONL writer carries a `# sink-ok` waiver.
-_JSONL_TREE = "spark_rapids_ml_tpu"
-_JSONL_EXEMPT_FILES = {"telemetry.py", "diagnostics.py"}
-_JSONL_RE = re.compile(
-    r"""\.write\(\s*json\.dumps|json\.dumps\([^)]*\)\s*\+\s*(['"])\\n\1"""
-)
-
-# Bare `time.sleep` in the framework is either a poll loop that should be
-# event/deadline-driven or an ad-hoc delay that stretches failure detection
-# past its documented budget. Sleeping is legal only for the retry/backoff,
-# heartbeat-pacing, and rendezvous-poll owners (core.retryable_stage's capped
-# backoff, parallel/context.py's poll ticks + heartbeat Event.wait,
-# parallel/chaos.py's injected delays) — every such line carries `# sleep-ok`
-# naming its bound, as must any future waiver.
-_SLEEP_TREE = "spark_rapids_ml_tpu"
-_SLEEP_EXEMPT_FILES: set = set()
-_SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
-
-# HBM accounting goes through the admission budgeter (memory.py — capacity
-# resolution, chaos-injected budgets, config override order) and the
-# telemetry watermark sampler (telemetry.record_device_memory). A direct
-# `Device.memory_stats()` call elsewhere bypasses the `hbm_budget_bytes`
-# override and the chaos `oom:budget=` injection, so the code under test
-# budgets against a DIFFERENT capacity than the admission controller —
-# exactly the split-brain the memory-safety plane exists to prevent (docs/
-# robustness.md "Memory safety"). A genuinely read-only probe carries a
-# `# hbm-ok` waiver naming why it must not flow through memory.py.
-_MEMSTATS_TREE = "spark_rapids_ml_tpu"
-_MEMSTATS_EXEMPT_FILES = {"memory.py", "telemetry.py"}
-_MEMSTATS_RE = re.compile(r"\.memory_stats\s*\(")
-
-# Transform/serving code pads batches through the bucket ladder
-# (parallel/mesh.py bucket_rows), never raw pad_rows: an exact-shape pad
-# mints one compiled `predict` program per distinct tail shape — tens of
-# seconds each on a TPU backend — where the ladder compiles once per bucket
-# (docs/performance.md "Multi-fit engine"). pad_rows stays legal inside
-# mesh.py itself (the ladder is built on it) and on lines carrying an
-# explicit `# bucket-ok` waiver (fit-side layout code, where every fit pads
-# to ONE shape anyway).
-_PAD_ROWS_TREE = "spark_rapids_ml_tpu"
-_PAD_ROWS_EXEMPT_FILES = {"mesh.py"}
-_PAD_ROWS_RE = re.compile(r"\bpad_rows\s*\(")
-
-failures: list[str] = []
-for target in TARGETS:
-    for path in sorted((ROOT / target).rglob("*.py")):
-        try:
-            py_compile.compile(str(path), doraise=True)
-        except py_compile.PyCompileError as e:
-            failures.append(f"{path}: {e.msg}")
-            continue
-        text = path.read_text()
-        check_timing = target == _PERF_COUNTER_TREE and path.name not in _PERF_COUNTER_EXEMPT_FILES
-        for lineno, line in enumerate(text.splitlines(), 1):
-            if "\t" in line:
-                failures.append(f"{path}:{lineno}: tab character")
-            if line != line.rstrip():
-                failures.append(f"{path}:{lineno}: trailing whitespace")
-            if check_timing and "perf_counter" in line and "# telemetry-ok" not in line:
-                failures.append(
-                    f"{path}:{lineno}: bare perf_counter timing in the framework — "
-                    "use telemetry.span()/registry (or mark `# telemetry-ok`)"
-                )
-            if (
-                target == _BLOCKING_TREE
-                and path.name not in _BLOCKING_EXEMPT_FILES
-                and _BLOCKING_RE.search(line)
-                and "# blocking-ok" not in line
-            ):
-                failures.append(
-                    f"{path}:{lineno}: unbounded blocking wait in the framework — "
-                    "a dead peer must raise a typed error, not hang; bound it with "
-                    "a deadline (see parallel/context.py) or mark `# blocking-ok`"
-                )
-            if (
-                target == _JSONL_TREE
-                and path.name not in _JSONL_EXEMPT_FILES
-                and _JSONL_RE.search(line)
-                and "# sink-ok" not in line
-            ):
-                failures.append(
-                    f"{path}:{lineno}: hand-rolled JSONL emission in the framework — "
-                    "records must flow through the telemetry sink or flight recorder "
-                    "(rank + trace-id tagging, per-rank files) or mark `# sink-ok`"
-                )
-            if (
-                target == _SLEEP_TREE
-                and path.name not in _SLEEP_EXEMPT_FILES
-                and _SLEEP_RE.search(line)
-                and "# sleep-ok" not in line
-            ):
-                failures.append(
-                    f"{path}:{lineno}: bare time.sleep in the framework — "
-                    "sleeping belongs to the retry-backoff/heartbeat/poll "
-                    "owners; bound it and mark `# sleep-ok: <why>`"
-                )
-            if (
-                target == _MEMSTATS_TREE
-                and path.name not in _MEMSTATS_EXEMPT_FILES
-                and _MEMSTATS_RE.search(line)
-                and "# hbm-ok" not in line
-            ):
-                failures.append(
-                    f"{path}:{lineno}: direct memory_stats() in the framework — "
-                    "HBM capacity flows through the admission budgeter "
-                    "(memory.device_capacity_bytes: honors hbm_budget_bytes + "
-                    "chaos budgets) or the telemetry watermark sampler; use "
-                    "them or mark `# hbm-ok: <why>`"
-                )
-            if (
-                target == _PAD_ROWS_TREE
-                and path.name not in _PAD_ROWS_EXEMPT_FILES
-                and _PAD_ROWS_RE.search(line)
-                and "# bucket-ok" not in line
-            ):
-                failures.append(
-                    f"{path}:{lineno}: raw pad_rows in the framework — serving "
-                    "batches pad through the bucket ladder (mesh.bucket_rows: one "
-                    "compile per bucket, not per tail shape); use it or mark "
-                    "`# bucket-ok`"
-                )
-
-import importlib
-
-sys.path.insert(0, str(ROOT))  # the script lives in ci/, imports resolve from the repo root
-for mod in ("spark_rapids_ml_tpu", "benchmark.benchmark_runner"):
-    try:
-        importlib.import_module(mod)
-    except Exception as e:  # import-time breakage must fail the gate
-        failures.append(f"import {mod}: {e!r}")
-
-if failures:
-    print("\n".join(failures))
-    print(f"lint: {len(failures)} issue(s)")
-    sys.exit(1)
-print(f"lint: OK ({len(TARGETS)} trees + imports)")
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

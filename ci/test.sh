@@ -10,11 +10,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint (compile + import checks)"
-python ci/lint.py
+# CI artifacts (analysis + regression verdicts) land side by side here
+ARTIFACTS="${CI_ARTIFACT_DIR:-/tmp/srml_ci_artifacts}"
+mkdir -p "$ARTIFACTS"
+
+echo "== static analysis (AST lint: ci/analysis — compile, invariants, registries, imports)"
+python -m ci.analysis --json-out "$ARTIFACTS/analysis_verdict.json"
 
 echo "== perf regression gate (report-only against the checked-in BENCH trajectory)"
-python -m benchmark.regression --report-only
+python -m benchmark.regression --report-only --out "$ARTIFACTS/regression_verdict.json"
 
 echo "== chaos smoke (kill one rank mid-solve; survivors must recover + post-mortem must name it)"
 python ci/chaos_smoke.py

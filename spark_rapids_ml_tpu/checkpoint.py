@@ -285,13 +285,13 @@ def run_segmented_while(
     seg_j = jax.jit(_segment)
     from .parallel import chaos
 
-    while bool(cond_j(state)):
-        it_now = int(np.asarray(it_of(state)))
+    while bool(cond_j(state)):  # host-fetch-ok: one probe per checkpoint SEGMENT (every_iters inner iterations), not per solver step
+        it_now = int(np.asarray(it_of(state)))  # host-fetch-ok: segment-boundary counter read, cadence-bounded
         seg_end = min(it_now + max(1, every), max_iter)
         state = seg_j(state, jnp.asarray(seg_end, jnp.int32))
         if store is not None:
             leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(state)]
-            it_after = int(np.asarray(it_of(state)))
+            it_after = int(np.asarray(it_of(state)))  # host-fetch-ok: the checkpoint itself — state must land on host to survive the process
             store.save(key, SolverCheckpoint(
                 solver=solver, iteration=it_after,
                 state={"leaves": leaves}, placement_key=placement_key,

@@ -454,7 +454,7 @@ def recoverable_stage(
                     "stage %s: rank failure (%s) — entering recovery epoch %d "
                     "over the survivor set", stage, e, generation,
                 )
-                new_rdv = rendezvous.reform(dead_ranks=dead, generation=generation)
+                new_rdv = rendezvous.reform(dead_ranks=dead, generation=generation)  # spmd-ok: recovery rendezvous — every survivor observes the same failure (heartbeat/abort scan) and enters reform, which carries its own deadline
                 lost = len(live) - len(getattr(new_rdv, "live_ranks", range(new_rdv.nranks)))
                 losses += max(1, lost)
                 reg.inc("recovery.rank_losses", max(1, lost))

@@ -34,7 +34,7 @@
 # budgeting: the verdict is RESIDENT, exactly the pre-PR behavior.
 #
 # This module (and telemetry.py's watermark sampler) is the one sanctioned
-# `memory_stats()` owner — ci/lint.py forbids direct calls elsewhere in the
+# `memory_stats()` owner — the ci/analysis gate forbids direct calls elsewhere in the
 # framework (`# hbm-ok` waiver).
 #
 from __future__ import annotations

@@ -395,7 +395,7 @@ def build_cagra(
             tile=tile, s_top=s_top, s_rnd=s_rnd, s_rev=s_rev, c_rnd=c_rnd,
             fast=bool(fast_score),
         )
-        if int(n_new) < min_new:
+        if int(n_new) < min_new:  # host-fetch-ok: per-ROUND termination probe (documented above: ~50ms fetch vs ~seconds per skipped descent round)
             break
     # prune to the final degree: the K_int list is distance-sorted by top_k;
     # both index halves stay ON DEVICE (the search consumes them there)
@@ -531,6 +531,6 @@ def cagra_search(
                     jnp.concatenate([best_d, td], axis=1),
                     k,
                 )
-        out_i[s : s + valid] = np.asarray(best_i)[:valid]
-        out_d[s : s + valid] = np.asarray(best_d)[:valid]
+        out_i[s : s + valid] = np.asarray(best_i)[:valid]  # host-fetch-ok: per-query-TILE result landing in the preallocated host output
+        out_d[s : s + valid] = np.asarray(best_d)[:valid]  # host-fetch-ok: per-query-TILE result landing in the preallocated host output
     return out_i, out_d

@@ -372,7 +372,7 @@ def kmeans_fit(
         centers, inertia, shift = step(centers, fast)
         n_iter += 1
         if prev_shift is not None:
-            shift_host = float(prev_shift)
+            shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (documented above) — overlapped with the current step's compute
             if not math.isfinite(shift_host):
                 _raise_diverged(n_iter - 1, last_good, f"center shift = {shift_host}")
             if telemetry.enabled():
@@ -386,14 +386,14 @@ def kmeans_fit(
             # documented checkpoint overhead; the float survives the
             # round-trip exactly, so the resumed convergence pipeline sees
             # the same value the uninterrupted run would
-            prev_shift = float(prev_shift)
+            prev_shift = float(prev_shift)  # host-fetch-ok: checkpoint-cadence boundary (config["checkpoint_every_iters"])
             ckpt_store.save(ckpt_key, _ckpt.SolverCheckpoint(
                 solver="kmeans", iteration=n_iter,
                 state={
-                    "centers": np.asarray(centers),
+                    "centers": np.asarray(centers),  # host-fetch-ok: the checkpoint itself — replicated centers must land on host to survive
                     "prev_shift": prev_shift,
                     # the divergence-fallback iterate (one step behind)
-                    "last_good": np.asarray(last_good),
+                    "last_good": np.asarray(last_good),  # host-fetch-ok: checkpoint payload (one step behind, for divergence fallback)
                 },
             ))
             # mid-solve fault injection points (`fail:stage=solve` and
@@ -537,7 +537,7 @@ def scalable_kmeans_init(x_host, k: int, seed: int, sample_weight=None, rounds: 
     cand_list = [np.ascontiguousarray(first)]
     min_d2 = _min_d2_update(xd, jax.device_put(cand_list[0]), jnp.full((n_sub,), np.inf, jnp.float32))
     for _ in range(rounds):
-        probs = np.maximum(np.asarray(min_d2), 0.0) * sw
+        probs = np.maximum(np.asarray(min_d2), 0.0) * sw  # host-fetch-ok: one fetch per k-means|| seeding ROUND (host does the ∝d² sampling); rounds is small and fixed
         s = probs.sum()
         # without-replacement sampling needs enough nonzero-probability rows
         n_new = min(l, n_sub, int(np.count_nonzero(probs)))

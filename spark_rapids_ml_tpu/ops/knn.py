@@ -98,8 +98,8 @@ def _exact_knn_1dev(items, valid, queries, k, batch_queries):
         q = queries[s0 : s0 + batch_queries]
         d2, idx = _topk_tile_1dev(items, valid, item_sq, q, kk=kk)
         fresh = start - s0
-        d_parts.append(np.asarray(d2)[fresh:])
-        i_parts.append(np.asarray(idx)[fresh:])
+        d_parts.append(np.asarray(d2)[fresh:])  # host-fetch-ok: per-TILE result fetch — every caller consumes numpy (comment below), a device round-trip here is pure waste
+        i_parts.append(np.asarray(idx)[fresh:])  # host-fetch-ok: per-TILE result fetch — see above
     # results stay HOST numpy: every caller fetches to numpy immediately, so a
     # device round-trip here would be pure waste
     d2 = np.concatenate(d_parts, axis=0)
@@ -371,7 +371,7 @@ def build_ivfpq(
             sub, sub_w, c0,
             mesh=mesh1, max_iter=pq_iters, tol=1e-6, final_inertia=False,
         )
-        codebooks[m, :k_eff] = np.asarray(st["cluster_centers_"])
+        codebooks[m, :k_eff] = np.asarray(st["cluster_centers_"])  # host-fetch-ok: one codebook fetch per PQ subspace (M is small and fixed), landing in the host codebook table
         if k_eff < K:  # degenerate tiny datasets: repeat the first centroid
             codebooks[m, k_eff:] = codebooks[m, 0]
 

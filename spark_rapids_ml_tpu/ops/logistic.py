@@ -101,7 +101,7 @@ def _glm_qn_setup(
     # host callback is free on CPU but a dispatch round-trip through a remote
     # TPU tunnel per L-BFGS iteration, so it only exists in programs traced
     # while SRML_TRACE_CONVERGENCE / enable(convergence=True) was active.
-    trace_convergence = telemetry.convergence_trace_enabled()
+    trace_convergence = telemetry.convergence_trace_enabled()  # traced-ok: the TRACE-TIME gate by design — callbacks exist only in programs traced while convergence tracing was on (docs/observability.md)
 
     def cond(state):
         _, _, _, _, _, _, _, f_prev, f_cur, it, stalled = state

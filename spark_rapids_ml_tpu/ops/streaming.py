@@ -255,7 +255,7 @@ def pca_fit_streaming(inputs: Any, *, k: int) -> Dict[str, jax.Array]:
         sx = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
             b_sw, b_sx, _ = _moments_block(blk["X"], blk["w"])
-            b_sw, b_sx = np.asarray(b_sw), np.asarray(b_sx)
+            b_sw, b_sx = np.asarray(b_sw), np.asarray(b_sx)  # host-fetch-ok: out-of-core by design — per-CHUNK moment partials accumulate on host (tiny [d]-sized payloads)
             sw = b_sw if sw is None else sw + b_sw
             sx = b_sx if sx is None else sx + b_sx
         assert sw is not None
@@ -263,7 +263,7 @@ def pca_fit_streaming(inputs: Any, *, k: int) -> Dict[str, jax.Array]:
         mean_dev = jnp.asarray(mean, dtype)
         cov_sum = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
-            part = np.asarray(_cov_block(blk["X"], blk["w"], mean_dev))
+            part = np.asarray(_cov_block(blk["X"], blk["w"], mean_dev))  # host-fetch-ok: out-of-core by design — per-CHUNK [d,d] covariance partial accumulates on host
             cov_sum = part if cov_sum is None else cov_sum + part
         cov = cov_sum / (sw - 1.0)
         return {"total_w": np.asarray(sw), "mean": np.asarray(mean), "cov": cov}
@@ -321,7 +321,7 @@ def kmeans_fit_streaming(
         sums = counts = inertia = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
             s, n_, i_ = block_assign_accumulate(blk["X"], blk["w"], c)
-            s, n_, i_ = np.asarray(s), np.asarray(n_), np.asarray(i_)
+            s, n_, i_ = np.asarray(s), np.asarray(n_), np.asarray(i_)  # host-fetch-ok: out-of-core by design — per-CHUNK [k,d] assignment partials accumulate on host
             if sums is None:
                 sums, counts, inertia = s, n_, i_
             else:
@@ -355,7 +355,7 @@ def kmeans_fit_streaming(
         centers, inertia, shift = step(centers)
         n_iter += 1
         if prev_shift is not None:
-            shift_host = float(prev_shift)
+            shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (resident-loop parity) — overlapped with the current step's compute
             if not math.isfinite(shift_host):
                 _raise_diverged(n_iter - 1, last_good, f"center shift = {shift_host}")
             if telemetry.enabled():
@@ -365,13 +365,13 @@ def kmeans_fit_streaming(
         prev_shift = shift
         last_good = step_in
         if ckpt_store is not None and ckpt_every > 0 and n_iter % ckpt_every == 0:
-            prev_shift = float(prev_shift)
+            prev_shift = float(prev_shift)  # host-fetch-ok: checkpoint-cadence boundary (config["checkpoint_every_iters"])
             ckpt_store.save(ckpt_key, _ckpt.SolverCheckpoint(
                 solver="kmeans", iteration=n_iter,
                 state={
-                    "centers": np.asarray(centers),
+                    "centers": np.asarray(centers),  # host-fetch-ok: the checkpoint itself — centers must land on host to survive
                     "prev_shift": prev_shift,
-                    "last_good": np.asarray(last_good),
+                    "last_good": np.asarray(last_good),  # host-fetch-ok: checkpoint payload (divergence-fallback iterate)
                 },
             ))
             chaos.maybe_fail_oom("solve", n_iter)
@@ -646,10 +646,10 @@ def logistic_fit_streaming(
                     blk["X"], blk["y"], blk["w"], Beff, off, total_w_f,
                     k=k, multinomial=multinomial,
                 )
-            z_blocks.append(np.asarray(z)[: row_counts[bi]])
-            loss += float(l_)
-            g_beff = g_beff + np.asarray(g)
-            sum_r = sum_r + np.asarray(sr)
+            z_blocks.append(np.asarray(z)[: row_counts[bi]])  # host-fetch-ok: out-of-core by design — per-CHUNK logits retained on host (z-block reuse saves an X pass per line search)
+            loss += float(l_)  # host-fetch-ok: per-CHUNK scalar loss partial, accumulated on host
+            g_beff = g_beff + np.asarray(g)  # host-fetch-ok: per-CHUNK [d,k] gradient partial, accumulated on host
+            sum_r = sum_r + np.asarray(sr)  # host-fetch-ok: per-CHUNK residual-sum partial, accumulated on host
         return z_blocks, loss / float(total_w), g_beff, sum_r
 
     # --- state (host numpy, the working dtype throughout) -----------------
@@ -701,7 +701,7 @@ def logistic_fit_streaming(
         rel = abs(f_prev - f_cur) / max(abs(f_cur), 1.0)
         if not rel > tol:
             break
-        d_dir = np.asarray(
+        d_dir = np.asarray(  # host-fetch-ok: ONE direction fetch per outer L-BFGS iteration — the host-stepped streaming solver's step size, not an inner-loop sync
             _two_loop(
                 jnp.asarray(g), jnp.asarray(S), jnp.asarray(Y), jnp.asarray(rho),
                 jnp.asarray(count, jnp.int32), jnp.asarray(pos, jnp.int32), m,
@@ -728,8 +728,8 @@ def logistic_fit_streaming(
                     blk["X"], blk["z"], blk["y"], blk["w"], Beff_d, off_d,
                     alphas_dev, multinomial=multinomial,
                 )
-            z_d_blocks.append(np.asarray(z_d)[: row_counts[bi]])
-            loss_cand = loss_cand + np.asarray(part)
+            z_d_blocks.append(np.asarray(z_d)[: row_counts[bi]])  # host-fetch-ok: out-of-core by design — per-CHUNK direction logits retained on host
+            loss_cand = loss_cand + np.asarray(part)  # host-fetch-ok: per-CHUNK batched-Armijo loss partials, accumulated on host
         p0, p1, p2 = penalty_terms(x, d_dir)
         a = alphas_np
         f_cand = loss_cand / float(total_w) + p0 + a * p1 + a * a * p2
@@ -762,8 +762,8 @@ def logistic_fit_streaming(
                     blk["X"], blk["z"], blk["y"], blk["w"], total_w_f,
                     k=k, multinomial=multinomial,
                 )
-            g_beff = g_beff + np.asarray(gb)
-            sum_r = sum_r + np.asarray(sr)
+            g_beff = g_beff + np.asarray(gb)  # host-fetch-ok: per-CHUNK gradient partial at the accepted point, accumulated on host
+            sum_r = sum_r + np.asarray(sr)  # host-fetch-ok: per-CHUNK residual-sum partial, accumulated on host
         gn = assemble_grad(xn, g_beff, sum_r)
         s = xn - x
         yv = gn - g

@@ -537,7 +537,7 @@ def forest_fit(
                     "transient TPU compile failure (attempt %d): %s",
                     attempt + 1, msg.splitlines()[0],
                 )
-                _time.sleep(15.0 * (attempt + 1))
+                _time.sleep(15.0 * (attempt + 1))  # sleep-ok: capped transient-compile retry backoff (≤45s over at most _retries attempts); the regex-era gate missed this aliased call
 
     rounds = []
     for t_i in range(trees_per_dev):
@@ -550,7 +550,7 @@ def forest_fit(
             )
         nst_b = dispatch(final_step, stw, nid, act, nst_b)
         f, b, s = dispatch(replicate, feat_b, bin_b, nst_b)
-        rounds.append((np.asarray(f), np.asarray(b), np.asarray(s)))
+        rounds.append((np.asarray(f), np.asarray(b), np.asarray(s)))  # host-fetch-ok: per-TREE round results land on host (trees are independent; the forest assembles in numpy)
     feats = np.concatenate([r[0] for r in rounds], axis=0)
     bins_ = np.concatenate([r[1] for r in rounds], axis=0)
     nstats = np.concatenate([r[2] for r in rounds], axis=0)

@@ -297,7 +297,7 @@ def bucket_rows(
 ) -> Tuple[np.ndarray, int]:
     """Zero-pad axis 0 of `x` up to its `bucket_size` rung; returns
     (padded, n_valid). THE one sanctioned padding entry point for
-    transform/serving code (ci/lint.py forbids raw `pad_rows` there): callers
+    transform/serving code (the ci/analysis gate forbids raw `pad_rows` there): callers
     slice every output back to `n_valid` rows."""
     b = bucket_size(x.shape[0], multiple=multiple, min_rows=min_rows, cap=cap)
     n = x.shape[0]
