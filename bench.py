@@ -43,8 +43,27 @@ import numpy as np
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_COLS = int(os.environ.get("BENCH_COLS", 3000))
-BASELINES = {"pca": 50_000.0, "kmeans": 8_333.0, "logreg": 12_500.0}
-ALGOS = ("pca", "logreg", "kmeans")
+# kmeans_scale / knn joined the headline geomean with the shared tiled
+# distance core (docs/performance.md "Tiled distance core"): the r01->r03
+# KMeans scaling cliff lived exactly in these lanes and the gate could not
+# see it while they carried no baseline. A100-class per-algo assumptions on
+# the same 1M x 3k shape (2 workers), like the original three:
+#   kmeans_scale = ONE fused assignment+accumulate pass at k=1000
+#     (~2 s/pass on A100-class: the 60 s / 30-iteration KMeans assumption)
+#     => 1M / (2 s x 2 chips) = 250k rows/sec/chip;
+#   knn = exact kNN of 4096 queries against the 1M items at k=64
+#     (NearestNeighborsMG-class ~25 s on 2 workers)
+#     => 1M / (25 s x 2 chips) = 20k rows/sec/chip (item-scan throughput).
+BASELINES = {
+    "pca": 50_000.0,
+    "kmeans": 8_333.0,
+    "logreg": 12_500.0,
+    "kmeans_scale": 250_000.0,
+    "knn": 20_000.0,
+}
+ALGOS = ("pca", "logreg", "kmeans", "kmeans_scale", "knn")
+KNN_QUERIES = int(os.environ.get("BENCH_KNN_QUERIES", 4096))
+KNN_K = int(os.environ.get("BENCH_KNN_K", 64))
 
 # Optional sparse lane (BENCH_SPARSE=1): the reference tests_large scale shape
 # (1e7 x 2200 at 0.1% density) streamed partition-parallel from
@@ -181,6 +200,59 @@ def bench_kmeans(X, w, mesh) -> float:
     fit_s = _time_fit(run, lambda s: s["cluster_centers_"], repeats=1)
     _log(f"kmeans: {fit_s:.2f}s fit (k={k}, maxIter=30)")
     return N_ROWS / fit_s
+
+
+def bench_kmeans_scale(X, w, mesh) -> float:
+    """The distance-core lane: ONE fused assignment + accumulate pass over
+    the full 1M x 3k block against k=1000 centers — the exact shape of the
+    r01->r03 scaling cliff, now measured in isolation so the regression gate
+    sees the tiled core's contribution separately from init/convergence."""
+    import jax
+
+    from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
+
+    k = 1000
+    rng = np.random.default_rng(7)
+    r0 = int(rng.integers(0, max(1, X.shape[0] - k + 1)))
+    centers0 = jax.jit(lambda X: jax.lax.dynamic_slice_in_dim(X, r0, k, 0))(X)
+    np.asarray(centers0[:1])
+
+    def run():
+        from spark_rapids_ml_tpu.parallel.mesh import effective_matmul_precision
+
+        with jax.default_matmul_precision(effective_matmul_precision("BF16_BF16_F32_X3")):
+            # max_iter=1, no final inertia pass: one assignment+accumulate
+            # sweep + the center update, nothing else
+            return kmeans_fit(
+                X, w, centers0, mesh=mesh, max_iter=1, tol=1e-20,
+                batch_rows=65536, final_inertia=False,
+            )
+
+    np.asarray(run()["cluster_centers_"])  # compile + warm
+    fit_s = _time_fit(run, lambda s: s["cluster_centers_"], repeats=2)
+    _log(f"kmeans_scale: {fit_s:.2f}s one-pass assignment (k={k})")
+    return N_ROWS / fit_s
+
+
+def bench_knn(X, w, mesh) -> float:
+    """Exact kNN lane: 4096 replicated queries against the row-sharded 1M
+    items at k=64 — the NearestNeighborsMG workload on the shared tiled
+    top-k core. Reported as item-scan throughput (items / second / chip),
+    the same normalization as the fit lanes."""
+    import jax
+
+    from spark_rapids_ml_tpu.ops.knn import exact_knn
+
+    Q = jax.jit(lambda X: jax.lax.dynamic_slice_in_dim(X, 0, KNN_QUERIES, 0))(X)
+    np.asarray(Q[:1])
+
+    def run():
+        return exact_knn(X, w > 0, Q, mesh=mesh, k=KNN_K)
+
+    np.asarray(run()[0])  # compile + warm
+    search_s = _time_fit(run, lambda out: out[0], repeats=2)
+    _log(f"knn: {search_s:.2f}s kneighbors ({KNN_QUERIES} queries, k={KNN_K})")
+    return N_ROWS / search_s
 
 
 def bench_logreg(X, w, y_idx) -> float:
@@ -331,6 +403,10 @@ def run_child() -> int:
             dense_data()["X"], dense_data()["w"], dense_data()["y_idx"]
         ),
         "kmeans": lambda: bench_kmeans(dense_data()["X"], dense_data()["w"], mesh),
+        "kmeans_scale": lambda: bench_kmeans_scale(
+            dense_data()["X"], dense_data()["w"], mesh
+        ),
+        "knn": lambda: bench_knn(dense_data()["X"], dense_data()["w"], mesh),
     }
     n_fail = 0
     for name in pending:
@@ -434,14 +510,18 @@ def emit(
     attempts: Optional[list] = None,
 ) -> None:
     """The one stdout JSON line. Degrades to value 0.0 when nothing ran.
-    Only the three headline BASELINES algos enter the geomean; extra lanes
-    (sparse_logreg) are logged to stderr. When the child reported a telemetry
-    snapshot (@TELEMETRY line), it is embedded under "telemetry" — the same
-    counters/gauges/span-aggregate dict `telemetry.snapshot()` returns
-    in-process (docs/observability.md). `attempts` is the per-attempt
-    phase/watchdog history (which phases each child reached, what killed it)
-    so a degraded emission explains ITSELF instead of requiring stderr
-    archaeology."""
+    The five headline BASELINES algos (pca/logreg/kmeans/kmeans_scale/knn)
+    enter the geomean; extra lanes (sparse_logreg, cv_sweep, oocore_stream)
+    are logged to stderr and still ride the record's "lanes" embed, which
+    carries EVERY finite per-lane value for benchmark/regression.py's
+    per-lane gates ("geomean_lanes" names the subset that formed the
+    geomean — the gate's comparability key). When the child reported a
+    telemetry snapshot (@TELEMETRY line), it is embedded under "telemetry"
+    — the same counters/gauges/span-aggregate dict `telemetry.snapshot()`
+    returns in-process (docs/observability.md). `attempts` is the
+    per-attempt phase/watchdog history (which phases each child reached,
+    what killed it) so a degraded emission explains ITSELF instead of
+    requiring stderr archaeology."""
     for name, v in results.items():
         if name not in BASELINES and v and np.isfinite(v):
             _log(f"{name}: {v:,.0f} rows/sec/chip (no baseline; excluded from geomean)")
@@ -453,7 +533,8 @@ def emit(
         geo, geo_vs = 0.0, 0.0
     missing = [a for a in ALGOS if a not in ok]
     unit = (
-        f"rows/sec/chip (geomean of PCA k=3 / KMeans k=1000 / LogReg maxIter=200 "
+        f"rows/sec/chip (geomean of PCA k=3 / KMeans k=1000 / LogReg maxIter=200 / "
+        f"KMeans-scale 1-pass k=1000 / kNN q={KNN_QUERIES} k={KNN_K} "
         f"on {N_ROWS // 1000}k x {N_COLS}, f32"
         + (f"; INCOMPLETE, missing {'+'.join(missing)}" if missing else "")
         + ")"
@@ -465,6 +546,20 @@ def emit(
         "value": round(geo, 1),
         "unit": unit,
         "vs_baseline": round(geo_vs, 3),
+        # per-lane values (baseline lanes AND extras): benchmark/regression.py
+        # gates each lane against ITS OWN trajectory — the first artifact
+        # carrying a lane starts that lane's history instead of false-failing
+        # against rounds that predate it
+        "lanes": {
+            name: round(v, 1)
+            for name, v in results.items()
+            if v and np.isfinite(v)
+        },
+        # which of those lanes entered the headline geomean: the regression
+        # gate keys geomean COMPARABILITY on this set, so toggling an
+        # optional extra lane (BENCH_SPARSE/BENCH_OOCORE) cannot silently
+        # skip the headline gate
+        "geomean_lanes": sorted(ok),
     }
     if telemetry_snap:
         record["telemetry"] = telemetry_snap

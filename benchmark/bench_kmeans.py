@@ -17,7 +17,12 @@ class BenchmarkKMeans(BenchmarkBase):
     extra_args = {
         "k": (int, 1000, "number of clusters (protocol: 1000)"),
         "maxIter": (int, 30, "Lloyd iterations (protocol: 30)"),
-        "batch_rows": (int, 16384, "rows per assignment tile (HBM knob)"),
+        "batch_rows": (
+            int, 16384,
+            "rows per assignment tile (HBM knob); the per-tile assignment + "
+            "accumulation runs on the shared tiled distance core "
+            "(ops/distance.py, docs/performance.md 'Tiled distance core')",
+        ),
     }
 
     def gen_dataset(self, args, mesh):

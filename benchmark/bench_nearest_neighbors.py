@@ -17,7 +17,11 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
     extra_args = {
         "k": (int, 64, "neighbors per query"),
         "num_queries": (int, 4096, "query rows"),
-        "batch_queries": (int, 1024, "query tile size (HBM knob)"),
+        "batch_queries": (
+            int, 0,
+            "query tile size (HBM knob); 0 = config['distance_tile_rows'], "
+            "the shared tiled distance core's row-tile (docs/performance.md)",
+        ),
     }
 
     def gen_dataset(self, args, mesh):
@@ -63,7 +67,8 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
         def run():
             return exact_knn(
                 data["X"], data["w"] > 0, data["Q"], mesh=mesh, k=args.k,
-                batch_queries=args.batch_queries,
+                # 0 -> None: exact_knn resolves config["distance_tile_rows"]
+                batch_queries=args.batch_queries or None,
             )
 
         fetch(run()[0])  # compile outside timing

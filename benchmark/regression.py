@@ -10,7 +10,14 @@
 #
 #   * WALL-TIME LANE — the headline throughput geomean of the newest complete
 #     run must stay within `--min-ratio` (default 0.8) of the trajectory
-#     reference (median of prior complete runs).
+#     reference (median of prior complete runs WITH THE SAME lane
+#     composition — a round that adds lanes to the geomean starts a new
+#     geomean trajectory instead of being gated on the mix).
+#   * PER-ALGO WALL LANES — records embedding per-lane values ("lanes",
+#     added when kmeans_scale/knn joined the geomean) are also gated lane by
+#     lane against each lane's OWN history; the first artifact carrying a
+#     lane is that lane's trajectory start (skipped, never a false fail
+#     against rounds that predate it).
 #   * COUNTER LANES — telemetry counters embedded in the BENCH snapshot
 #     (ingest/layout/placement/solve counts) are lower-is-better efficiency
 #     invariants: the newest run failing `current <= tolerance * reference`
@@ -101,6 +108,32 @@ def _counters(rec: Dict[str, Any]) -> Dict[str, float]:
     return {}
 
 
+def _lanes(rec: Dict[str, Any]) -> Dict[str, float]:
+    """Per-algo throughput values embedded in the record ("lanes", added
+    when kmeans_scale/knn entered the geomean). Empty for older artifacts —
+    which is exactly how the gate knows a lane's trajectory starts here."""
+    lanes = rec.get("lanes")
+    if isinstance(lanes, dict):
+        return {k: float(v) for k, v in lanes.items()
+                if isinstance(v, (int, float)) and float(v) > 0.0}
+    return {}
+
+
+def _geomean_lanes(rec: Dict[str, Any]) -> frozenset:
+    """The lane names whose values entered the record's headline geomean —
+    the COMPARABILITY key for the wall lane. bench.py embeds it explicitly
+    ("geomean_lanes"); records without it (incl. the pre-lanes era) fall
+    back to every embedded lane, and lane-less legacy records compare as
+    the empty set (i.e. with each other), preserving pre-lane behavior.
+    Keying on the embedded lane dict alone would let an OPTIONAL extra lane
+    (BENCH_SPARSE/BENCH_OOCORE toggled on for one round) silently skip the
+    headline gate even though the geomean composition never changed."""
+    gl = rec.get("geomean_lanes")
+    if isinstance(gl, (list, tuple)):
+        return frozenset(str(x) for x in gl)
+    return frozenset(_lanes(rec).keys())
+
+
 def discover_trajectory(root: str, pattern: str = "BENCH_r*.json") -> List[str]:
     """BENCH artifacts in round order (numeric suffix sort, not lexical —
     r2 < r10)."""
@@ -136,9 +169,21 @@ def run_gate(
         }
 
     # -- wall-time lane: throughput geomean, higher is better --------------
+    # The geomean is only comparable between runs with the SAME lane
+    # composition: when a round ADDS lanes to the headline (kmeans_scale/knn
+    # joining with the tiled distance core), its geomean is a different
+    # statistic, and gating it against the old composition's median would
+    # false-fail (or false-pass) on the mix, not on performance. Runs that
+    # predate the "lanes" embed have no composition info — treated as
+    # matching only other lane-less runs.
     cur_value = float(current["value"])
-    if complete_hist:
-        ref_value = statistics.median(float(r["value"]) for r in complete_hist)
+    cur_lanes = _lanes(current)
+    comparable = [
+        r for r in complete_hist
+        if _geomean_lanes(r) == _geomean_lanes(current)
+    ]
+    if comparable:
+        ref_value = statistics.median(float(r["value"]) for r in comparable)
         ratio = cur_value / ref_value if ref_value > 0 else float("inf")
         lanes.append({
             "lane": "throughput_geomean",
@@ -150,6 +195,16 @@ def run_gate(
             "threshold": min_ratio,
             "status": "pass" if ratio >= min_ratio else "fail",
         })
+    elif complete_hist:
+        lanes.append({
+            "lane": "throughput_geomean",
+            "kind": "wall",
+            "current": cur_value,
+            "reference": None,
+            "status": "skipped",
+            "note": "lane composition changed — this artifact starts the new "
+                    "geomean trajectory; the per-lane gates carry the signal",
+        })
     else:
         lanes.append({
             "lane": "throughput_geomean",
@@ -158,6 +213,37 @@ def run_gate(
             "reference": None,
             "status": "skipped",
             "note": "no complete historical run to compare against",
+        })
+
+    # -- per-algo wall lanes: each lane gates against ITS OWN trajectory ---
+    # A lane absent from every historical run starts its trajectory at the
+    # current artifact (status "skipped", never a false fail against rounds
+    # that predate the lane — e.g. kmeans_scale/knn joining at round N).
+    for lane_name in sorted(cur_lanes):
+        refs = [
+            _lanes(r)[lane_name] for r in complete_hist if lane_name in _lanes(r)
+        ]
+        if not refs:
+            lanes.append({
+                "lane": f"lane:{lane_name}",
+                "kind": "wall",
+                "current": cur_lanes[lane_name],
+                "reference": None,
+                "status": "skipped",
+                "note": "trajectory start: no historical run carries this lane",
+            })
+            continue
+        ref_value = statistics.median(refs)
+        ratio = cur_lanes[lane_name] / ref_value if ref_value > 0 else float("inf")
+        lanes.append({
+            "lane": f"lane:{lane_name}",
+            "kind": "wall",
+            "direction": "higher-better",
+            "current": cur_lanes[lane_name],
+            "reference": ref_value,
+            "ratio": round(ratio, 4),
+            "threshold": min_ratio,
+            "status": "pass" if ratio >= min_ratio else "fail",
         })
 
     # -- counter lanes: work-amount invariants, lower is better ------------

@@ -10,6 +10,7 @@ from typing import List
 
 from ..engine import RuleBase
 from .blocking import BlockingRule
+from .distance import RawDistanceRule
 from .hostsync import HostSyncRule
 from .hygiene import KNOWN_WAIVER_TAGS, HygieneRule
 from .jsonl import JsonlRule
@@ -36,6 +37,7 @@ def default_rules() -> List[RuleBase]:
         SpmdDivergenceRule(),
         HostSyncRule(),
         TracedImpurityRule(),
+        RawDistanceRule(),
         ConfigKeyRule(),
         MetricNameRule(),
     ]
@@ -58,6 +60,7 @@ __all__ = [
     "SpmdDivergenceRule",
     "HostSyncRule",
     "TracedImpurityRule",
+    "RawDistanceRule",
     "ConfigKeyRule",
     "MetricNameRule",
 ]

@@ -1,0 +1,195 @@
+#
+# Raw-pairwise-distance detector: the neighbor family's `x·cᵀ -> argmin /
+# top-k` inner loop lives in ONE place — ops/distance.py (the tiled core
+# with the Pallas kernel + bit-compatible fallback, docs/performance.md
+# "Tiled distance core"). Before the core existed, five estimators each
+# hand-rolled that loop, and the hand-rolled KMeans form was the r01->r03
+# 2.2x scaling cliff. This rule stops the pattern from growing back:
+#
+#   a `jnp.argmin` / `lax.top_k` / `lax.approx_min_k` whose operand was
+#   built from a LOCAL matmul (`@`, `jnp.dot`, `jnp.einsum`,
+#   `jax.lax.dot(_general)`) is a finding anywhere in the framework
+#   outside ops/distance.py.
+#
+# Taint is function-scoped and deliberately shallow: a name bound to a
+# matmul-containing expression is tainted, and taint flows through
+# arithmetic (BinOp/UnaryOp), subscripts, and the shape-preserving
+# combinators (`jnp.where` / `maximum` / `minimum` / `concatenate` / `pad`)
+# — but NOT through arbitrary calls: a result that went through the shared
+# core (`distance.pairwise_d2(...)`, `distance.topk_tile(...)`) or any
+# other function is clean, which is exactly how consumers are expected to
+# look after porting. Gathered-bucket scans and other genuinely different
+# shapes waive with `# distance-ok: <reason>`.
+#
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..engine import FileContext, RuleBase, dotted
+
+# reductions that define the banned pattern when fed a matmul-built operand
+_REDUCER_TAILS = {"argmin", "argmax", "top_k", "approx_min_k", "approx_max_k"}
+# calls that ARE matmuls (taint sources), by resolved-name tail
+_MATMUL_TAILS = {"dot", "dot_general", "matmul", "einsum", "tensordot", "inner"}
+# calls taint flows THROUGH (shape-preserving combinators); everything else
+# launders — notably the shared core's own entry points
+_PROPAGATING_TAILS = {"where", "maximum", "minimum", "concatenate", "pad",
+                      "negative", "abs", "sqrt", "square"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_jaxish(name: Optional[str]) -> bool:
+    return name is not None and name.startswith(("jax.", "numpy."))
+
+
+class RawDistanceRule(RuleBase):
+    id = "raw-distance"
+    waiver = "distance"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    exempt_files = frozenset({"distance.py"})  # the core owns the loop
+    description = "raw pairwise-distance argmin/top-k outside ops/distance.py"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        self._scope(tree.body, set(), ctx)
+
+    # ---------------------------------------------------------- traversal --
+
+    def _scope(self, body: List[ast.stmt], inherited: Set[str], ctx: FileContext) -> None:
+        """One lexical scope, statements in source order. Nested function
+        scopes inherit a COPY of the taint visible at their definition point
+        (closures read outer locals — how `def one_tile(q)` bodies inside a
+        tiled pass are still seen)."""
+        tainted: Set[str] = set(inherited)
+        for stmt in body:
+            self._stmt(stmt, tainted, ctx)
+
+    def _stmt(self, stmt: ast.stmt, tainted: Set[str], ctx: FileContext) -> None:
+        if isinstance(stmt, _FUNC_NODES) or isinstance(stmt, ast.ClassDef):
+            self._scope(stmt.body, tainted, ctx)
+            return
+        if isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While,
+                             ast.With, ast.AsyncWith, ast.Try)):
+            # compound statement: check header expressions against the
+            # CURRENT taint, then recurse into each sub-statement in source
+            # order so bindings inside the block are visible to later
+            # statements of the same block
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    for node in ast.walk(child):
+                        if isinstance(node, ast.Call):
+                            self._check_call(node, tainted, ctx)
+                elif isinstance(child, ast.withitem):
+                    for node in ast.walk(child.context_expr):
+                        if isinstance(node, ast.Call):
+                            self._check_call(node, tainted, ctx)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)) and self._tainted(
+                stmt.iter, tainted
+            ):
+                tainted.update(
+                    n.id for n in ast.walk(stmt.target) if isinstance(n, ast.Name)
+                )
+            for field in ("body", "orelse", "finalbody"):
+                for sub in getattr(stmt, field, []) or []:
+                    self._stmt(sub, tainted, ctx)
+            for handler in getattr(stmt, "handlers", []) or []:
+                for sub in handler.body:
+                    self._stmt(sub, tainted, ctx)
+            return
+        # nested defs anywhere inside this statement get their own scope
+        # pass; their nodes are excluded from this statement's flat walk
+        nested = [n for n in ast.walk(stmt) if isinstance(n, _FUNC_NODES)]
+        skip: Set[int] = set()
+        for fn in nested:
+            for sub in ast.walk(fn):
+                if sub is not fn:
+                    skip.add(id(sub))
+        # findings first (an assignment's RHS may itself hold the reduction)
+        for node in ast.walk(stmt):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Call):
+                self._check_call(node, tainted, ctx)
+        # then taint updates from this statement's bindings
+        for node in ast.walk(stmt):
+            if id(node) in skip:
+                continue
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            tnt = self._tainted(value, tainted)
+            for t in targets:
+                names = [n.id for n in ast.walk(t) if isinstance(n, ast.Name)]
+                if tnt:
+                    tainted.update(names)
+                elif isinstance(node, ast.Assign) and isinstance(t, ast.Name):
+                    tainted.discard(t.id)  # clean rebinding
+        for fn in nested:
+            self._scope(fn.body, tainted, ctx)
+
+    def _check_call(self, node: ast.Call, tainted: Set[str], ctx: FileContext) -> None:
+        operand: Optional[ast.expr] = None
+        label: Optional[str] = None
+        name = dotted(node.func, ctx.imports)
+        if (
+            name is not None
+            and name.split(".")[-1] in _REDUCER_TAILS
+            and _is_jaxish(name)
+            and node.args
+        ):
+            operand, label = node.args[0], name.split(".")[-1]
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "argmin",
+            "argmax",
+        ):
+            # method form: d2.argmin(axis=1)
+            operand, label = node.func.value, node.func.attr
+        if operand is None:
+            return
+        # lambdas in the operand (rare) are treated as opaque
+        if self._tainted(operand, tainted):
+            ctx.emit(
+                self,
+                node,
+                f"`{label}` over a locally-built `x @ c.T`-shaped operand — "
+                "the neighbor family's distance/argmin/top-k loop is owned by "
+                "ops/distance.py (tile_topk / argmin_assign / "
+                "assign_accumulate / pairwise_d2): hand-rolled copies are the "
+                "r01->r03 KMeans scaling-cliff pattern. Call the shared core, "
+                "or mark `# distance-ok: <reason>`",
+            )
+
+    # --------------------------------------------------------------- taint --
+
+    def _tainted(self, node: ast.expr, tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.MatMult):
+                return True
+            return self._tainted(node.left, tainted) or self._tainted(node.right, tainted)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand, tainted)
+        if isinstance(node, ast.Subscript):
+            return self._tainted(node.value, tainted)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._tainted(e, tainted) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self._tainted(node.body, tainted) or self._tainted(node.orelse, tainted)
+        if isinstance(node, ast.Call):
+            name = dotted(node.func, None)
+            tail = name.split(".")[-1] if name else None
+            if tail in _MATMUL_TAILS:
+                return True
+            if tail in _PROPAGATING_TAILS:
+                return any(self._tainted(a, tainted) for a in node.args)
+            return False  # any other call launders (incl. the shared core)
+        return False

@@ -25,6 +25,7 @@ KNOWN_WAIVER_TAGS = {
     "traced",
     "config",
     "metric",
+    "distance",
 }
 
 

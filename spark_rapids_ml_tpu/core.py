@@ -51,6 +51,13 @@ config: Dict[str, Any] = {
     # with the dataset (the streaming analog of the reference's Arrow
     # maxRecordsPerBatch-bounded batch loop, reference core.py:698-760)
     "ingest_chunk_bytes": 128 << 20,
+    # rows per tile of the shared distance/top-k core (ops/distance.py,
+    # docs/performance.md "Tiled distance core"): the outer row-tile every
+    # neighbor-family scan shares — kNN query tiles, kmeans_predict
+    # assignment tiles, the kernel block planner's input. Bounds the live
+    # [tile, k] reduction footprint on the fallback path and the per-tile
+    # VMEM working set on the Pallas path.
+    "distance_tile_rows": 4096,
     # --- fault-tolerant control plane (docs/robustness.md) ---------------
     # per-round rendezvous deadline: a round with ranks still missing raises
     # RendezvousTimeoutError (transient, retryable) when this elapses —

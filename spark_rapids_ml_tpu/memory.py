@@ -22,7 +22,9 @@
 # tests/test_memory.py against analytic byte counts): per-device placement
 # bytes for the dense and CSR->ELL (incl. padding) layouts, plus per-solver
 # workspace from the estimator hook `_solver_workspace_terms` (GLM logits +
-# L-BFGS history, k-means tile buffers, PCA/linear X'X). A fraction of the
+# L-BFGS history, k-means tile buffers AND its predict-side assignment tile
+# — `config["distance_tile_rows"]` rows through the shared distance core,
+# so an admitted fit cannot OOM at transform — PCA/linear X'X). A fraction of the
 # capacity (`config["hbm_headroom_fraction"]`) is reserved as headroom for the
 # transform bucket ladder, compiled-program scratch, and allocator
 # fragmentation — the budget is capacity * (1 - headroom).

@@ -108,12 +108,20 @@ class KMeans(_KMeansParams, _TpuEstimator):
     ) -> Dict[str, int]:
         # per-device tile buffers of the assignment scan: the [b, k] distance
         # + one-hot blocks for batch_rows-row tiles, plus the (k, d) centers
-        # and sums (replicated)
+        # and sums (replicated), plus the PREDICT-side assignment tile — the
+        # transform path row-tiles through the shared distance core at
+        # config["distance_tile_rows"] rows (ops/distance.argmin_assign), so
+        # an admission-approved fit cannot OOM at predict; its [tile, k]
+        # block is budgeted here like the fit-side tiles
+        from ..ops.distance import tile_rows
+
         k = int(params.get("n_clusters", 8))
         b = min(int(params.get("max_samples_per_batch", 32768)), max(1, rows_per_device))
+        predict_rows = min(tile_rows(), max(1, rows_per_device))
         return {
             "tile_buffers": 2 * b * k * itemsize,
             "centers": 2 * k * n_cols * itemsize,
+            "predict_tile": predict_rows * k * itemsize,
         }
 
     def __init__(self, **kwargs: Any) -> None:

@@ -39,7 +39,10 @@ class _KNNParams(HasInputCol, HasInputCols, HasFeaturesCol, HasFeaturesCols, Has
         return {"k": "n_neighbors"}
 
     def _get_solver_params_default(self) -> Dict[str, Any]:
-        return {"n_neighbors": 5, "batch_queries": 4096, "verbose": False}
+        # batch_queries 0 = config["distance_tile_rows"] (the shared tiled
+        # distance core's row-tile, docs/performance.md "Tiled distance
+        # core"); a nonzero value overrides per estimator
+        return {"n_neighbors": 5, "batch_queries": 0, "verbose": False}
 
 
 class NearestNeighbors(_KNNParams, _TpuEstimator):
@@ -269,7 +272,8 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
 
                 d_dev, gidx_dev = exact_knn(
                     X, w > 0, Q, mesh=mesh, k=k,
-                    batch_queries=int(self._solver_params["batch_queries"]),
+                    # 0 -> None: resolves config["distance_tile_rows"]
+                    batch_queries=int(self._solver_params["batch_queries"]) or None,
                 )
                 dist = np.asarray(d_dev, dtype=np.float64)[q_offset : q_offset + nq_local]
                 gidx = np.asarray(gidx_dev)[q_offset : q_offset + nq_local]

@@ -46,11 +46,13 @@ import numpy as np
 
 __all__ = ["build_cagra", "cagra_search"]
 
+from .distance import argmin_assign, batched_self_topk, row_sq as _row_sq
+from .distance import score_candidates as _score_candidates
+
 _SENTINEL_F = jnp.float32(jnp.inf)
 
-
-def _row_sq(x: jax.Array) -> jax.Array:
-    return jnp.sum(x * x, axis=1)
+# row-tiled nearest-anchor assignment (shared core), compiled once per shape
+_assign_rows = jax.jit(argmin_assign)
 
 
 def _merge_dedup_topk(all_ids, all_d2, keep: int, extra=None):
@@ -83,28 +85,10 @@ def _merge_dedup_topk(all_ids, all_d2, keep: int, extra=None):
     return out_ids, out_d2, ex
 
 
-def _score_candidates(q_rows, cand, x, x_sq, fast: bool = False):
-    """d2[t, c] = ||q_rows[t] - x[cand[t, c]]||² (squared L2, >= 0); the
-    [T, C, d] gather feeds one batched einsum (the MXU side of the round).
-
-    fast=True runs the einsum with bf16 inputs and f32 accumulation (the
-    KMeans fast-path policy): the BUILD only uses these distances to RANK
-    candidate edges, so the ~1e-3 relative rounding is absorbed by the
-    descent's redundancy (recall asserted in tests/test_knn.py), while the
-    one-pass MXU einsum runs ~2.6x the f32-highest rate on a v5e. The SEARCH
-    keeps exact f32 scoring (its distances are returned to the user)."""
-    xc = x[cand]  # [T, C, d]
-    if fast:
-        dots = jnp.einsum(
-            "td,tcd->tc",
-            q_rows.astype(jnp.bfloat16),
-            xc.astype(jnp.bfloat16),
-            preferred_element_type=jnp.float32,
-        )
-    else:
-        dots = jnp.einsum("td,tcd->tc", q_rows, xc)
-    d2 = _row_sq(q_rows)[:, None] + x_sq[cand] - 2.0 * dots
-    return jnp.maximum(d2, 0.0)
+# candidate scoring is the shared core's gather-scoring primitive
+# (distance.score_candidates — imported above): d2[t, c] =
+# ||q_rows[t] - x[cand[t, c]]||², fast=True runs the einsum one-pass bf16
+# (ranking-only distances; recall asserted in tests/test_knn.py)
 
 
 @partial(jax.jit, static_argnames=("r_max",), donate_argnums=())
@@ -216,21 +200,10 @@ def _descent_round(
 
 @partial(jax.jit, static_argnames=("kk",))
 def _bucket_knn(xb, ids_b, *, kk: int):
-    """Exact kNN inside padded buckets: xb [Cb, L, d], ids_b [Cb, L] global
-    ids (−1 pad). One batched [Cb, L, L] distance matmul on the MXU + top-k.
-    Returns (d2 [Cb, L, kk], neighbor ids [Cb, L, kk])."""
-    sq = jnp.sum(xb * xb, axis=2)  # [Cb, L]
-    G = jnp.einsum("cld,cmd->clm", xb, xb)
-    d2 = sq[:, :, None] + sq[:, None, :] - 2.0 * G
-    valid = ids_b >= 0
-    mask = valid[:, None, :] & valid[:, :, None]
-    eye = jnp.eye(xb.shape[1], dtype=bool)[None]
-    d2 = jnp.where(mask & ~eye, jnp.maximum(d2, 0.0), _SENTINEL_F)
-    nd2, pos = jax.lax.top_k(-d2, kk)
-    nid = jnp.take_along_axis(
-        jnp.broadcast_to(ids_b[:, None, :], d2.shape), pos, axis=2
-    )
-    return -nd2, nid
+    """Exact kNN inside padded buckets — the shared core's batched
+    self-top-k (distance.batched_self_topk): one [Cb, L, L] distance matmul
+    on the MXU + top-k. Returns (d2 [Cb, L, kk], neighbor ids [Cb, L, kk])."""
+    return batched_self_topk(xb, ids_b, kk=kk)
 
 
 def _cluster_seed_rep(xd, x_sq, n: int, anchors_c: int, kk: int, seed: int):
@@ -243,13 +216,7 @@ def _cluster_seed_rep(xd, x_sq, n: int, anchors_c: int, kk: int, seed: int):
     d = xd.shape[1]
     rng = np.random.default_rng(seed)
     anchors = xd[jnp.asarray(rng.choice(n, min(anchors_c, n), replace=False))]
-    assign = np.asarray(
-        jax.jit(
-            lambda X, A: jnp.argmin(
-                jnp.sum(A * A, 1)[None, :] - 2.0 * X @ A.T, axis=1
-            ).astype(jnp.int32)
-        )(xd, anchors)
-    )
+    assign = np.asarray(_assign_rows(xd, anchors))
     C = anchors.shape[0]
     counts = np.bincount(assign, minlength=C)
     # cap pathological buckets: overflow rows just miss THIS rep's edges

@@ -36,6 +36,7 @@ from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import ROWS_AXIS
+from .distance import pairwise_d2 as _pairwise_d2
 
 
 def _tile_rows_for_budget(n: int, max_mbytes: Optional[int], default: int = 8192) -> int:
@@ -54,19 +55,10 @@ def _replicate_out(mesh, x):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
 
 
-def _pairwise_d2(q: jax.Array, x: jax.Array, metric: str) -> jax.Array:
-    """Distance tile [tq, n]: squared euclidean, or cosine distance.
-
-    Inputs are pre-normalized for cosine by `dbscan_fit`, so cosine distance
-    is 1 - q·xᵀ — both metrics ride the MXU. For "precomputed" the rows ARE
-    distances already (dbscan_fit hands each pass the matching column slice
-    of the user's distance matrix, padding columns with +huge), so the tile
-    is just `q` — no compute."""
-    if metric == "precomputed":
-        return q
-    if metric == "cosine":
-        return 1.0 - q @ x.T
-    return jnp.sum(q * q, axis=1)[:, None] - 2.0 * (q @ x.T) + jnp.sum(x * x, axis=1)[None, :]
+# the distance tile is the SHARED core's (distance.pairwise_d2, imported
+# above): squared euclidean / cosine / precomputed pass-through — dbscan_fit
+# pre-normalizes cosine rows and hands "precomputed" passes the matching
+# column slice of the user's distance matrix, so the tile is just `q` there
 
 
 def _map_row_tiles(fn, rows, tile_rows: int, extra=None):

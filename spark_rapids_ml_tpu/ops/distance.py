@@ -1,0 +1,750 @@
+#
+# The ONE tiled distance / argmin / top-k core shared by the whole neighbor
+# family (docs/performance.md "Tiled distance core").
+#
+# Every neighbor-shaped estimator reduces to the same inner loop: a
+# `[rows_tile, d] x [k_side, d]` distance contraction followed by a running
+# reduction (argmin for KMeans assignment, top-k for kNN/UMAP/CAGRA, an
+# eps-threshold count for DBSCAN). Before this module each of
+# kmeans/knn/dbscan/umap/cagra hand-rolled that loop — and the hand-rolled
+# KMeans form fell ~2.2x going from 400k to 1M rows (BENCH_r01 ~226k -> r03
+# ~100k rows/sec/chip at k=1000): at k=1000 the un-k-tiled `[batch, k]`
+# distance block plus its one-hot twin stop fitting close to the compute and
+# the MXU starves. This module is the single owner of that loop:
+#
+#   * a Pallas-TPU kernel path: the distance block is computed in
+#     `[block_rows, d] x [block_k, d]` VMEM tiles (the grid pipeline
+#     double-buffers the HBM->VMEM tile fetches), with the argmin merged
+#     IN-KERNEL across k tiles — a `[rows_tile, k]` matrix never exists in
+#     HBM, which is exactly the r01->r03 cliff;
+#   * a bit-compatible pure-jnp fallback: the same formulas as one XLA
+#     program (what CPU CI and older jaxlibs run); parity between the two is
+#     pinned by tests/test_distance.py (rtol 1e-9 f64, exact assignments f32)
+#     across tile boundaries, ragged tails, weights, and the `fast`
+#     precision mode;
+#   * the backend probe (`kernel_mode`) that picks between them once per
+#     process: Pallas only on a TPU backend whose jaxlib passes a tiny
+#     end-to-end kernel self-test; `SRML_DISTANCE_KERNEL` overrides
+#     (`pallas` | `jnp` | `interpret` — the interpret form runs the REAL
+#     kernels through the Pallas interpreter, which is how CPU CI exercises
+#     kernel code paths at all).
+#
+# The ci/analysis `raw-distance` rule forbids re-growing private copies:
+# `jnp.argmin` / `lax.top_k` over a locally-built `x @ c.T`-shaped operand
+# anywhere in the framework outside this file is a finding
+# (`# distance-ok: <reason>` waives a deliberate exception).
+#
+# `distance.*` counters (docs/observability.md) count PROGRAM TRACES, not
+# executions — they increment at trace time by design, so "a KMeans fit
+# compiles ONE distance program across its iterations" is a testable
+# invariant instead of folklore.
+#
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+
+# Outer row-tile default when config["distance_tile_rows"] is missing or
+# invalid (the config default matches this).
+_DEFAULT_TILE_ROWS = 4096
+
+# VMEM budget the kernel block planner fits (x block + k-side block + the
+# [block_rows, block_k] distance block, each double-buffered by the grid
+# pipeline). Half of a v5e core's ~16 MB, leaving the other half for the
+# pipeline's second buffers and compiler scratch.
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+_MODE: Optional[str] = None  # kernel_mode() cache: "pallas" | "interpret" | "jnp"
+
+
+# ----------------------------------------------------------- tile planning --
+
+
+def tile_rows() -> int:
+    """Outer row-tile size shared by every consumer's query/row scan —
+    `config["distance_tile_rows"]` (docs/configuration.md)."""
+    from ..core import config
+
+    try:
+        v = int(config.get("distance_tile_rows", _DEFAULT_TILE_ROWS))
+    except (TypeError, ValueError):
+        return _DEFAULT_TILE_ROWS
+    return v if v > 0 else _DEFAULT_TILE_ROWS
+
+
+def plan_blocks(
+    n_rows: int, k_side: int, d: int, itemsize: int = 4
+) -> Optional[Tuple[int, int]]:
+    """Kernel-internal (block_rows, block_k) so one x block [br, d], one
+    k-side block [bk, d] and the [br, bk] distance block fit the VMEM
+    budget. Returns None when even the floor blocks don't fit (enormous d)
+    — callers fall back to the jnp path then."""
+    budget = _VMEM_BUDGET_BYTES // max(1, itemsize)
+    br, bk = 512, 512
+    while br * d + bk * d + br * bk > budget and (br > 8 or bk > 128):
+        if bk > 128:
+            bk //= 2
+        elif br > 8:
+            br //= 2
+    if br * d + bk * d + br * bk > budget:
+        return None
+    return min(br, max(1, n_rows)), min(bk, max(1, k_side))
+
+
+# ---------------------------------------------------------- backend probe ---
+
+
+def kernel_mode() -> str:
+    """Which inner-loop implementation this process runs: "pallas" (TPU
+    backend, kernels verified by a tiny self-test), "interpret" (the real
+    kernels through the Pallas interpreter — CI parity testing), or "jnp"
+    (the bit-compatible fallback; CPU and older jaxlibs). Resolved once;
+    `SRML_DISTANCE_KERNEL` overrides."""
+    global _MODE
+    if _MODE is None:
+        _MODE = _probe()
+        if telemetry.enabled():  # traced-ok: one-shot probe-result gauge — resolves once per process, trace-time reads return the cached string
+            telemetry.registry().gauge(  # traced-ok: same one-shot probe gauge (see line above)
+                "distance.kernel_pallas", 1.0 if _MODE != "jnp" else 0.0
+            )
+    return _MODE
+
+
+def _probe() -> str:
+    env = os.environ.get("SRML_DISTANCE_KERNEL", "").strip().lower()
+    if env in ("jnp", "fallback", "off"):
+        return "jnp"
+    if env == "interpret":
+        return "interpret"
+    if env == "pallas":
+        # explicit override really FORCES the kernel path: no self-test
+        # fallback — an operator debugging a kernel failure needs it to
+        # surface at the kernel call, not be silently probed away
+        return "pallas"
+    if jax.default_backend() != "tpu":
+        return "jnp"
+    try:
+        import numpy as np
+
+        x = jnp.asarray(np.arange(64, dtype=np.float32).reshape(8, 8) / 64.0)
+        c = jnp.asarray(np.arange(32, dtype=np.float32).reshape(4, 8) / 32.0)
+        mind, best = _pl_argmin(x, c, _c_sq(c), block_rows=8, block_k=4,
+                                fast=False, interpret=False)
+        ref_d2 = _c_sq(c)[None, :] - 2.0 * (x @ c.T)
+        ok = np.allclose(np.asarray(mind), np.asarray(jnp.min(ref_d2, 1)), rtol=1e-5)
+        ok &= bool(np.all(np.asarray(best) == np.asarray(jnp.argmin(ref_d2, 1))))
+        return "pallas" if ok else "jnp"
+    except Exception:
+        # older jaxlib / no Mosaic lowering: the fallback is the contract
+        return "jnp"
+
+
+def _use_kernel() -> bool:
+    return kernel_mode() != "jnp"
+
+
+def _interpret() -> bool:
+    return kernel_mode() == "interpret"
+
+
+# --------------------------------------------------------------- helpers ----
+
+
+def row_sq(x: jax.Array) -> jax.Array:
+    return jnp.sum(x * x, axis=1)
+
+
+def _c_sq(c: jax.Array) -> jax.Array:
+    return jnp.sum(c * c, axis=1)
+
+
+def _mm(a: jax.Array, b: jax.Array, fast: bool) -> jax.Array:
+    """Matmul at the neighbor-family loop precision. `fast` = one-pass bf16
+    on the MXU with f32 accumulation (explicit casts, so CPU tests see the
+    same rounding). Measured at the protocol shape (1M x 3k, k=1000, v5e):
+    in-loop bf16 drops 331 -> 208 ms/iter while the TRUE inertia (recomputed
+    at 3-pass-bf16 "f32" precision with the final centers) agrees to 7e-6
+    relative — assignment flips only for near-tied rows, which contribute
+    equally either way."""
+    if fast:
+        return jax.lax.dot(
+            a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        ).astype(a.dtype)
+    return a @ b
+
+
+def _note(name: str) -> None:
+    """Trace-time program counter (see module docstring): one tick per
+    compiled distance program, NOT per execution."""
+    if telemetry.enabled():  # traced-ok: distance.* counters count program TRACES by design — one tick per compile is the invariant tests/test_distance.py pins
+        telemetry.registry().inc(name)  # traced-ok: see line above (deliberate trace-time tick, docs/observability.md "Tiled distance core")
+
+
+# ---------------------------------------------------------- Pallas kernels --
+#
+# Kernels never tile the feature axis: blocks are [block_rows, d] and
+# [block_k, d] with full-depth dots, so each distance entry is ONE dot
+# reduction — bitwise identical to the fallback's single big matmul slice-
+# for-slice (the parity suite leans on this). The block planner refuses
+# (-> jnp fallback) when full-depth blocks cannot fit VMEM.
+
+
+def _pl_argmin(
+    x: jax.Array,  # [B, d] row tile
+    c_pad: jax.Array,  # [kp, d] centers, padded to a block_k multiple
+    c_sq_pad: jax.Array,  # [kp] (+inf on padding rows)
+    *,
+    block_rows: int,
+    block_k: int,
+    fast: bool,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused distance + running argmin: returns (min d2 [B] WITHOUT the
+    ||x||^2 term, argmin index [B] int32). Grid = (row blocks, k blocks)
+    with the k axis innermost: each step computes one [br, bk] distance
+    block in VMEM and merges it into the carried per-row minimum — the full
+    [B, k] matrix never exists."""
+    from jax.experimental import pallas as pl
+
+    B, d = x.shape
+    kp = c_pad.shape[0]
+    n_rb = B // block_rows
+    n_kb = kp // block_k
+    dtype = x.dtype
+
+    def kernel(x_ref, c_ref, csq_ref, mind_ref, best_ref):
+        kb = pl.program_id(1)
+        xb = x_ref[...]
+        cb = c_ref[...]
+        if fast:
+            xc = jnp.dot(
+                xb.astype(jnp.bfloat16), cb.astype(jnp.bfloat16).T,
+                preferred_element_type=jnp.float32,
+            ).astype(dtype)
+        else:
+            xc = jnp.dot(xb, cb.T)
+        d2 = csq_ref[...] - 2.0 * xc  # [br, bk]
+        blk_min = jnp.min(d2, axis=1, keepdims=True)
+        blk_arg = (
+            jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None] + kb * block_k
+        )
+
+        @pl.when(kb == 0)
+        def _init():
+            mind_ref[...] = blk_min
+            best_ref[...] = blk_arg
+
+        @pl.when(kb > 0)
+        def _merge():
+            cur = mind_ref[...]
+            take = blk_min < cur  # strict: first-k-block wins ties, like argmin
+            mind_ref[...] = jnp.where(take, blk_min, cur)
+            best_ref[...] = jnp.where(take, blk_arg, best_ref[...])
+
+    mind, best = pl.pallas_call(
+        kernel,
+        grid=(n_rb, n_kb),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda r, k: (r, 0)),
+            pl.BlockSpec((block_k, d), lambda r, k: (k, 0)),
+            pl.BlockSpec((1, block_k), lambda r, k: (0, k)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, 1), lambda r, k: (r, 0)),
+            pl.BlockSpec((block_rows, 1), lambda r, k: (r, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, 1), dtype),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(x, c_pad, c_sq_pad[None, :])
+    return mind[:, 0], best[:, 0]
+
+
+def _pl_accumulate(
+    x: jax.Array,  # [B, d]
+    w: jax.Array,  # [B]
+    assign: jax.Array,  # [B] int32
+    kp: int,  # padded center count (block_k multiple)
+    *,
+    block_rows: int,
+    block_k: int,
+    fast: bool,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Weighted one-hot accumulation: (sums [kp, d], counts [kp]). Grid =
+    (k blocks, row blocks) with rows innermost: each step builds one
+    [br, bk] one-hot block and accumulates its [bk, d] contribution — the
+    full [B, k] one-hot matrix never exists."""
+    from jax.experimental import pallas as pl
+
+    B, d = x.shape
+    n_rb = B // block_rows
+    n_kb = kp // block_k
+    dtype = x.dtype
+
+    def kernel(x_ref, w_ref, a_ref, sums_ref, counts_ref):
+        kb = pl.program_id(0)
+        rb = pl.program_id(1)
+        xb = x_ref[...]
+        wb = w_ref[...]  # [br, 1]
+        ab = a_ref[...]  # [br, 1]
+        ids = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        oh = jnp.where(ab == ids, wb, jnp.zeros((), dtype))  # [br, bk]
+        if fast:
+            contrib = jnp.dot(
+                oh.astype(jnp.bfloat16).T, xb.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            ).astype(dtype)
+        else:
+            contrib = jnp.dot(oh.T, xb)
+
+        @pl.when(rb == 0)
+        def _init():
+            sums_ref[...] = contrib
+            counts_ref[...] = jnp.sum(oh, axis=0)[:, None]
+
+        @pl.when(rb > 0)
+        def _acc():
+            sums_ref[...] += contrib
+            counts_ref[...] += jnp.sum(oh, axis=0)[:, None]
+
+    sums, counts = pl.pallas_call(
+        kernel,
+        grid=(n_kb, n_rb),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda k, r: (r, 0)),
+            pl.BlockSpec((block_rows, 1), lambda k, r: (r, 0)),
+            pl.BlockSpec((block_rows, 1), lambda k, r: (r, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_k, d), lambda k, r: (k, 0)),
+            pl.BlockSpec((block_k, 1), lambda k, r: (k, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, d), dtype),
+            jax.ShapeDtypeStruct((kp, 1), dtype),
+        ],
+        interpret=interpret,
+    )(x, w[:, None], assign[:, None].astype(jnp.int32))
+    return sums, counts[:, 0]
+
+
+def _pl_d2_block(
+    q: jax.Array,  # [B, d] query/row tile
+    xt: jax.Array,  # [bk_total, d] item tile (fully VMEM-resident per block)
+    xt_sq: jax.Array,  # [bk_total]
+    *,
+    block_rows: int,
+    fast: bool,
+    interpret: bool,
+) -> jax.Array:
+    """One [B, k_tile] distance block (WITHOUT the ||q||^2 term): the inner
+    matmul of the top-k merge loop. Grid over row blocks only — the item
+    tile is sized by the caller to fit VMEM whole."""
+    from jax.experimental import pallas as pl
+
+    B, d = q.shape
+    kt = xt.shape[0]
+    n_rb = B // block_rows
+    dtype = q.dtype
+
+    def kernel(q_ref, x_ref, xsq_ref, out_ref):
+        qb = q_ref[...]
+        xb = x_ref[...]
+        if fast:
+            dots = jnp.dot(
+                qb.astype(jnp.bfloat16), xb.astype(jnp.bfloat16).T,
+                preferred_element_type=jnp.float32,
+            ).astype(dtype)
+        else:
+            dots = jnp.dot(qb, xb.T)
+        out_ref[...] = xsq_ref[...] - 2.0 * dots
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_rb,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda r: (r, 0)),
+            pl.BlockSpec((kt, d), lambda r: (0, 0)),
+            pl.BlockSpec((1, kt), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, kt), lambda r: (r, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, kt), dtype),
+        interpret=interpret,
+    )(q, xt, xt_sq[None, :])
+
+
+def _pad_rows_multiple(a: jax.Array, mult: int) -> Tuple[jax.Array, int]:
+    n = a.shape[0]
+    pad = (-n) % mult
+    if pad:
+        a = jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+    return a, n
+
+
+# ----------------------------------------------------- fused assign (KMeans) --
+
+
+def assign_argmin(
+    xb: jax.Array,  # [B, d] one row tile
+    centers: jax.Array,  # [k, d]
+    *,
+    fast: bool = False,
+    block_rows: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Nearest-center reduction for one row tile: (min d2 [B] WITHOUT the
+    ||x||^2 term, assignment [B] int32). The k-tiled kernel and the one-shot
+    fallback share the exact `c_sq - 2 x.c^T` formula; first-index argmin
+    ties are preserved across k blocks by the kernel's strict-< merge."""
+    k, d = centers.shape
+    c_sq = _c_sq(centers)
+    plan = (
+        plan_blocks(xb.shape[0], k, d, xb.dtype.itemsize)
+        if _use_kernel()
+        else None
+    )
+    if plan is None:
+        d2 = c_sq[None, :] - 2.0 * _mm(xb, centers.T, fast)
+        return jnp.min(d2, axis=1), jnp.argmin(d2, axis=1).astype(jnp.int32)
+    br, bk = block_rows or plan[0], block_k or plan[1]
+    xp, n = _pad_rows_multiple(xb, br)
+    cp, _ = _pad_rows_multiple(centers, bk)
+    csq_p = jnp.pad(c_sq, (0, cp.shape[0] - k), constant_values=jnp.inf)
+    mind, best = _pl_argmin(
+        xp, cp, csq_p, block_rows=br, block_k=min(bk, cp.shape[0]),
+        fast=fast, interpret=_interpret(),
+    )
+    return mind[:n], best[:n]
+
+
+def assign_accumulate(
+    xb: jax.Array,  # [B, d] one row tile
+    wb: jax.Array,  # [B] weights (0 on padding rows — they contribute nothing)
+    centers: jax.Array,  # [k, d]
+    *,
+    fast: bool = False,
+    block_rows: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One row tile's fused Lloyd contribution: (sums [k, d], counts [k],
+    inertia scalar). THE kmeans inner loop: assignment (k-tiled argmin) plus
+    the weighted one-hot accumulation, never materializing [B, k] on the
+    kernel path."""
+    k, d = centers.shape
+    plan = (
+        plan_blocks(xb.shape[0], k, d, xb.dtype.itemsize)
+        if _use_kernel()
+        else None
+    )
+    if plan is None:
+        c_sq = _c_sq(centers)
+        d2 = c_sq[None, :] - 2.0 * _mm(xb, centers.T, fast)
+        assign = jnp.argmin(d2, axis=1)
+        min_d2 = jnp.min(d2, axis=1) + row_sq(xb)
+        oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
+        return (
+            _mm(oh.T, xb, fast),
+            jnp.sum(oh, axis=0),
+            jnp.sum(jnp.maximum(min_d2, 0.0) * wb),
+        )
+    br, bk = block_rows or plan[0], block_k or plan[1]
+    xp, n = _pad_rows_multiple(xb, br)
+    wp, _ = _pad_rows_multiple(wb, br)
+    cp, _ = _pad_rows_multiple(centers, bk)
+    bk = min(bk, cp.shape[0])
+    csq_p = jnp.pad(_c_sq(centers), (0, cp.shape[0] - k), constant_values=jnp.inf)
+    mind, best = _pl_argmin(
+        xp, cp, csq_p, block_rows=br, block_k=bk, fast=fast,
+        interpret=_interpret(),
+    )
+    sums_p, counts_p = _pl_accumulate(
+        xp, wp, best, cp.shape[0], block_rows=br, block_k=bk, fast=fast,
+        interpret=_interpret(),
+    )
+    min_d2 = mind[:n] + row_sq(xb)
+    inertia = jnp.sum(jnp.maximum(min_d2, 0.0) * wb)
+    return sums_p[:k], counts_p[:k], inertia
+
+
+def tile_assign_accumulate(
+    Xl: jax.Array, wl: jax.Array, centers: jax.Array, batch_rows: int,
+    fast: bool = False, spmd: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Scan one device's rows in tiles; returns (sums [k,d], counts [k],
+    inertia) — the whole-shard Lloyd accumulation every KMeans path shares.
+
+    Tiles are cut with `dynamic_slice` DIRECTLY out of Xl inside a fori_loop,
+    and the ragged tail is one extra direct step. Neither `jnp.pad` of the
+    shard nor a `lax.scan` over a reshaped view is safe here: both make XLA
+    materialize a second X-sized buffer (11 GiB at the 1M x 3k benchmark
+    shape, measured) — the slice-in-loop form keeps X single-buffered."""
+    _note("distance.assign_programs")
+    nl, d = Xl.shape
+    k = centers.shape[0]
+
+    def step(carry, xw):
+        sums, counts, inertia = carry
+        xb, wb = xw
+        s, c, i = assign_accumulate(xb, wb, centers, fast=fast)
+        return (sums + s, counts + c, inertia + i), None
+
+    init = (
+        jnp.zeros((k, d), Xl.dtype),
+        jnp.zeros((k,), Xl.dtype),
+        jnp.zeros((), Xl.dtype),
+    )
+    if spmd:
+        # carry must be typed as varying over the mesh axis to match the
+        # per-shard accumulators (JAX shard_map vma typing); the meshless
+        # 1-device program has no axis to cast over
+        from ..parallel.mesh import ROWS_AXIS, pcast_varying
+
+        init = jax.tree.map(lambda t: pcast_varying(t, ROWS_AXIS), init)
+    batch_rows = min(batch_rows, nl)
+    n_full = (nl // batch_rows) * batch_rows
+
+    def tile_body(i, carry):
+        xb = jax.lax.dynamic_slice_in_dim(Xl, i * batch_rows, batch_rows, 0)
+        wb = jax.lax.dynamic_slice_in_dim(wl, i * batch_rows, batch_rows, 0)
+        return step(carry, (xb, wb))[0]
+
+    carry = jax.lax.fori_loop(0, n_full // batch_rows, tile_body, init)
+    if nl - n_full:
+        carry, _ = step(carry, (Xl[n_full:], wl[n_full:]))
+    return carry
+
+
+# ---------------------------------------------------- row-tiled assignment --
+
+
+def argmin_assign(
+    X: jax.Array, centers: jax.Array, *, batch_rows: Optional[int] = None
+) -> jax.Array:
+    """Nearest-center assignment over ALL rows, row-tiled through the core:
+    int32 [n]. The predict-side entry (kmeans transform, k-means|| candidate
+    weighting, IVF/CAGRA anchor assignment) — an admission-approved fit must
+    not OOM at predict because the full [n, k] distance matrix materialized
+    (docs/performance.md "Tiled distance core"). Tiles are clamped back at
+    the ragged tail (overlap rows recompute the same assignment — writes are
+    idempotent), so no padded copy of X is ever made."""
+    _note("distance.argmin_programs")
+    n = X.shape[0]
+    tr = min(batch_rows or tile_rows(), max(n, 1))
+    if n <= tr:
+        return assign_argmin(X, centers)[1]
+    n_tiles = -(-n // tr)
+
+    def body(i, out):
+        s0 = jnp.minimum(i * tr, n - tr)
+        xb = jax.lax.dynamic_slice_in_dim(X, s0, tr, 0)
+        a = assign_argmin(xb, centers)[1]
+        return jax.lax.dynamic_update_slice(out, a, (s0,))
+
+    return jax.lax.fori_loop(0, n_tiles, body, jnp.zeros((n,), jnp.int32))
+
+
+# ----------------------------------------------------------- top-k (kNN) ----
+
+
+def topk_tile(
+    q: jax.Array,  # [B, d] one query tile
+    items: jax.Array,  # [n, d]
+    valid: Optional[jax.Array],  # [n] bool, or None for all-valid
+    kk: int,
+    *,
+    item_sq: Optional[jax.Array] = None,
+    fast: bool = False,
+    k_tile: Optional[int] = None,
+    block_rows: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Running top-kk of one query tile against ALL items: (d2 [B, kk]
+    WITHOUT the ||q||^2 term, item index [B, kk] int32), ascending by
+    distance with `jax.lax.top_k` tie semantics (lower index first — pinned
+    vs a full-matrix top_k by tests/test_distance.py).
+
+    The item axis is scanned in `k_tile` blocks with the [B, kk] best list
+    as the loop carry, so the [B, n] distance matrix never materializes; the
+    last block is clamped back and its overlap columns masked +inf (already
+    merged). On the kernel path each block's distances come from the Pallas
+    d2-block kernel; the fallback runs the same merge with a plain matmul —
+    identical selection logic, bit-compatible results."""
+    _note("distance.topk_programs")
+    n, d = items.shape
+    kk = min(kk, n)
+    if item_sq is None:
+        item_sq = row_sq(items)
+    plan = plan_blocks(q.shape[0], n, d, q.dtype.itemsize) if _use_kernel() else None
+    use_kernel = plan is not None
+    if k_tile is None:
+        # fallback: one block (today's one-matmul shape, right for CPU);
+        # kernel: VMEM-sized item blocks
+        k_tile = max(plan[1], 128) if use_kernel else n
+    kt = min(k_tile, n)
+    big = jnp.asarray(jnp.inf, items.dtype)
+
+    def block_d2(xt, xt_sq):
+        if use_kernel:
+            br = block_rows or plan[0]
+            qp, nq = _pad_rows_multiple(q, br)
+            out = _pl_d2_block(
+                qp, xt, xt_sq, block_rows=br, fast=fast, interpret=_interpret()
+            )
+            return out[:nq]
+        return xt_sq[None, :] - 2.0 * _mm(q, xt.T, fast)
+
+    def masked_block(start):
+        s0 = jnp.minimum(start, n - kt)
+        xt = jax.lax.dynamic_slice_in_dim(items, s0, kt, 0)
+        sq = jax.lax.dynamic_slice_in_dim(item_sq, s0, kt, 0)
+        ids = s0 + jnp.arange(kt, dtype=jnp.int32)
+        d2 = block_d2(xt, sq)
+        keep = ids >= start  # clamp-back overlap: already merged columns
+        if valid is not None:
+            keep = keep & jax.lax.dynamic_slice_in_dim(valid, s0, kt, 0)
+        return jnp.where(keep[None, :], d2, big), ids
+
+    if kt >= n:  # single block: exactly the one-shot top_k
+        d2, ids = masked_block(jnp.int32(0))
+        neg_d, pos = jax.lax.top_k(-d2, kk)
+        return -neg_d, jnp.take_along_axis(
+            jnp.broadcast_to(ids[None, :], d2.shape), pos, axis=1
+        )
+
+    n_tiles = -(-n // kt)
+
+    def body(i, carry):
+        best_d2, best_i = carry
+        d2, ids = masked_block(i * kt)
+        cat_d = jnp.concatenate([best_d2, d2], axis=1)
+        cat_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(ids[None, :], d2.shape)], axis=1
+        )
+        neg_d, pos = jax.lax.top_k(-cat_d, kk)
+        return -neg_d, jnp.take_along_axis(cat_i, pos, axis=1)
+
+    init = (
+        jnp.full((q.shape[0], kk), jnp.inf, items.dtype),
+        jnp.zeros((q.shape[0], kk), jnp.int32),
+    )
+    return jax.lax.fori_loop(0, n_tiles, body, init)
+
+
+def tile_topk(
+    items: jax.Array,  # [n_loc, d]
+    queries: jax.Array,  # [nq, d]
+    valid: jax.Array,  # [n_loc] bool (False on padding)
+    k: int,
+    batch_queries: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact top-k of every query against one device's items: (dist [nq, k]
+    SQUARED incl. the ||q||^2 term, idx [nq, k] local), scanning query tiles
+    of `batch_queries` rows (default `config["distance_tile_rows"]`).
+    Padding items get +inf distance; k past the shard's row count is padded
+    with +inf so a global merge never selects it."""
+    n_loc, d = items.shape
+    nq = queries.shape[0]
+    bq = batch_queries or tile_rows()
+    n_tiles = max(1, -(-nq // bq))
+    pad = n_tiles * bq - nq
+    qp = jnp.pad(queries, ((0, pad), (0, 0)))
+    item_sq = row_sq(items)
+    kk = min(k, n_loc)
+
+    def one_tile(q):
+        d2, idx = topk_tile(q, items, valid, kk, item_sq=item_sq)
+        d_out = d2 + row_sq(q)[:, None]
+        if kk < k:
+            d_out = jnp.pad(d_out, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
+            idx = jnp.pad(idx, ((0, 0), (0, k - kk)))
+        return d_out, idx
+
+    qt = qp.reshape(n_tiles, bq, d)
+    dists, idxs = jax.lax.map(one_tile, qt)
+    return dists.reshape(-1, k)[:nq], idxs.reshape(-1, k)[:nq]
+
+
+# ------------------------------------------------------ distance tiles ------
+
+
+def pairwise_d2(q: jax.Array, x: jax.Array, metric: str = "euclidean") -> jax.Array:
+    """One dense distance tile [tq, n]: squared euclidean, or cosine
+    distance. The tile IS the intended output here (DBSCAN's threshold
+    passes, running-min merges), so it stays a single MXU contraction — the
+    Pallas path exists for the fused argmin/top-k reductions above, where
+    NOT materializing the tile is the win.
+
+    Inputs are pre-normalized for cosine by the caller, so cosine distance
+    is 1 - q.x^T — both metrics ride the MXU. For "precomputed" the rows ARE
+    distances already (DBSCAN hands each pass the matching column slice of
+    the user's distance matrix), so the tile is just `q` — no compute."""
+    _note("distance.pairwise_programs")
+    if metric == "precomputed":
+        return q
+    if metric == "cosine":
+        return 1.0 - q @ x.T
+    return row_sq(q)[:, None] - 2.0 * (q @ x.T) + row_sq(x)[None, :]
+
+
+def min_d2_update(x: jax.Array, cand: jax.Array, min_d2: jax.Array) -> jax.Array:
+    """min(min_d2, min distance^2 to the NEW candidate block) — the k-means||
+    seeding round's incremental matmul (one tile, running min)."""
+    d2 = pairwise_d2(x, cand)
+    return jnp.minimum(min_d2, jnp.maximum(jnp.min(d2, axis=1), 0.0))
+
+
+def score_candidates(
+    q_rows: jax.Array, cand: jax.Array, x: jax.Array, x_sq: jax.Array,
+    fast: bool = False,
+) -> jax.Array:
+    """d2[t, c] = ||q_rows[t] - x[cand[t, c]]||^2 (squared L2, >= 0); the
+    [T, C, d] gather feeds one batched einsum (the MXU side of a graph-ANN
+    round). fast=True runs the einsum with bf16 inputs and f32 accumulation
+    (the KMeans fast-path policy): CAGRA's BUILD only uses these distances
+    to RANK candidate edges, so the ~1e-3 relative rounding is absorbed by
+    the descent's redundancy, while the one-pass MXU einsum runs ~2.6x the
+    f32-highest rate on a v5e. Searches keep exact f32 scoring (their
+    distances are returned to the user)."""
+    xc = x[cand]  # [T, C, d]
+    if fast:
+        dots = jnp.einsum(
+            "td,tcd->tc",
+            q_rows.astype(jnp.bfloat16),
+            xc.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        dots = jnp.einsum("td,tcd->tc", q_rows, xc)
+    d2 = row_sq(q_rows)[:, None] + x_sq[cand] - 2.0 * dots
+    return jnp.maximum(d2, 0.0)
+
+
+def batched_self_topk(
+    xb: jax.Array, ids_b: jax.Array, *, kk: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN inside padded buckets: xb [Cb, L, d], ids_b [Cb, L] global
+    ids (-1 pad). One batched [Cb, L, L] distance matmul on the MXU + top-k
+    — CAGRA's clustered brute-force seeding unit. Returns (d2 [Cb, L, kk],
+    neighbor ids [Cb, L, kk])."""
+    big = jnp.asarray(jnp.inf, jnp.float32)
+    sq = jnp.sum(xb * xb, axis=2)  # [Cb, L]
+    G = jnp.einsum("cld,cmd->clm", xb, xb)
+    d2 = sq[:, :, None] + sq[:, None, :] - 2.0 * G
+    valid = ids_b >= 0
+    mask = valid[:, None, :] & valid[:, :, None]
+    eye = jnp.eye(xb.shape[1], dtype=bool)[None]
+    d2 = jnp.where(mask & ~eye, jnp.maximum(d2, 0.0), big)
+    nd2, pos = jax.lax.top_k(-d2, kk)
+    nid = jnp.take_along_axis(
+        jnp.broadcast_to(ids_b[:, None, :], d2.shape), pos, axis=2
+    )
+    return -nd2, nid

@@ -4,18 +4,19 @@
 #
 # Lloyd iterations as an explicit SPMD program (`shard_map` over the rows axis):
 # each device scans its row block in fixed-size tiles (the reference's
-# `max_samples_per_batch` memory knob, clustering.py:110-121), computing
-# argmin distances on the MXU (x·cᵀ matmul) and accumulating one-hot weighted
-# center sums; partial (k,d) sums/counts/inertia are `psum`'d across devices —
-# the NCCL allreduce the cuML MG solver does internally. The outer loop is a
-# `lax.while_loop` on center movement + max_iter, so the whole fit is ONE XLA
-# program: no per-iteration host round-trips.
+# `max_samples_per_batch` memory knob, clustering.py:110-121) through the
+# SHARED tiled distance core (ops/distance.py — fused assignment + one-hot
+# accumulation, Pallas-k-tiled on TPU); partial (k,d) sums/counts/inertia are
+# `psum`'d across devices — the NCCL allreduce the cuML MG solver does
+# internally. The outer loop is a `lax.while_loop` on center movement +
+# max_iter, so the whole fit is ONE XLA program: no per-iteration host
+# round-trips.
 #
 from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -24,79 +25,15 @@ from jax.sharding import PartitionSpec as P
 
 from .. import telemetry
 from ..parallel.mesh import ROWS_AXIS
+from .distance import (
+    argmin_assign,
+    assign_accumulate,
+    min_d2_update,
+    tile_assign_accumulate as _tile_assign_accumulate,
+)
 
-
-def _mm(a: jax.Array, b: jax.Array, fast: bool) -> jax.Array:
-    """Matmul at the Lloyd-loop precision. `fast` = one-pass bf16 on the MXU
-    with f32 accumulation (explicit casts, so CPU tests see the same rounding).
-
-    Measured at the protocol shape (1M×3k, k=1000, v5e): in-loop bf16 drops
-    331→208 ms/iter while the TRUE inertia (recomputed at 3-pass-bf16 "f32"
-    precision with the final centers) agrees to 7e-6 relative — assignment
-    flips only for near-tied rows, which contribute equally either way. The
-    reported inertia is always evaluated at high precision (see kmeans_fit)."""
-    if fast:
-        return jax.lax.dot(
-            a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
-            precision=jax.lax.Precision.DEFAULT,
-            preferred_element_type=jnp.float32,
-        ).astype(a.dtype)
-    return a @ b
-
-
-def _tile_assign_accumulate(
-    Xl: jax.Array, wl: jax.Array, centers: jax.Array, batch_rows: int,
-    fast: bool = False, spmd: bool = True,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Scan one device's rows in tiles; returns (sums [k,d], counts [k], inertia).
-
-    Tiles are cut with `dynamic_slice` DIRECTLY out of Xl inside a fori_loop,
-    and the ragged tail is one extra direct step. Neither `jnp.pad` of the
-    shard nor a `lax.scan` over a reshaped view is safe here: both make XLA
-    materialize a second X-sized buffer (11 GiB at the 1M x 3k benchmark
-    shape, measured) — the slice-in-loop form keeps X single-buffered."""
-    nl, d = Xl.shape
-    k = centers.shape[0]
-    c_sq = jnp.sum(centers * centers, axis=1)  # [k]
-
-    def step(carry, xw):
-        sums, counts, inertia = carry
-        xb, wb = xw
-        # ||x-c||² = ||x||² - 2 x·c + ||c||²; the x·cᵀ term is the MXU matmul
-        xc = _mm(xb, centers.T, fast)  # [b, k]
-        d2 = c_sq[None, :] - 2.0 * xc
-        assign = jnp.argmin(d2, axis=1)  # [b]
-        min_d2 = jnp.min(d2, axis=1) + jnp.sum(xb * xb, axis=1)
-        oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]  # [b, k]
-        sums = sums + _mm(oh.T, xb, fast)  # [k, d] — MXU again
-        counts = counts + jnp.sum(oh, axis=0)
-        inertia = inertia + jnp.sum(jnp.maximum(min_d2, 0.0) * wb)
-        return (sums, counts, inertia), None
-
-    init = (
-        jnp.zeros((k, d), Xl.dtype),
-        jnp.zeros((k,), Xl.dtype),
-        jnp.zeros((), Xl.dtype),
-    )
-    if spmd:
-        # carry must be typed as varying over the mesh axis to match the
-        # per-shard accumulators (JAX shard_map vma typing); the meshless
-        # 1-device program (_lloyd_step_fused_1dev) has no axis to cast over
-        from ..parallel.mesh import pcast_varying
-
-        init = jax.tree.map(lambda t: pcast_varying(t, ROWS_AXIS), init)
-    batch_rows = min(batch_rows, nl)
-    n_full = (nl // batch_rows) * batch_rows
-
-    def tile_body(i, carry):
-        xb = jax.lax.dynamic_slice_in_dim(Xl, i * batch_rows, batch_rows, 0)
-        wb = jax.lax.dynamic_slice_in_dim(wl, i * batch_rows, batch_rows, 0)
-        return step(carry, (xb, wb))[0]
-
-    carry = jax.lax.fori_loop(0, n_full // batch_rows, tile_body, init)
-    if nl - n_full:
-        carry, _ = step(carry, (Xl[n_full:], wl[n_full:]))
-    return carry
+# jitted once per shape: the seeding paths dispatch these eagerly per round
+_min_d2_update = jax.jit(min_d2_update)
 
 
 def _finish_centers(sums, counts, inertia, centers):
@@ -162,21 +99,12 @@ def _tile_accum_1dev(X, w, centers, sums, counts, inertia, start, *, size, fast=
     consumed operand is size-dependent — at the 1M x 3k benchmark shape even
     the fori_loop-of-dynamic_slice form gets a full X copy — so on one device
     the tile loop lives on the host and the (k,d) accumulators are DONATED
-    device buffers updated in place."""
+    device buffers updated in place. The per-tile math is the shared core's
+    fused assign+accumulate (ops/distance.py)."""
     xb = jax.lax.dynamic_slice_in_dim(X, start, size, 0)
     wb = jax.lax.dynamic_slice_in_dim(w, start, size, 0)
-    k = centers.shape[0]
-    c_sq = jnp.sum(centers * centers, axis=1)
-    xc = _mm(xb, centers.T, fast)
-    d2 = c_sq[None, :] - 2.0 * xc
-    assign = jnp.argmin(d2, axis=1)
-    min_d2 = jnp.min(d2, axis=1) + jnp.sum(xb * xb, axis=1)
-    oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
-    return (
-        sums + _mm(oh.T, xb, fast),
-        counts + jnp.sum(oh, axis=0),
-        inertia + jnp.sum(jnp.maximum(min_d2, 0.0) * wb),
-    )
+    s, c, i = assign_accumulate(xb, wb, centers, fast=fast)
+    return sums + s, counts + c, inertia + i
 
 
 def _lloyd_step_1dev(X, w, centers, batch_rows, fast=False):
@@ -221,22 +149,12 @@ _ONE_DISPATCH_MAX_BYTES = 2 << 30
 @jax.jit
 def block_assign_accumulate(xb: jax.Array, wb: jax.Array, centers: jax.Array):
     """One streaming chunk's Lloyd contribution: (sums [k,d], counts [k],
-    inertia) — the same assignment + one-hot accumulation math as the
-    resident tile step (`_tile_assign_accumulate`), over ONE placed row
-    block. The out-of-core driver (ops/streaming.py) sums these per-chunk
-    partials across the double-buffered pipeline; padding rows carry zero
-    weight, so they contribute nothing — exactly the resident pad contract."""
-    k = centers.shape[0]
-    c_sq = jnp.sum(centers * centers, axis=1)
-    d2 = c_sq[None, :] - 2.0 * (xb @ centers.T)
-    assign = jnp.argmin(d2, axis=1)
-    min_d2 = jnp.min(d2, axis=1) + jnp.sum(xb * xb, axis=1)
-    oh = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
-    return (
-        oh.T @ xb,
-        jnp.sum(oh, axis=0),
-        jnp.sum(jnp.maximum(min_d2, 0.0) * wb),
-    )
+    inertia) — the shared core's fused assign+accumulate
+    (ops/distance.py), over ONE placed row block. The out-of-core driver
+    (ops/streaming.py) sums these per-chunk partials across the
+    double-buffered pipeline; padding rows carry zero weight, so they
+    contribute nothing — exactly the resident pad contract."""
+    return assign_accumulate(xb, wb, centers)
 
 
 def kmeans_ckpt_key(init_centers, max_iter: int, tol: float) -> str:
@@ -301,7 +219,7 @@ def kmeans_fit(
     is a stale, possibly-bf16 partial) and `inertia_` is returned as NaN.
 
     precision_mode: "fast" (default for f32) runs the IN-LOOP distance and
-    center-update matmuls in one-pass bf16 (see _mm — 1.6× per iteration at
+    center-update matmuls in one-pass bf16 (see distance._mm — 1.6× per iteration at
     the protocol shape, true inertia agrees to ~1e-5); "high" keeps the
     ambient (3-pass-bf16 "f32") precision everywhere. f64 inputs always run
     "high". The final reported inertia is high-precision in both modes."""
@@ -432,10 +350,14 @@ def kmeans_fit(
 
 @jax.jit
 def kmeans_predict(X: jax.Array, centers: jax.Array) -> jax.Array:
-    """Nearest-center assignment for a batch of rows (transform path)."""
-    c_sq = jnp.sum(centers * centers, axis=1)
-    d2 = c_sq[None, :] - 2.0 * (X @ centers.T)
-    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+    """Nearest-center assignment for a batch of rows (transform path).
+
+    Row-tiled through the shared core (`distance.argmin_assign`,
+    `config["distance_tile_rows"]` rows per tile): the full [n, k] distance
+    matrix never materializes, so a fit the HBM admission controller
+    approved cannot OOM at PREDICT — the predict-side tile is a budgeted
+    workspace term (memory.py / KMeans._solver_workspace_terms)."""
+    return argmin_assign(X, centers)
 
 
 _INIT_SAMPLE_CAP = 262_144  # rows used for seeding (both init paths)
@@ -458,20 +380,9 @@ def _init_subsample(x_host, sample_weight, rng):
     return x, sw
 
 
-@jax.jit
-def _assign_nearest(X, C):
-    return jnp.argmin(jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1)
-
-
-@partial(jax.jit, static_argnames=())
-def _min_d2_update(x, cand, min_d2):
-    """min(min_d2, min distance² to the NEW candidate block) — one matmul."""
-    d2 = (
-        jnp.sum(x * x, axis=1)[:, None]
-        - 2.0 * x @ cand.T
-        + jnp.sum(cand * cand, axis=1)[None, :]
-    )
-    return jnp.minimum(min_d2, jnp.maximum(jnp.min(d2, axis=1), 0.0))
+# nearest-candidate assignment for the seeding paths: the shared row-tiled
+# core (never a full [n, k] distance matrix), jitted once per shape
+_assign_nearest = jax.jit(argmin_assign)
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -612,12 +523,7 @@ def _kmeanspar_round(xd, cand_prev, min_d2, sw, key, *, l: int):
     log p + Gumbel(0,1); the top-l keys are exactly a weighted
     without-replacement sample). Returns (new candidate block [l, d],
     updated min_d2)."""
-    d2 = (
-        jnp.sum(xd * xd, axis=1)[:, None]
-        - 2.0 * xd @ cand_prev.T
-        + jnp.sum(cand_prev * cand_prev, axis=1)[None, :]
-    )
-    min_d2 = jnp.minimum(min_d2, jnp.maximum(jnp.min(d2, axis=1), 0.0))
+    min_d2 = min_d2_update(xd, cand_prev, min_d2)
     probs = min_d2 * sw
     total = jnp.sum(probs)
     # degenerate (all points covered): fall back to uniform-by-weight

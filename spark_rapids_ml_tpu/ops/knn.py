@@ -19,7 +19,7 @@
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,60 +27,41 @@ from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import ROWS_AXIS
+from .distance import argmin_assign, pairwise_d2, row_sq, tile_topk, topk_tile
 
+# row-tiled nearest-centroid assignment (shared core), compiled once per shape
+_assign_rows = jax.jit(argmin_assign)
 
-def _tile_topk(items, queries, valid, k, batch_queries=4096):
-    """Per-device exact top-k: items [n_loc, d], queries [nq, d] ->
-    (dist [nq, k], idx [nq, k] local). Scans query tiles; padding items get
-    +inf distance."""
-    n_loc, d = items.shape
-    nq = queries.shape[0]
-    n_tiles = max(1, -(-nq // batch_queries))
-    pad = n_tiles * batch_queries - nq
-    qp = jnp.pad(queries, ((0, pad), (0, 0)))
-    item_sq = jnp.sum(items * items, axis=1)  # [n_loc]
-    big = jnp.asarray(jnp.inf, items.dtype)
-    # k may exceed the per-shard row count (only the GLOBAL row count bounds
-    # it); take what the shard has and pad candidates with +inf distance so the
-    # global merge never selects them
-    kk = min(k, n_loc)
-
-    def one_tile(q):
-        # ||q - x||² = ||q||² - 2 q·x + ||x||²; q·xᵀ rides the MXU
-        d2 = item_sq[None, :] - 2.0 * (q @ items.T)
-        d2 = jnp.where(valid[None, :], d2, big)
-        neg_d, idx = jax.lax.top_k(-d2, kk)
-        d_out = -neg_d + jnp.sum(q * q, axis=1)[:, None]
-        if kk < k:
-            d_out = jnp.pad(d_out, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
-            idx = jnp.pad(idx, ((0, 0), (0, k - kk)))
-        return d_out, idx
-
-    qt = qp.reshape(n_tiles, batch_queries, d)
-    dists, idxs = jax.lax.map(one_tile, qt)
-    return dists.reshape(-1, k)[:nq], idxs.reshape(-1, k)[:nq]
+# the per-device query-tile scan is the SHARED core's (ops/distance.py):
+# query tiles of config["distance_tile_rows"] rows, item axis k-tiled so the
+# [tile, n_loc] distance block never materializes on the kernel path
+_tile_topk = tile_topk
 
 
 @jax.jit
 def _row_sq(x):
-    return jnp.sum(x * x, axis=1)
+    return row_sq(x)
 
 
 @partial(jax.jit, static_argnames=("kk",))
 def _topk_tile_1dev(items, valid, item_sq, q, *, kk):
-    d2 = item_sq[None, :] - 2.0 * (q @ items.T)
-    d2 = jnp.where(valid[None, :], d2, jnp.inf)
-    neg_d, idx = jax.lax.top_k(-d2, kk)
-    return -neg_d + jnp.sum(q * q, axis=1)[:, None], idx
+    """One compiled query-tile program over the shared core (the host-looped
+    single-device path below)."""
+    d2, idx = topk_tile(q, items, valid, kk, item_sq=item_sq)
+    return d2 + row_sq(q)[:, None], idx
 
 
 def _exact_knn_1dev(items, valid, queries, k, batch_queries):
     """Single-device exact kNN with a HOST loop over query tiles: each tile is
-    one top-level program (matmul + top_k). The shard_map/in-program tiling
-    form costs a full copy of the item matrix at benchmark scale (measured
-    +11 GiB at 1M x 3k -> OOM), same XLA behavior as the KMeans tile loop."""
+    one top-level program over the shared core (distance.topk_tile). The
+    shard_map/in-program tiling form costs a full copy of the item matrix at
+    benchmark scale (measured +11 GiB at 1M x 3k -> OOM), same XLA behavior
+    as the KMeans tile loop."""
     import numpy as np
 
+    from .distance import tile_rows
+
+    batch_queries = batch_queries or tile_rows()
     nq = queries.shape[0]
     if nq == 0:
         return (
@@ -111,16 +92,13 @@ def _exact_knn_1dev(items, valid, queries, k, batch_queries):
 
 
 @partial(jax.jit, static_argnames=())
-def _sparse_tile_merge(xt, q, q_sq, best_d2, best_i, tile_ids, fresh):
+def _sparse_tile_merge(xt, q, best_d2, best_i, tile_ids, fresh):
     """Merge one densified item tile into the running top-k: d² tile vs all
-    queries (one MXU matmul), concat with the carried best, re-top-k.
-    `fresh` masks rows already merged by a previous tile (the clamped last
-    tile overlaps — a duplicate candidate would otherwise occupy two slots)."""
-    d2 = (
-        q_sq[:, None]
-        - 2.0 * q @ xt.T
-        + jnp.sum(xt * xt, axis=1)[None, :]
-    )  # [nq, bt]
+    queries (one shared-core distance tile, ops/distance.py), concat with
+    the carried best, re-top-k. `fresh` masks rows already merged by a
+    previous tile (the clamped last tile overlaps — a duplicate candidate
+    would otherwise occupy two slots)."""
+    d2 = pairwise_d2(q, xt)  # [nq, bt]
     d2 = jnp.where(fresh[None, :], d2, jnp.inf)
     cat_d = jnp.concatenate([best_d2, d2], axis=1)
     cat_i = jnp.concatenate([best_i, jnp.broadcast_to(tile_ids[None, :], d2.shape)], axis=1)
@@ -145,7 +123,6 @@ def exact_knn_sparse(items_csr, queries, k: int, batch_items: int = 65536):
     if nq == 0:
         return np.zeros((0, k), dtype=dtype), np.zeros((0, k), dtype=np.int32)
     q_dev = jax.device_put(np.ascontiguousarray(queries, dtype=dtype))
-    q_sq = _row_sq(q_dev)
     best_d2 = jnp.full((nq, kk), jnp.inf, dtype)
     best_i = jnp.full((nq, kk), -1, jnp.int32)
     for start in range(0, n, batch_items):
@@ -157,7 +134,7 @@ def exact_knn_sparse(items_csr, queries, k: int, batch_items: int = 65536):
         tile_ids = jnp.arange(s0, stop, dtype=jnp.int32)
         fresh = tile_ids >= start
         best_d2, best_i = _sparse_tile_merge(
-            xt, q_dev, q_sq, best_d2, best_i, tile_ids, fresh
+            xt, q_dev, best_d2, best_i, tile_ids, fresh
         )
     dist = np.sqrt(np.maximum(np.asarray(best_d2), 0.0))
     idx = np.asarray(best_i)
@@ -174,11 +151,12 @@ def exact_knn(
     *,
     mesh,
     k: int,
-    batch_queries: int = 4096,
+    batch_queries: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Global exact kNN: returns (distances [nq, k], GLOBAL item indices [nq, k])
     sorted ascending by distance. Distances are euclidean (not squared), Spark/
-    cuML convention."""
+    cuML convention. `batch_queries` defaults to
+    ``config["distance_tile_rows"]`` (the shared core's row-tile knob)."""
     if mesh.devices.size == 1:
         return _exact_knn_1dev(items, valid, queries, k, batch_queries)
     return _exact_knn_sharded(
@@ -194,7 +172,7 @@ def _exact_knn_sharded(
     *,
     mesh,
     k: int,
-    batch_queries: int = 4096,
+    batch_queries: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     n_dev = mesh.devices.size
     n_loc = items.shape[0] // n_dev
@@ -304,11 +282,7 @@ def _coarse_quantizer(x, n_lists: int, seed: int, kmeans_iters: int = 10):
         mesh=get_mesh(1), max_iter=kmeans_iters, tol=1e-6, final_inertia=False,
     )
     centroids_dev = state["cluster_centers_"].astype(jnp.float32)
-    assign = np.asarray(
-        jax.jit(lambda X, C: jnp.argmin(
-            jnp.sum(C * C, 1)[None, :] - 2.0 * X @ C.T, axis=1
-        ).astype(jnp.int32))(xd, centroids_dev)
-    )
+    assign = np.asarray(_assign_rows(xd, centroids_dev))
     counts = np.bincount(assign, minlength=n_lists)
     L = max(1, int(counts.max()))
     order = np.argsort(assign, kind="stable")
@@ -418,13 +392,13 @@ def _encode_residuals(X, C, A, CB):
         ab = jax.lax.dynamic_slice(A, (r0,), (tile,))
         R = (xb - C[ab]).reshape(tile, M, dsub)
         d2 = cb_sq[None] - 2.0 * jnp.einsum("nmd,mkd->nmk", R, CB)
-        codes_t = jnp.argmin(d2, axis=2).astype(jnp.int32)
+        codes_t = jnp.argmin(d2, axis=2).astype(jnp.int32)  # distance-ok: PQ nearest-codeword argmin over [tile, M, K] per-SUBSPACE residual distances — M parallel tiny codebooks, not the row-tile x·cᵀ shape the core owns
         return jax.lax.dynamic_update_slice(out, codes_t, (r0, 0))
 
     if n <= tile:
         R = (X - C[A]).reshape(n, M, dsub)
         d2 = cb_sq[None] - 2.0 * jnp.einsum("nmd,mkd->nmk", R, CB)
-        return jnp.argmin(d2, axis=2).astype(jnp.int32)
+        return jnp.argmin(d2, axis=2).astype(jnp.int32)  # distance-ok: same per-subspace PQ codeword argmin as the tiled branch above
     return jax.lax.fori_loop(
         0, n_tiles, body, jnp.zeros((n, M), jnp.int32)
     )
@@ -447,8 +421,9 @@ def _ivfpq_search_impl(
 
     def one_tile(q):  # [B, d]
         B = q.shape[0]
-        cd = jnp.sum(centroids * centroids, 1)[None, :] - 2.0 * q @ centroids.T
-        probe_d, probe = jax.lax.top_k(-cd, n_probes)  # [B, P]
+        # coarse probe through the shared core (identical ranking: the
+        # ||q||^2 term is constant per row)
+        _, probe = topk_tile(q, centroids, None, n_probes)  # [B, P]
         # residual per probed list, split into subspaces
         q_res = q[:, None, :] - centroids[probe]  # [B, P, d]
         q_res = q_res.reshape(B, n_probes, M, dsub)
@@ -527,8 +502,8 @@ def ivfflat_search(
 
     def one_tile(q):  # [B, d]
         B = q.shape[0]
-        cd = jnp.sum(centroids * centroids, 1)[None, :] - 2.0 * q @ centroids.T
-        _, probe = jax.lax.top_k(-cd, n_probes)  # [B, n_probes]
+        # coarse probe through the shared core (ranking-identical, see above)
+        _, probe = topk_tile(q, centroids, None, n_probes)  # [B, n_probes]
         q_sq = jnp.sum(q * q, axis=1)  # [B]
 
         def probe_body(p_i, carry):
@@ -545,7 +520,7 @@ def ivfflat_search(
             d2 = jnp.where(ids >= 0, d2, jnp.inf)
             cat_d = jnp.concatenate([best_d, d2], axis=1)
             cat_i = jnp.concatenate([best_i, ids], axis=1)
-            neg_d, pos = jax.lax.top_k(-cat_d, kk)
+            neg_d, pos = jax.lax.top_k(-cat_d, kk)  # distance-ok: IVF bucket scan — per-query GATHERED buckets ([B, L, d] batched einsum), not the shared row-tile x·cᵀ shape; the running kk-merge is the memory bound here
             return -neg_d, jnp.take_along_axis(cat_i, pos, axis=1)
 
         init = (

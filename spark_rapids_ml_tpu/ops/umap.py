@@ -369,9 +369,13 @@ def _self_first(idx: np.ndarray, dist: np.ndarray) -> Tuple[np.ndarray, np.ndarr
 
 
 def build_knn_graph(
-    x: np.ndarray, n_neighbors: int, mesh, batch_queries: int = 4096
+    x: np.ndarray, n_neighbors: int, mesh, batch_queries: Optional[int] = None
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Exact kNN graph incl. self in column 0: ([n, k] idx, [n, k] dist)."""
+    """Exact kNN graph incl. self in column 0: ([n, k] idx, [n, k] dist).
+
+    The graph build runs on the shared tiled distance core (ops/distance.py
+    via exact_knn); `batch_queries` defaults to
+    ``config["distance_tile_rows"]``."""
     from ..parallel.mesh import make_global_rows
     from .knn import exact_knn
 

@@ -31,6 +31,7 @@ from ci.analysis.rules import (  # noqa: E402
     MetricNameRule,
     PadRowsRule,
     PerfCounterRule,
+    RawDistanceRule,
     SleepRule,
     SpmdDivergenceRule,
     TracedImpurityRule,
@@ -125,6 +126,115 @@ def test_pad_rows_true_positive_and_bucket_passes():
 
 
 # --------------------------------------------------------------------------
+# raw-distance: hand-rolled x·cᵀ → argmin/top-k outside ops/distance.py
+# --------------------------------------------------------------------------
+
+
+def test_raw_distance_inline_matmul_argmin_fires():
+    src = """
+    import jax.numpy as jnp
+    def assign(x, c):
+        return jnp.argmin(jnp.sum(c * c, 1)[None, :] - 2.0 * x @ c.T, axis=1)
+    """
+    assert rule_ids(run(src, RawDistanceRule)) == ["raw-distance"]
+
+
+def test_raw_distance_tainted_local_through_where_and_concat_fires():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def tile(q, items, valid, best):
+        d2 = jnp.sum(items * items, 1)[None, :] - 2.0 * (q @ items.T)
+        d2 = jnp.where(valid[None, :], d2, jnp.inf)
+        cat = jnp.concatenate([best, d2], axis=1)
+        return jax.lax.top_k(-cat, 4)
+    """
+    assert rule_ids(run(src, RawDistanceRule)) == ["raw-distance"]
+
+
+def test_raw_distance_einsum_taint_and_method_argmin_fire():
+    src = """
+    import jax.numpy as jnp
+    def f(q, bucket):
+        d2 = -2.0 * jnp.einsum("bld,bd->bl", bucket, q)
+        return d2.argmin(axis=1)
+    """
+    assert rule_ids(run(src, RawDistanceRule)) == ["raw-distance"]
+
+
+def test_raw_distance_binding_inside_if_block_fires():
+    # regression: a binding and its reduction inside ONE compound statement
+    src = """
+    import jax.numpy as jnp
+    def f(x, c, small):
+        if small:
+            d2 = c_sq[None] - 2.0 * jnp.einsum("nd,kd->nk", x, c)
+            return jnp.argmin(d2, axis=1)
+        return None
+    """
+    assert rule_ids(run(src, RawDistanceRule)) == ["raw-distance"]
+
+
+def test_raw_distance_core_call_results_are_clean():
+    # the intended ported shape: distances from the shared core, reduction
+    # on the call RESULT — calls launder taint
+    src = """
+    import jax
+    import jax.numpy as jnp
+    from .distance import pairwise_d2
+    def f(q, items):
+        d2 = pairwise_d2(q, items)
+        return jax.lax.top_k(-d2, 4)
+    """
+    assert run(src, RawDistanceRule) == []
+
+
+def test_raw_distance_non_matmul_reductions_pass():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def g(scores, probs, gumbel):
+        a = jnp.argmin(scores, axis=1)            # no matmul anywhere
+        keys = jnp.where(probs > 0, jnp.log(probs) + gumbel, -jnp.inf)
+        _, idx = jax.lax.top_k(keys, 8)           # laundered through log()
+        return a, idx
+    """
+    assert run(src, RawDistanceRule) == []
+
+
+def test_raw_distance_exempt_in_core_and_waiver():
+    src = """
+    import jax.numpy as jnp
+    def assign(x, c):
+        return jnp.argmin(c_sq[None, :] - 2.0 * x @ c.T, axis=1)
+    """
+    assert run(src, RawDistanceRule, relpath="spark_rapids_ml_tpu/ops/distance.py") == []
+    waived = """
+    import jax.numpy as jnp
+    def assign(x, c):
+        return jnp.argmin(c_sq[None, :] - 2.0 * x @ c.T, axis=1)  # distance-ok: fixture rationale
+    """
+    assert run(waived, RawDistanceRule) == []
+    bare = """
+    import jax.numpy as jnp
+    def assign(x, c):
+        return jnp.argmin(c_sq[None, :] - 2.0 * x @ c.T, axis=1)  # distance-ok
+    """
+    assert rule_ids(run(bare, RawDistanceRule)) == ["raw-distance"]
+
+
+def test_raw_distance_clean_rebinding_clears_taint():
+    src = """
+    import jax.numpy as jnp
+    def f(x, c, scores):
+        d2 = x @ c.T
+        d2 = jnp.asarray(scores)   # rebinding from a laundering call cleans
+        return jnp.argmin(d2, axis=1)
+    """
+    assert run(src, RawDistanceRule) == []
+
+
+# --------------------------------------------------------------------------
 # pinned regression: the regex-era false-positive class — trigger text in
 # comments, docstrings, and string literals must not fire under AST ports
 # --------------------------------------------------------------------------
@@ -144,6 +254,10 @@ _LEGACY_FP_SNIPPETS = [
     (SleepRule, '# time.sleep(5) would be wrong here\ndoc = "time.sleep(5)"\n'),
     (MemStatsRule, '"""Never call d.memory_stats() directly."""\ns = "d.memory_stats()"\n'),
     (PadRowsRule, '# pad_rows(x, 8) is forbidden\ns = "pad_rows(x, 8)"\n'),
+    (
+        RawDistanceRule,
+        '"""Never write jnp.argmin(x @ c.T) by hand."""\ns = "jax.lax.top_k(-(x @ c.T), k)"\n',
+    ),
 ]
 
 

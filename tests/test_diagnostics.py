@@ -611,6 +611,73 @@ def test_regression_counter_reference_is_one_coherent_snapshot():
     assert verdict["verdict"] == "pass"
 
 
+def test_regression_new_lanes_start_their_own_trajectory():
+    # the first artifact carrying per-lane values (kmeans_scale/knn joining
+    # the geomean) must NOT false-fail against history that lacks them: the
+    # geomean lane is skipped (different composition), the per-lane gates
+    # are skipped (trajectory start), and the counter lanes still run
+    from benchmark.regression import run_gate
+
+    cur = _bench_record(80_000.0, {"ingest.rows": 1e6, "ingest.datasets": 2})
+    cur["lanes"] = {"pca": 1e6, "kmeans": 1e5, "kmeans_scale": 3e6, "knn": 5e4}
+    verdict = run_gate(cur, HIST)
+    lanes = {ln["lane"]: ln for ln in verdict["lanes"]}
+    assert lanes["throughput_geomean"]["status"] == "skipped"
+    assert "new" in lanes["throughput_geomean"]["note"]
+    for name in ("pca", "kmeans", "kmeans_scale", "knn"):
+        assert lanes[f"lane:{name}"]["status"] == "skipped"
+        assert "trajectory start" in lanes[f"lane:{name}"]["note"]
+    assert lanes["ingest.rows"]["status"] == "pass"
+    assert verdict["verdict"] == "pass"
+
+
+def test_regression_per_lane_gate_catches_single_lane_slowdown():
+    # once two runs share the lane composition: a 2x slowdown in ONE lane
+    # fails its per-lane gate even when the other lanes lift the geomean
+    from benchmark.regression import run_gate
+
+    def lane_rec(value, lanes):
+        rec = _bench_record(value)
+        rec["lanes"] = dict(lanes)
+        return rec
+
+    hist = [
+        lane_rec(100_000.0, {"kmeans_scale": 3e6, "knn": 5e4}),
+        lane_rec(101_000.0, {"kmeans_scale": 3e6, "knn": 5e4}),
+    ]
+    cur = lane_rec(102_000.0, {"kmeans_scale": 6e6, "knn": 2e4})  # knn halved
+    verdict = run_gate(cur, hist)
+    lanes = {ln["lane"]: ln for ln in verdict["lanes"]}
+    assert lanes["throughput_geomean"]["status"] == "pass"  # same composition
+    assert lanes["lane:kmeans_scale"]["status"] == "pass"
+    assert lanes["lane:knn"]["status"] == "fail"
+    assert verdict["verdict"] == "fail"
+    assert "lane:knn" in verdict["failed_lanes"]
+
+
+def test_regression_optional_extra_lane_does_not_skip_geomean_gate():
+    # BENCH_OOCORE toggled on for one round adds an EXTRA embedded lane but
+    # the geomean composition (geomean_lanes) is unchanged — the headline
+    # gate must still run (and fail here: 2x slowdown), while the extra
+    # lane just starts its own trajectory
+    from benchmark.regression import run_gate
+
+    def rec(value, extras=None):
+        r = _bench_record(value)
+        r["lanes"] = {"pca": 1e6, "kmeans": 1e5}
+        r["lanes"].update(extras or {})
+        r["geomean_lanes"] = ["kmeans", "pca"]
+        return r
+
+    hist = [rec(100_000.0), rec(101_000.0)]
+    verdict = run_gate(rec(50_000.0, extras={"oocore_stream": 7e4}), hist)
+    lanes = {ln["lane"]: ln for ln in verdict["lanes"]}
+    assert lanes["throughput_geomean"]["status"] == "fail"
+    assert lanes["lane:oocore_stream"]["status"] == "skipped"
+    assert "trajectory start" in lanes["lane:oocore_stream"]["note"]
+    assert verdict["verdict"] == "fail"
+
+
 def test_regression_gate_incomplete_run_is_no_data_not_failure():
     from benchmark.regression import run_gate
 

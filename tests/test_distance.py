@@ -1,0 +1,267 @@
+#
+# Parity suite for the shared tiled distance/top-k core (ops/distance.py):
+# the Pallas kernels (run through the interpreter — CPU CI's way of
+# executing real kernel code) against the bit-compatible pure-jnp fallback,
+# swept across tile boundaries (rows/k/d = block±1), f32/f64,
+# weighted/zero-weight padding rows, the `fast` bf16 precision mode, and
+# top-k tie ordering against a full-matrix `jax.lax.top_k` reference.
+# Plus the compile-count invariant: a KMeans fit compiles ONE distance
+# program across all its Lloyd iterations (the distance.* counters tick at
+# TRACE time by design).
+#
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import telemetry
+from spark_rapids_ml_tpu.core import config
+from spark_rapids_ml_tpu.ops import distance
+
+
+@pytest.fixture
+def interpret_mode():
+    """Force the REAL kernels through the Pallas interpreter for this test;
+    restore the probed mode after."""
+    saved = distance._MODE
+    distance._MODE = "interpret"
+    yield
+    distance._MODE = saved
+
+
+@pytest.fixture
+def jnp_mode():
+    saved = distance._MODE
+    distance._MODE = "jnp"
+    yield
+    distance._MODE = saved
+
+
+def _data(n, k, d, dtype, seed=0, dup_rows=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(dtype)
+    if dup_rows:  # deliberate exact ties for the tie-ordering tests
+        X[-dup_rows:] = X[:dup_rows]
+    C = rng.normal(size=(k, d)).astype(dtype)
+    w = rng.uniform(0.5, 2.0, size=n).astype(dtype)
+    return jnp.asarray(X), jnp.asarray(C), jnp.asarray(w)
+
+
+def _fallback_assign_accumulate(X, w, C):
+    d2 = jnp.sum(C * C, 1)[None, :] - 2.0 * (X @ C.T)
+    assign = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.min(d2, axis=1) + jnp.sum(X * X, axis=1)
+    oh = jax.nn.one_hot(assign, C.shape[0], dtype=X.dtype) * w[:, None]
+    return oh.T @ X, jnp.sum(oh, axis=0), jnp.sum(jnp.maximum(min_d2, 0.0) * w)
+
+
+# ------------------------------------------------- assign/accumulate parity --
+
+
+@pytest.mark.parametrize("n", [7, 8, 9])
+@pytest.mark.parametrize("k", [3, 4, 5])
+@pytest.mark.parametrize("d", [5, 8])
+def test_assign_accumulate_kernel_parity_f64(interpret_mode, n, k, d):
+    # blocks of (8, 4): every (n, k) combination crosses a boundary or a
+    # ragged tail on at least one axis
+    X, C, w = _data(n, k, d, np.float64, seed=n * 100 + k * 10 + d)
+    s, c, i = distance.assign_accumulate(X, w, C, block_rows=8, block_k=4)
+    sr, cr, ir = _fallback_assign_accumulate(X, w, C)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(cr), rtol=1e-9)
+    np.testing.assert_allclose(float(i), float(ir), rtol=1e-9)
+
+
+@pytest.mark.parametrize("n,k", [(9, 5), (16, 4), (33, 7)])
+def test_assignments_exact_f32(interpret_mode, n, k):
+    X, C, _ = _data(n, k, 6, np.float32, seed=n)
+    _, a = distance.assign_argmin(X, C, block_rows=8, block_k=4)
+    ref = jnp.argmin(jnp.sum(C * C, 1)[None, :] - 2.0 * (X @ C.T), axis=1)
+    assert (np.asarray(a) == np.asarray(ref)).all()
+
+
+def test_assignments_exact_f32_fast_mode(interpret_mode):
+    # `fast` (one-pass bf16, f32 accumulation) must round IDENTICALLY on the
+    # kernel and fallback paths — assignments are compared exactly
+    X, C, w = _data(33, 5, 8, np.float32, seed=3)
+    s, c, i = distance.assign_accumulate(X, w, C, fast=True, block_rows=8, block_k=4)
+    distance._MODE = "jnp"
+    sr, cr, ir = distance.assign_accumulate(X, w, C, fast=True)
+    distance._MODE = "interpret"
+    np.testing.assert_allclose(np.asarray(c), np.asarray(cr), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(i), float(ir), rtol=1e-5)
+
+
+def test_zero_weight_padding_rows_contribute_nothing(interpret_mode):
+    # the resident pad contract: rows with w == 0 change NOTHING, on both
+    # paths, including when they land in a ragged kernel block
+    X, C, w = _data(11, 4, 5, np.float64, seed=7)
+    Xp = jnp.concatenate([X, jnp.ones((5, 5), X.dtype) * 1e6])
+    wp = jnp.concatenate([w, jnp.zeros((5,), X.dtype)])
+    s, c, i = distance.assign_accumulate(Xp, wp, C, block_rows=8, block_k=4)
+    sr, cr, ir = _fallback_assign_accumulate(X, w, C)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(cr), rtol=1e-9)
+    np.testing.assert_allclose(float(i), float(ir), rtol=1e-9)
+
+
+def test_argmin_assign_ragged_tiles_match_bruteforce(jnp_mode):
+    # row-tiled predict path: clamp-back tiles recompute overlap rows
+    # idempotently — assignments equal the untiled argmin
+    X, C, _ = _data(37, 6, 5, np.float64, seed=11)
+    a = distance.argmin_assign(X, C, batch_rows=8)
+    ref = jnp.argmin(jnp.sum(C * C, 1)[None, :] - 2.0 * (X @ C.T), axis=1)
+    assert (np.asarray(a) == np.asarray(ref)).all()
+    assert a.dtype == jnp.int32
+
+
+# ------------------------------------------------------------ top-k parity --
+
+
+def _topk_reference(q, items, valid, kk):
+    d2 = jnp.sum(items * items, 1)[None, :] - 2.0 * (q @ items.T)
+    if valid is not None:
+        d2 = jnp.where(valid[None, :], d2, jnp.inf)
+    neg_d, idx = jax.lax.top_k(-d2, kk)
+    return -neg_d, idx
+
+
+@pytest.mark.parametrize("mode_fixture", ["interpret_mode", "jnp_mode"])
+@pytest.mark.parametrize("n", [7, 8, 9, 20])
+def test_topk_tile_boundary_parity(request, mode_fixture, n):
+    request.getfixturevalue(mode_fixture)
+    rng = np.random.default_rng(n)
+    q = jnp.asarray(rng.normal(size=(5, 6)))
+    items = jnp.asarray(rng.normal(size=(n, 6)))
+    kk = min(4, n)
+    d2, idx = distance.topk_tile(q, items, None, kk, k_tile=4, block_rows=8)
+    d2r, idxr = _topk_reference(q, items, None, kk)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(d2r), rtol=1e-9)
+    assert (np.asarray(idx) == np.asarray(idxr)).all()
+
+
+def test_topk_tie_ordering_matches_lax_top_k(jnp_mode):
+    # duplicated item rows produce EXACTLY tied distances; the k-tiled
+    # running merge must resolve them like one full-matrix lax.top_k
+    # (lower index first) even when the tie straddles a tile boundary
+    rng = np.random.default_rng(0)
+    base = rng.integers(-3, 4, size=(6, 5)).astype(np.float64)
+    items = jnp.asarray(np.concatenate([base, base[:3]]))  # ids 6,7,8 == 0,1,2
+    q = jnp.asarray(rng.integers(-3, 4, size=(4, 5)).astype(np.float64))
+    d2, idx = distance.topk_tile(q, items, None, 6, k_tile=4)
+    d2r, idxr = _topk_reference(q, items, None, 6)
+    np.testing.assert_array_equal(np.asarray(d2), np.asarray(d2r))
+    assert (np.asarray(idx) == np.asarray(idxr)).all()
+
+
+def test_topk_tie_ordering_kernel_path(interpret_mode):
+    # INTEGER-valued rows: every dot product is exact in f64 regardless of
+    # tiling/summation order, so duplicated rows are bitwise ties on both
+    # paths — the only fair way to compare tie ordering across matmul
+    # shapes (float matmuls of different shapes are not bitwise
+    # reproducible even within one backend)
+    rng = np.random.default_rng(1)
+    base = rng.integers(-3, 4, size=(6, 5)).astype(np.float64)
+    items = jnp.asarray(np.concatenate([base, base[:3]]))  # ids 6,7,8 == 0,1,2
+    q = jnp.asarray(rng.integers(-3, 4, size=(4, 5)).astype(np.float64))
+    d2, idx = distance.topk_tile(q, items, None, 6, k_tile=4, block_rows=4)
+    d2r, idxr = _topk_reference(q, items, None, 6)
+    np.testing.assert_array_equal(np.asarray(d2), np.asarray(d2r))
+    assert (np.asarray(idx) == np.asarray(idxr)).all()
+
+
+def test_topk_invalid_items_masked(interpret_mode):
+    # padding items (valid=False) must never appear among finite neighbors
+    rng = np.random.default_rng(2)
+    items = jnp.asarray(rng.normal(size=(9, 4)))
+    valid = jnp.asarray(np.array([True] * 6 + [False] * 3))
+    q = jnp.asarray(rng.normal(size=(3, 4)))
+    d2, idx = distance.topk_tile(q, items, valid, 6, k_tile=4, block_rows=4)
+    finite = np.isfinite(np.asarray(d2))
+    assert finite[:, :6].sum() == 3 * 6  # all six real items found
+    assert (np.asarray(idx)[finite] < 6).all()
+
+
+def test_tile_topk_routes_batch_queries_through_config():
+    # satellite: the query scan's hardcoded 4096 became
+    # config["distance_tile_rows"] — a small knob value must still produce
+    # exact results (more, smaller tiles), proving the knob is live
+    saved = config["distance_tile_rows"]
+    config["distance_tile_rows"] = 8
+    try:
+        assert distance.tile_rows() == 8
+        rng = np.random.default_rng(5)
+        items = jnp.asarray(rng.normal(size=(30, 4)))
+        valid = jnp.asarray(np.ones(30, dtype=bool))
+        q = jnp.asarray(rng.normal(size=(21, 4)))  # 3 tiles of 8 (ragged)
+        dist, idx = distance.tile_topk(items, q, valid, 5)
+        d2r, idxr = _topk_reference(q, items, valid, 5)
+        ref = np.asarray(d2r) + np.sum(np.asarray(q) ** 2, axis=1)[:, None]
+        np.testing.assert_allclose(np.asarray(dist), ref, rtol=1e-9)
+        assert (np.asarray(idx) == np.asarray(idxr)).all()
+    finally:
+        config["distance_tile_rows"] = saved
+
+
+# ------------------------------------------------------- compile invariant --
+
+
+def test_kmeans_fit_compiles_one_distance_program():
+    # the distance.* counters tick once per TRACE: across 3 and then 8 Lloyd
+    # iterations of identical shape, the assign program is traced for the
+    # first fit only — no per-iteration (or per-fit) recompile
+    from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
+    from spark_rapids_ml_tpu.parallel import get_mesh
+
+    rng = np.random.default_rng(9)
+    # unique shape so no other test's cached program hides the first trace
+    X = jnp.asarray(rng.normal(size=(257, 13)))
+    w = jnp.ones((257,), X.dtype)
+    c0 = jnp.asarray(rng.normal(size=(6, 13)))
+    telemetry.enable()
+    try:
+        telemetry.registry().reset()
+        kmeans_fit(X, w, c0, mesh=get_mesh(1), max_iter=3, tol=0.0)
+        first = telemetry.snapshot()["counters"].get("distance.assign_programs", 0)
+        assert first > 0  # the fit really went through the shared core
+        kmeans_fit(X, w, c0, mesh=get_mesh(1), max_iter=8, tol=0.0)
+        second = telemetry.snapshot()["counters"].get("distance.assign_programs", 0)
+        assert second == first  # 8 iterations + a second fit: zero retraces
+    finally:
+        telemetry.registry().reset()
+        telemetry.disable()
+
+
+def test_kernel_mode_probe_is_jnp_on_cpu(monkeypatch):
+    monkeypatch.delenv("SRML_DISTANCE_KERNEL", raising=False)
+    saved = distance._MODE
+    distance._MODE = None
+    try:
+        assert distance.kernel_mode() == "jnp"  # CPU backend -> fallback
+    finally:
+        distance._MODE = saved
+
+
+def test_kernel_mode_env_override(monkeypatch):
+    saved = distance._MODE
+    try:
+        monkeypatch.setenv("SRML_DISTANCE_KERNEL", "interpret")
+        distance._MODE = None
+        assert distance.kernel_mode() == "interpret"
+        # explicit `pallas` really FORCES the kernel path (no silent
+        # self-test fallback — docs/configuration.md contract)
+        monkeypatch.setenv("SRML_DISTANCE_KERNEL", "pallas")
+        distance._MODE = None
+        assert distance.kernel_mode() == "pallas"
+    finally:
+        distance._MODE = saved
+
+
+def test_plan_blocks_fits_budget_and_floors():
+    br, bk = distance.plan_blocks(4096, 1000, 3000, 4)
+    assert (br * 3000 + bk * 3000 + br * bk) * 4 <= distance._VMEM_BUDGET_BYTES
+    assert br >= 8 and bk >= 128
+    # absurd depth: nothing fits -> None (callers fall back to jnp)
+    assert distance.plan_blocks(4096, 1000, 50_000_000, 4) is None

@@ -122,11 +122,31 @@ def test_pca_workspace_terms_analytic():
 def test_kmeans_workspace_terms_analytic():
     est = KMeans(k=5, float32_inputs=False)
     terms = est._solver_workspace_terms(125, 12, dict(est._solver_params), 8)
-    # b = min(max_samples_per_batch, rows_dev) = 125
-    assert terms == {"tile_buffers": 2 * 125 * 5 * 8, "centers": 2 * 5 * 12 * 8}
-    # huge shard: the tile cap kicks in at max_samples_per_batch
+    # b = min(max_samples_per_batch, rows_dev) = 125; the predict-side
+    # assignment tile is min(distance_tile_rows, rows_dev) = 125 rows
+    assert terms == {
+        "tile_buffers": 2 * 125 * 5 * 8,
+        "centers": 2 * 5 * 12 * 8,
+        "predict_tile": 125 * 5 * 8,
+    }
+    # huge shard: the fit tile caps at max_samples_per_batch, the predict
+    # tile at config["distance_tile_rows"] (default 4096)
     terms = est._solver_workspace_terms(10**6, 12, dict(est._solver_params), 8)
     assert terms["tile_buffers"] == 2 * 32768 * 5 * 8
+    assert terms["predict_tile"] == 4096 * 5 * 8
+
+
+def test_kmeans_predict_tile_term_tracks_config():
+    # the predict-side term follows the distance_tile_rows knob — the
+    # admission estimate and the transform-path tiling cannot drift apart
+    saved = core_mod.config["distance_tile_rows"]
+    core_mod.config["distance_tile_rows"] = 512
+    try:
+        est = KMeans(k=5, float32_inputs=False)
+        terms = est._solver_workspace_terms(10**6, 12, dict(est._solver_params), 8)
+        assert terms["predict_tile"] == 512 * 5 * 8
+    finally:
+        core_mod.config["distance_tile_rows"] = saved
 
 
 def test_logistic_workspace_terms_analytic():
