@@ -1,0 +1,155 @@
+#
+# Serving-plane benchmark: p50/p99 request latency + QPS through the resident
+# scoring service (docs/serving.md) — the FIRST lane that measures serve, not
+# fit. Joins bench.py's gated geomean (per-lane trajectory gating from
+# benchmark/regression.py; the p99 latency additionally gates as a
+# lower-is-better lane).
+#
+# Shape: a KMeans model (constructed directly from synthetic centers — the
+# lane measures the serving plane, not a fit) is loaded into a ModelRegistry
+# (admission + ladder prewarm), then `concurrency` client threads fire
+# `n_requests` mixed-size predict requests through one ScoringEngine. Per
+# request we record end-to-end latency; the lane value is rows scored per
+# second (the serve-side analog of the fit lanes' rows/sec normalization).
+#
+# The lane doubles as a LIVE correctness canary: every coalesced response is
+# compared against the same request served solo (`_transform_arrays`) and the
+# max abs difference is reported — 0.0 is the bit-identity acceptance
+# criterion (assignments are integers, so any drift is a real bug, not
+# rounding).
+#
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .base import BenchmarkBase
+
+
+def run_serving_bench(
+    n_cols: int = 256,
+    k: int = 256,
+    *,
+    n_requests: int = 256,
+    concurrency: int = 8,
+    request_rows: tuple = (1, 16, 128, 512),
+    coalesce_window_ms: float = 2.0,
+    serve_dtype: str = "",
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """One serving-lane run; returns QPS, rows/sec, p50/p99 latency (ms),
+    coalescing counters, and the solo-vs-coalesced max abs diff. Shared by
+    the BenchmarkBase lane below and bench.py's `serving` geomean lane."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from spark_rapids_ml_tpu import core, telemetry
+    from spark_rapids_ml_tpu.models.clustering import KMeansModel
+    from spark_rapids_ml_tpu.serving import ModelRegistry, ScoringEngine
+
+    rng = np.random.default_rng(seed)
+    centers = (rng.standard_normal((k, n_cols)) * 4.0).astype(np.float32)
+    model = KMeansModel(cluster_centers_=centers, n_cols=n_cols, dtype="float32")
+
+    telemetry.enable()
+    saved = core.config["serve_coalesce_window_ms"]
+    core.config["serve_coalesce_window_ms"] = float(coalesce_window_ms)
+    mark = telemetry.registry().mark()
+    try:
+        registry = ModelRegistry()
+        t0 = time.perf_counter()
+        entry = registry.load(
+            "bench", model, serve_dtype=serve_dtype or None
+        )
+        load_s = time.perf_counter() - t0
+
+        requests: List[np.ndarray] = [
+            rng.standard_normal(
+                (int(request_rows[i % len(request_rows)]), n_cols)
+            ).astype(np.float32)
+            for i in range(n_requests)
+        ]
+        # solo reference OUTSIDE the timed window (the bit-identity canary)
+        solo = [np.asarray(model._transform_arrays(q)) for q in requests]
+
+        latencies = np.zeros(n_requests)
+        responses: List[Any] = [None] * n_requests
+
+        with ScoringEngine(registry) as engine:
+            # warm the dispatch path (programs are already prewarmed at load)
+            engine.score("bench", requests[0])
+
+            def one(i: int) -> None:
+                t = time.perf_counter()
+                responses[i] = engine.score("bench", requests[i], timeout=120)
+                latencies[i] = time.perf_counter() - t
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=concurrency) as pool:
+                list(pool.map(one, range(n_requests)))
+            wall = time.perf_counter() - t0
+
+        max_abs_diff = max(
+            float(np.max(np.abs(np.asarray(r) - s))) if s.size else 0.0
+            for r, s in zip(responses, solo)
+        )
+    finally:
+        core.config["serve_coalesce_window_ms"] = saved
+
+    delta = telemetry.registry().delta(mark)
+    counters = delta.get("counters", {})
+    total_rows = int(sum(q.shape[0] for q in requests))
+    return {
+        "fit": wall,  # BenchmarkBase's timing key
+        "load_s": load_s,
+        "qps": n_requests / wall,
+        "rows_per_sec": total_rows / wall,
+        "p50_ms": float(np.percentile(latencies, 50) * 1e3),
+        "p99_ms": float(np.percentile(latencies, 99) * 1e3),
+        "max_abs_diff": max_abs_diff,
+        "requests": float(n_requests),
+        "total_rows": float(total_rows),
+        "coalesced_batches": float(counters.get("serve.coalesced_batches", 0.0)),
+        "batches": float(counters.get("serve.batches", 0.0)),
+        "bucket_hits": float(counters.get("serve.bucket_hits", 0.0)),
+        "prewarmed_programs": float(entry.prewarmed_rungs),
+    }
+
+
+class BenchmarkServing(BenchmarkBase):
+    name = "serving"
+    extra_args = {
+        "k": (int, 256, "resident KMeans model's center count"),
+        "n_requests": (int, 256, "scoring requests fired through the engine"),
+        "concurrency": (int, 8, "client threads"),
+        "coalesce_window_ms": (float, 2.0, "engine coalesce window"),
+        "serve_dtype": (str, "", "per-model serving dtype ('' = fit dtype, 'bf16' = distance-core fast path)"),
+    }
+
+    def gen_dataset(self, args, mesh) -> Dict[str, Any]:
+        # the model and requests are generated inside run_serving_bench: the
+        # lane measures load+score through the serving plane end to end
+        return {}
+
+    def run_once(self, args, data, mesh) -> Dict[str, float]:
+        out = run_serving_bench(
+            n_cols=args.num_cols,
+            k=args.k,
+            n_requests=args.n_requests,
+            concurrency=args.concurrency,
+            coalesce_window_ms=args.coalesce_window_ms,
+            serve_dtype=args.serve_dtype,
+            seed=args.seed,
+        )
+        data["counters"] = {key: v for key, v in out.items() if key != "fit"}
+        return {"fit": out["fit"]}
+
+    def quality(self, args, data) -> Dict[str, float]:
+        # qps/p50/p99/max_abs_diff: the lane's acceptance numbers
+        # (max_abs_diff == 0 is the coalesce bit-identity criterion)
+        return data.get("counters", {})
+
+
+if __name__ == "__main__":
+    BenchmarkServing().run()

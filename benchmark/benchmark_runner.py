@@ -24,6 +24,7 @@ from .bench_nearest_neighbors import BenchmarkNearestNeighbors
 from .bench_oocore import BenchmarkOOCore
 from .bench_pca import BenchmarkPCA
 from .bench_random_forest import BenchmarkRandomForest
+from .bench_serving import BenchmarkServing
 from .bench_umap import BenchmarkUMAP
 from .utils import log
 
@@ -31,6 +32,7 @@ ALGORITHMS = {
     "cv": BenchmarkCV,
     "ingest": BenchmarkIngest,
     "oocore": BenchmarkOOCore,
+    "serving": BenchmarkServing,
     "pca": BenchmarkPCA,
     "kmeans": BenchmarkKMeans,
     "linear_regression": BenchmarkLinearRegression,

@@ -22,6 +22,12 @@
 #     (ingest/layout/placement/solve counts) are lower-is-better efficiency
 #     invariants: the newest run failing `current <= tolerance * reference`
 #     fails the lane even when wall time looks fine.
+#   * LATENCY LANES — records embedding `latency_lanes` (serving p50/p99 ms,
+#     added with the persistent serving plane) gate each value as a
+#     LOWER-IS-BETTER lane against the median of its own trajectory at
+#     `--max-latency-ratio` (default 1.5): a p99 blowup fails even when the
+#     throughput lanes hide it. Same trajectory-start rule as the per-algo
+#     wall lanes — the first artifact carrying a latency lane is skipped.
 #
 # Infra honesty: a run the tunnel killed (value 0.0 / INCOMPLETE) carries no
 # perf signal — those runs are excluded from the reference and, when the
@@ -119,6 +125,43 @@ def _lanes(rec: Dict[str, Any]) -> Dict[str, float]:
     return {}
 
 
+def _latency_lanes(rec: Dict[str, Any]) -> Dict[str, float]:
+    """Lower-is-better latency values embedded in the record
+    ("latency_lanes", added when the serving lane joined — p50/p99 ms).
+    Empty for older artifacts, which is how the gate knows a latency lane's
+    trajectory starts here."""
+    lanes = rec.get("latency_lanes")
+    if isinstance(lanes, dict):
+        return {k: float(v) for k, v in lanes.items()
+                if isinstance(v, (int, float)) and float(v) > 0.0}
+    return {}
+
+
+def _lower_better_lane(
+    name: str, kind: str, cur: float, ref: Optional[float], tolerance: float,
+    skip_note: str = "counter absent on one side",
+) -> Dict[str, Any]:
+    """One lower-is-better lane verdict — the counter-lane machinery,
+    generalized so latency lanes gate through the exact same rule
+    (`current <= tolerance * reference`)."""
+    if cur is None or ref is None or ref <= 0:
+        return {
+            "lane": name, "kind": kind, "status": "skipped",
+            "current": cur, "reference": ref, "note": skip_note,
+        }
+    ratio = cur / ref
+    return {
+        "lane": name,
+        "kind": kind,
+        "direction": "lower-better",
+        "current": cur,
+        "reference": ref,
+        "ratio": round(ratio, 4),
+        "threshold": tolerance,
+        "status": "pass" if ratio <= tolerance else "fail",
+    }
+
+
 def _geomean_lanes(rec: Dict[str, Any]) -> frozenset:
     """The lane names whose values entered the record's headline geomean —
     the COMPARABILITY key for the wall lane. bench.py embeds it explicitly
@@ -150,6 +193,7 @@ def run_gate(
     *,
     min_ratio: float = 0.8,
     counter_lanes: Optional[List[Tuple[str, float]]] = None,
+    max_latency_ratio: float = 1.5,
 ) -> Dict[str, Any]:
     """Compare `current` against the completed runs in `history`. Pure
     function of its inputs (the CLI wires files in); returns the verdict
@@ -246,6 +290,24 @@ def run_gate(
             "status": "pass" if ratio >= min_ratio else "fail",
         })
 
+    # -- latency lanes: p50/p99 upper bounds, lower is better --------------
+    # Same machinery as the counter lanes (`current <= tolerance * ref`),
+    # with the per-algo wall lanes' trajectory rule: each latency value
+    # gates against the median of ITS OWN history, and the first artifact
+    # carrying a lane starts that lane's trajectory (skipped).
+    cur_lat = _latency_lanes(current)
+    for lane_name in sorted(cur_lat):
+        refs = [
+            _latency_lanes(r)[lane_name]
+            for r in complete_hist
+            if lane_name in _latency_lanes(r)
+        ]
+        lanes.append(_lower_better_lane(
+            f"latency:{lane_name}", "latency", cur_lat[lane_name],
+            statistics.median(refs) if refs else None, max_latency_ratio,
+            skip_note="trajectory start: no historical run carries this lane",
+        ))
+
     # -- counter lanes: work-amount invariants, lower is better ------------
     # Reference = the NEWEST complete run that embedded a telemetry
     # snapshot, taken as one coherent set. Never assembled per-key across
@@ -259,26 +321,10 @@ def run_gate(
             ref_counters = _counters(r)
             break
     for name, tolerance in counter_lanes:
-        cur = cur_counters.get(name)
-        ref = ref_counters.get(name)
-        if cur is None or ref is None or ref <= 0:
-            lanes.append({
-                "lane": name, "kind": "counter", "status": "skipped",
-                "current": cur, "reference": ref,
-                "note": "counter absent on one side",
-            })
-            continue
-        ratio = cur / ref
-        lanes.append({
-            "lane": name,
-            "kind": "counter",
-            "direction": "lower-better",
-            "current": cur,
-            "reference": ref,
-            "ratio": round(ratio, 4),
-            "threshold": tolerance,
-            "status": "pass" if ratio <= tolerance else "fail",
-        })
+        lanes.append(_lower_better_lane(
+            name, "counter", cur_counters.get(name), ref_counters.get(name),
+            tolerance,
+        ))
 
     checked = [ln for ln in lanes if ln["status"] in ("pass", "fail")]
     failed = [ln for ln in lanes if ln["status"] == "fail"]
@@ -304,6 +350,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="wall lane: fail when current/reference drops below this")
     ap.add_argument("--counter-tolerance", type=float, default=None,
                     help="override every counter lane's tolerance ratio")
+    ap.add_argument("--max-latency-ratio", type=float, default=1.5,
+                    help="latency lanes: fail when current/reference exceeds this")
     ap.add_argument("--report-only", action="store_true",
                     help="always exit 0 (CI report lane); the verdict JSON still says fail")
     ap.add_argument("--out", default=None, help="also write the verdict JSON here")
@@ -330,6 +378,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         [load_bench_record(p) for p in history_paths],
         min_ratio=args.min_ratio,
         counter_lanes=lanes,
+        max_latency_ratio=args.max_latency_ratio,
     )
     verdict["current_artifact"] = os.path.basename(current_path)
     verdict["history_artifacts"] = [os.path.basename(p) for p in history_paths]

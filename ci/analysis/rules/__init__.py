@@ -18,6 +18,7 @@ from .memstats import MemStatsRule
 from .padrows import PadRowsRule
 from .purity import TracedImpurityRule
 from .registries import ConfigKeyRule, MetricNameRule
+from .serving import ServeDispatchRule
 from .sleeps import SleepRule
 from .spmd import SpmdDivergenceRule
 from .timing import PerfCounterRule
@@ -38,6 +39,7 @@ def default_rules() -> List[RuleBase]:
         HostSyncRule(),
         TracedImpurityRule(),
         RawDistanceRule(),
+        ServeDispatchRule(),
         ConfigKeyRule(),
         MetricNameRule(),
     ]
@@ -61,6 +63,7 @@ __all__ = [
     "HostSyncRule",
     "TracedImpurityRule",
     "RawDistanceRule",
+    "ServeDispatchRule",
     "ConfigKeyRule",
     "MetricNameRule",
 ]

@@ -26,6 +26,7 @@ KNOWN_WAIVER_TAGS = {
     "config",
     "metric",
     "distance",
+    "serve",
 }
 
 

@@ -1,0 +1,64 @@
+#
+# serve-dispatch: the serving plane's async contract, CI-enforced
+# (docs/serving.md "Async dispatch"). Inside `spark_rapids_ml_tpu/serving/`,
+# predict work must flow through a model's resident `core.PredictProgram`
+# (dispatch = pad + run, NO host fetch) and block exactly once — at the
+# engine's response-assembly point. A stray `jax.jit` mints a second program
+# cache the prewarm ladder never warmed; a stray `block_until_ready` /
+# `device_get` turns async micro-batching back into the reference's
+# synchronous per-batch dispatch. Both are findings anywhere in serving/;
+# the ONE sanctioned assembly point carries `# serve-ok: <reason>`, and the
+# baseline stays empty.
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase, dotted
+
+_BLOCKED_CALLS = {"jax.jit", "jax.block_until_ready", "jax.device_get"}
+
+
+class ServeDispatchRule(RuleBase):
+    id = "serve-dispatch"
+    waiver = "serve"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    description = (
+        "direct jit/block_until_ready/device_get inside serving/ outside the "
+        "engine's waived dispatch point"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.relpath.startswith("spark_rapids_ml_tpu/serving/")
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = dotted(func, ctx.imports)
+            if name in _BLOCKED_CALLS:
+                what = name.split(".", 1)[1]
+                ctx.emit(
+                    self,
+                    node,
+                    f"direct `{what}` in serving/ — predict dispatch flows "
+                    "through the model's resident PredictProgram and blocks "
+                    "only at the engine's response-assembly point; mark the "
+                    "one sanctioned site `# serve-ok: <reason>` "
+                    "(docs/serving.md)",
+                )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "block_until_ready"
+            ):
+                # the Array METHOD form (`result.block_until_ready()`) — the
+                # receiver is a local value, but the method name is
+                # unambiguous in jax code
+                ctx.emit(
+                    self,
+                    node,
+                    "direct `.block_until_ready()` in serving/ — the engine's "
+                    "response-assembly point is the one sanctioned sync "
+                    "(`# serve-ok: <reason>`, docs/serving.md)",
+                )

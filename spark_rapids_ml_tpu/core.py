@@ -128,6 +128,18 @@ config: Dict[str, Any] = {
     # evicted beyond this, so a scope wrapped around a loop over FRESH
     # dataset objects cannot stack placements until HBM OOMs
     "device_dataset_cache_entries": 2,
+    # --- serving plane (docs/serving.md) ---------------------------------
+    # how long the ScoringEngine holds a dispatched request open for
+    # same-model coalescing (micro-batching up the bucket ladder): the
+    # latency/throughput knob — 0 disables coalescing entirely
+    "serve_coalesce_window_ms": 2.0,
+    # row cap of one coalesced serving batch (and of a resident model's
+    # PredictProgram bucket ladder); larger requests split across dispatches
+    "serve_max_batch_rows": 8192,
+    # model-load prewarm: every bucket-ladder rung up to this many rows is
+    # compiled (through the persistent compile cache) AT LOAD TIME, so a
+    # resident model's first query is compile-free; 0 disables prewarm
+    "serve_prewarm_rows": 4096,
     # --- distributed diagnostics (docs/observability.md) -----------------
     # directory for flight-recorder dumps (`flightrec_rank_<r>.jsonl`) on
     # SrmlError / abort publication; seeded from SRML_FLIGHTREC_DIR. None ->
@@ -1408,6 +1420,10 @@ class _TpuModel(_TpuCommon):
         # per-fit telemetry delta (counters/spans/gauges captured during the
         # fit that produced this model); {} when telemetry was disabled
         self._fit_metrics: Dict[str, Any] = {}
+        # serving-plane state stamped by serving.ModelRegistry (docs/serving.md):
+        # the admission verdict that loaded (or refused/evicted) this model,
+        # mirroring the fit-side _fit_metrics["admission"] stamp
+        self._serve_metrics: Dict[str, Any] = {}
 
     @property
     def hasSummary(self) -> bool:
@@ -1425,6 +1441,99 @@ class _TpuModel(_TpuCommon):
 
     def _combine(self, models: List["_TpuModel"]) -> "_TpuModel":
         raise NotImplementedError
+
+    # serving hooks (docs/serving.md) -------------------------------------
+    # The per-estimator surface the serving plane composes: a resident
+    # PredictProgram factory, plus the placement / per-bucket workspace byte
+    # terms the admission budgeter (memory.admit_model_load) charges — the
+    # serve-side analog of the fit-side `_solver_workspace_terms` hook.
+
+    # serving dtypes this model accepts; the distance-core models extend
+    # with "bf16" (their fast-bf16 scoring is parity-tested)
+    _serve_dtypes: tuple = (None, "float32", "float64")
+
+    def _serve_program(
+        self, serve_dtype: Optional[str] = None, *, cap: Optional[int] = None
+    ) -> "PredictProgram":
+        """Resident predict handle for the serving plane. Models without a
+        batched predict surface (DBSCAN's fused fit-transform, UMAP's
+        fit-embedding) have nothing to keep resident."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no serving hook (no batched predict "
+            "surface to keep resident)"
+        )
+
+    def _serve_check(self, serve_dtype: Optional[str] = None) -> None:
+        """Cheap serveability preflight: raises exactly what `_serve_program`
+        would, WITHOUT placing anything on device. The registry runs this
+        before its admission/eviction loop, so a load that can never succeed
+        (no hook, bad serve_dtype, unbound item set) cannot evict resident
+        models as a side effect."""
+        if type(self)._serve_program is _TpuModel._serve_program:
+            self._serve_program(serve_dtype)  # the standard NotImplementedError
+        if serve_dtype not in self._serve_dtypes:
+            raise ValueError(
+                f"{type(self).__name__} serves at its fit dtype; "
+                f"serve_dtype={serve_dtype!r} is only available on the "
+                "distance-core models (docs/serving.md)"
+            )
+        self._serve_n_cols()
+
+    def _serve_n_cols(self) -> int:
+        """Feature width the serving plane prewarms/validates against."""
+        n = int(getattr(self, "n_cols", 0) or 0)
+        if n <= 0:
+            raise ValueError(
+                f"{type(self).__name__} does not know its feature width; "
+                "cannot prewarm the serving ladder"
+            )
+        return n
+
+    def _serve_placement_terms(self) -> Dict[str, int]:
+        """Per-device HBM bytes of this model's RESIDENT state (the arrays
+        `construct()` places), as named terms for the admission budgeter.
+        Default: every array model attribute at the serving working dtype —
+        model state is replicated, so per-device cost is the full size."""
+        itemsize = 4 if self._float32_inputs else 8
+        total = 0
+        for v in self._model_attributes.values():
+            if isinstance(v, np.ndarray):
+                total += int(v.size) * itemsize
+            elif isinstance(v, (list, tuple)) and v and isinstance(v[0], np.ndarray):
+                total += sum(int(a.size) for a in v) * itemsize
+        return {"placement.params": total}
+
+    def _serve_workspace_terms(
+        self, bucket_rows_count: int, itemsize: int
+    ) -> Dict[str, int]:
+        """Per-bucket predict workspace estimate: bytes live during ONE
+        dispatched batch of `bucket_rows_count` rows beyond the model state
+        and the input block itself. {} (default) = no modeled workspace."""
+        return {}
+
+    def _record_bucket(self, xp: np.ndarray, n_valid: int, on_mesh: bool) -> None:
+        """Bucket-ladder telemetry: rows padded, and — via a process-wide set
+        of (model class, bucketed shape, dtype, placement) signatures — a
+        `transform.bucket_programs` counter that advances only when a NEW
+        bucketed shape reaches `predict`. The shape set deliberately
+        survives `registry().reset()`: it mirrors the process-wide jit
+        cache, which a registry reset does not clear — a shape seen before
+        genuinely compiles nothing, so re-counting it would overstate
+        compile work. Readers wanting per-window numbers take counter
+        DELTAS. Asserting the counter stays at the ladder size while batch
+        sizes vary freely is the test-side proof that serving compiles per
+        bucket, not per tail shape."""
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return
+        reg = telemetry.registry()
+        reg.inc("transform.bucket_pad_rows", int(xp.shape[0]) - int(n_valid))
+        sig = (type(self).__name__, tuple(xp.shape), str(xp.dtype), on_mesh)
+        with _BUCKET_LOCK:
+            if sig not in _BUCKET_SHAPES:
+                _BUCKET_SHAPES.add(sig)
+                reg.inc("transform.bucket_programs")
 
     # Spark JVM interop: name of the `spark_interop` converter for this model
     # class (None = the reference has no `.cpu()` for it either)
@@ -1464,9 +1573,124 @@ class _TpuModel(_TpuCommon):
 
 
 # Process-wide record of bucketed shapes already handed to a `predict`
-# program (see `_TpuModelWithColumns._record_bucket`).
+# program (see `_TpuModel._record_bucket`).
 _BUCKET_LOCK = threading.Lock()
 _BUCKET_SHAPES: set = set()
+
+
+class PredictProgram:
+    """Resident, reusable predict handle — the internals of
+    `_TpuModelWithColumns._transform_arrays` (construct the device state once,
+    bucket-pad every batch up the geometric ladder, run the jitted `predict`,
+    slice outputs back) exposed as ONE object with a lifetime.
+
+    Two consumers share it so they cannot drift: `_transform_arrays` builds a
+    short-lived one per transform call, and the serving plane
+    (`spark_rapids_ml_tpu/serving/`, docs/serving.md) holds one per RESIDENT
+    model for the model's whole registry lifetime — which is what makes a
+    long-lived scoring service compile-free after load-time prewarm.
+
+    The async contract (enforced by the ci/analysis `serve-dispatch` rule):
+
+      * `dispatch(xb)` pads a host batch UP the bucket ladder
+        (`mesh.bucket_rows`) and runs `predict` WITHOUT any host fetch — the
+        returned device arrays are in flight when it returns;
+      * `fetch(result, n_valid)` is the one device→host sync point, slicing
+        every output back to the valid rows;
+      * `prewarm(...)` dispatches zeros through every ladder rung (through
+        the persistent compile cache, `mesh.ensure_compilation_cache`) so a
+        resident model's first query pays dispatch, never compile.
+    """
+
+    def __init__(
+        self,
+        model: "_TpuModel",
+        *,
+        construct: Optional[Callable[[], Any]] = None,
+        predict: Optional[Callable[[Any, Any], Any]] = None,
+        cap: Optional[int] = None,
+        mesh: Any = None,
+    ) -> None:
+        import jax
+
+        from .parallel.mesh import replicated
+
+        if construct is None or predict is None:
+            c0, p0, _ = model._get_transform_func()
+            construct = construct or c0
+            predict = predict or p0
+        self.model = model
+        self.predict_fn = predict
+        self.mesh = mesh
+        self.multiple = int(mesh.devices.size) if mesh is not None else 1
+        self.cap = int(cap) if cap else int(config["max_records_per_batch"]) * self.multiple
+        self.bucket_min = int(config["transform_bucket_min_rows"])
+        self.dtype = np.float32 if model._float32_inputs else np.float64
+        state = construct()
+        if mesh is not None:
+            state = jax.tree.map(
+                lambda a: jax.device_put(a, replicated(mesh))
+                if isinstance(a, (np.ndarray, jax.Array))
+                else a,
+                state,
+            )
+        self.state = state
+        # per-program record of bucketed shapes already dispatched — what the
+        # serving engine's `serve.bucket_hits` counter reads (independent of
+        # the telemetry-gated process-wide `transform.bucket_programs` set)
+        self._shapes_seen: set = set()
+        self.last_dispatch_new_shape: bool = False
+
+    def ladder(self, max_rows: Optional[int] = None) -> List[int]:
+        """The rung sizes (rows) batches of 1..max_rows pad up to — exactly
+        what `prewarm` compiles (`mesh.bucket_ladder`)."""
+        from .parallel.mesh import bucket_ladder
+
+        return bucket_ladder(
+            min(int(max_rows), self.cap) if max_rows else self.cap,
+            multiple=self.multiple,
+            min_rows=self.bucket_min,
+            cap=self.cap,
+        )
+
+    def dispatch(self, xb: np.ndarray) -> Tuple[Any, int]:
+        """Pad one host batch up its bucket rung and run `predict` — NO host
+        fetch; returns (in-flight result, valid row count). A zero-row batch
+        still dispatches one bucket-padded rung so multi-output models yield
+        one correctly-shaped empty array per output at `fetch`."""
+        import jax
+
+        from .parallel.mesh import bucket_rows, row_sharding
+
+        xb = np.asarray(xb)
+        xp, n_valid = bucket_rows(
+            xb, multiple=self.multiple, min_rows=self.bucket_min, cap=self.cap
+        )
+        self.model._record_bucket(xp, n_valid, self.mesh is not None)
+        sig = (tuple(xp.shape), str(xp.dtype))
+        self.last_dispatch_new_shape = sig not in self._shapes_seen
+        self._shapes_seen.add(sig)
+        if self.mesh is not None:
+            xp = jax.device_put(xp, row_sharding(self.mesh, xp.ndim))
+        return self.predict_fn(self.state, xp), n_valid
+
+    def fetch(self, result: Any, n_valid: int) -> Any:
+        """THE device→host sync point: materialize the in-flight result and
+        slice every output back to the valid rows."""
+        if isinstance(result, tuple):
+            return tuple(np.asarray(r)[:n_valid] for r in result)
+        return np.asarray(result)[:n_valid]
+
+    def prewarm(self, n_cols: int, *, max_rows: Optional[int] = None) -> int:
+        """Compile every ladder rung up to `max_rows` rows by dispatching a
+        zeros batch per rung and blocking on it (the compile must complete at
+        LOAD time, not at the first query). With a persistent compile cache
+        configured the programs come off disk. Returns the rung count."""
+        rungs = self.ladder(max_rows)
+        for r in rungs:
+            result, _ = self.dispatch(np.zeros((r, int(n_cols)), dtype=self.dtype))
+            self.fetch(result, 0)
+        return len(rungs)
 
 
 class _TpuModelWithColumns(_TpuModel):
@@ -1481,6 +1705,17 @@ class _TpuModelWithColumns(_TpuModel):
     @abstractmethod
     def _get_transform_func(self) -> TransformFuncs:
         raise NotImplementedError
+
+    def _serve_program(
+        self, serve_dtype: Optional[str] = None, *, cap: Optional[int] = None
+    ) -> PredictProgram:
+        """Default serving hook: the model's own (construct, predict) pair as
+        a resident PredictProgram. `serve_dtype` outside `_serve_dtypes` is
+        rejected — the bf16 query path exists only on the distance-core
+        models (KMeansModel, NearestNeighborsModel), whose fast-bf16 scoring
+        is parity-tested in ops/distance.py (docs/serving.md "bf16 serving")."""
+        self._serve_check(serve_dtype)
+        return PredictProgram(self, cap=cap)
 
     def _out_column_names(self) -> List[str]:
         """Names of appended columns; single-entry list for plain predictors."""
@@ -1499,6 +1734,10 @@ class _TpuModelWithColumns(_TpuModel):
         process restarts). `predict` is row-parallel by contract, so padding
         rows cannot influence valid rows' outputs.
 
+        The pad/dispatch/slice mechanics live in `PredictProgram` — the same
+        handle the serving plane keeps resident per model (docs/serving.md) —
+        so batch transform and long-lived serving cannot drift.
+
         Small blocks run on one device (the reference's one-task-per-batch
         pandas_udf shape). At ``config["distributed_transform_min_rows"]`` rows
         and up, each batch is row-sharded over the full mesh with the model
@@ -1509,13 +1748,10 @@ class _TpuModelWithColumns(_TpuModel):
 
         from . import telemetry
         from .parallel.mesh import (
-            bucket_rows,
             default_devices,
             dtype_scope,
             ensure_compilation_cache,
             get_mesh,
-            replicated,
-            row_sharding,
         )
 
         ensure_compilation_cache()
@@ -1524,11 +1760,8 @@ class _TpuModelWithColumns(_TpuModel):
         ), dtype_scope(
             np.float32 if self._float32_inputs else np.float64, self._matmul_precision
         ):
-            construct, predict, _ = self._get_transform_func()
-            state = construct()
             n = features.shape[0]
             batch = int(config["max_records_per_batch"])
-            bucket_min = int(config["transform_bucket_min_rows"])
             n_dev = min(self.num_workers, len(default_devices()))
             # multi-process SPMD transforms rank-LOCAL batches: stay on local
             # devices (sharding a local batch over the global mesh would mix
@@ -1541,13 +1774,8 @@ class _TpuModelWithColumns(_TpuModel):
             mesh = None
             if use_mesh:
                 mesh = get_mesh(n_dev)
-                state = jax.tree.map(
-                    lambda a: jax.device_put(a, replicated(mesh))
-                    if isinstance(a, (np.ndarray, jax.Array))
-                    else a,
-                    state,
-                )
                 batch *= n_dev  # per-device batch budget stays constant
+            program = PredictProgram(self, cap=batch, mesh=mesh)
             if telemetry.enabled():
                 reg = telemetry.registry()
                 reg.inc("transform.rows", n)
@@ -1563,47 +1791,11 @@ class _TpuModelWithColumns(_TpuModel):
                 xb = features[start:stop]
                 if hasattr(xb, "todense"):
                     xb = np.asarray(xb.todense())
-                xp, n_valid = bucket_rows(
-                    np.asarray(xb),
-                    multiple=n_dev if mesh is not None else 1,
-                    min_rows=bucket_min,
-                    cap=batch,
-                )
-                self._record_bucket(xp, n_valid, mesh is not None)
-                if mesh is not None:
-                    xp = jax.device_put(xp, row_sharding(mesh, xp.ndim))
-                result = predict(state, xp)
-                if isinstance(result, tuple):
-                    outs.append(tuple(np.asarray(r)[:n_valid] for r in result))
-                else:
-                    outs.append(np.asarray(result)[:n_valid])
+                result, n_valid = program.dispatch(np.asarray(xb))
+                outs.append(program.fetch(result, n_valid))
             if isinstance(outs[0], tuple):
                 return tuple(np.concatenate(parts, axis=0) for parts in zip(*outs))
             return np.concatenate(outs, axis=0)
-
-    def _record_bucket(self, xp: np.ndarray, n_valid: int, on_mesh: bool) -> None:
-        """Bucket-ladder telemetry: rows padded, and — via a process-wide set
-        of (model class, bucketed shape, dtype, placement) signatures — a
-        `transform.bucket_programs` counter that advances only when a NEW
-        bucketed shape reaches `predict`. The shape set deliberately
-        survives `registry().reset()`: it mirrors the process-wide jit
-        cache, which a registry reset does not clear — a shape seen before
-        genuinely compiles nothing, so re-counting it would overstate
-        compile work. Readers wanting per-window numbers take counter
-        DELTAS. Asserting the counter stays at the ladder size while batch
-        sizes vary freely is the test-side proof that serving compiles per
-        bucket, not per tail shape."""
-        from . import telemetry
-
-        if not telemetry.enabled():
-            return
-        reg = telemetry.registry()
-        reg.inc("transform.bucket_pad_rows", int(xp.shape[0]) - int(n_valid))
-        sig = (type(self).__name__, tuple(xp.shape), str(xp.dtype), on_mesh)
-        with _BUCKET_LOCK:
-            if sig not in _BUCKET_SHAPES:
-                _BUCKET_SHAPES.add(sig)
-                reg.inc("transform.bucket_programs")
 
     def transform(self, dataset: Any):
         pdf = as_pandas(dataset)

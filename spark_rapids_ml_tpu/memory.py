@@ -219,14 +219,16 @@ def streaming_estimate(
     return est
 
 
-def device_capacity_bytes(mesh: Any = None) -> Optional[int]:
+def device_capacity_bytes(mesh: Any = None, devices: Any = None) -> Optional[int]:
     """Per-device HBM capacity the admission check budgets against.
 
     Resolution order: chaos-injected budget (`oom:budget=` fault — the
     shrunken-budget injection that makes the whole demotion ladder testable
     without a real TPU) > ``config["hbm_budget_bytes"]`` > the minimum
-    ``Device.memory_stats()['bytes_limit']`` over the mesh devices. Returns
-    None when nothing is known (CPU backend, no override) — no budgeting."""
+    ``Device.memory_stats()['bytes_limit']`` over the mesh devices (or the
+    explicit `devices` list — the serving plane budgets its one local device
+    without standing up a mesh). Returns None when nothing is known (CPU
+    backend, no override) — no budgeting."""
     from .core import config
     from .parallel import chaos
 
@@ -236,10 +238,12 @@ def device_capacity_bytes(mesh: Any = None) -> Optional[int]:
     override = config.get("hbm_budget_bytes")
     if override:
         return int(override)
-    if mesh is None:
-        return None
+    if devices is None:
+        if mesh is None:
+            return None
+        devices = list(mesh.devices.flatten())
     limit: Optional[int] = None
-    for d in mesh.devices.flatten():
+    for d in devices:
         try:
             stats = d.memory_stats()  # hbm-ok: memory.py is the budget owner
         except Exception:
@@ -396,6 +400,77 @@ def admit_fit(
         chunk_rows=int(chunk_rows),
         reason=reason,
         demoted=True,
+    )
+
+
+# ------------------------------------------------------- serving plane ------
+
+
+def model_serve_estimate(model: Any, bucket_rows_count: int) -> MemoryEstimate:
+    """Per-device working set of a RESIDENT serving model: the placement of
+    its state arrays (`_serve_placement_terms` — replicated, so per-device =
+    full size) plus the per-bucket predict workspace
+    (`_serve_workspace_terms` at the ladder cap), exactly the fit-side
+    placement + workspace split (module docstring)."""
+    dtype = np.float32 if getattr(model, "_float32_inputs", True) else np.float64
+    itemsize = int(np.dtype(dtype).itemsize)
+    terms: Dict[str, int] = {}
+    hook = getattr(model, "_serve_placement_terms", None)
+    for name, nbytes in ((hook() if hook is not None else None) or {}).items():
+        key = name if name.startswith("placement.") else f"placement.{name}"
+        terms[key] = int(nbytes)
+    whook = getattr(model, "_serve_workspace_terms", None)
+    raw = whook(int(bucket_rows_count), itemsize) if whook is not None else None
+    for name, nbytes in (raw or {}).items():
+        key = name if name.startswith("workspace.") else f"workspace.{name}"
+        terms[key] = int(nbytes)
+    return MemoryEstimate(terms)
+
+
+def admit_model_load(
+    model: Any,
+    *,
+    resident_bytes: int = 0,
+    bucket_rows_count: Optional[int] = None,
+    devices: Any = None,
+) -> AdmissionDecision:
+    """Admission verdict for loading a fitted model into the serving plane
+    (docs/serving.md): params get a placement estimate and a per-bucket
+    predict workspace term, exactly like fits. `resident_bytes` is what the
+    registry's already-resident models hold — the load is admitted against
+    the REMAINING budget. There is no streaming demotion for serving (a
+    model either resides or the load is refused typed), so the two verdicts
+    are RESIDENT or a raised `HbmBudgetError` naming the largest term; the
+    caller (serving.ModelRegistry) may evict LRU residents and retry."""
+    from . import telemetry
+    from .core import config
+
+    if bucket_rows_count is None:
+        bucket_rows_count = int(config.get("serve_max_batch_rows", 8192))
+    capacity = device_capacity_bytes(devices=devices)
+    budget = (
+        None if capacity is None else int(capacity * (1.0 - headroom_fraction()))
+    )
+    est = model_serve_estimate(model, bucket_rows_count)
+    if telemetry.enabled():
+        telemetry.registry().gauge("memory.serve_estimate_bytes", est.total())
+    if budget is None or est.total() + int(resident_bytes) <= budget:
+        return AdmissionDecision(
+            verdict=RESIDENT,
+            estimate=est,
+            capacity_bytes=capacity,
+            budget_bytes=budget,
+            reason="fits" if budget is not None else "no capacity information",
+        )
+    name, nbytes = est.largest()
+    raise HbmBudgetError(
+        f"{type(model).__name__} load does not fit the serving budget "
+        f"({int(resident_bytes)} bytes already resident)",
+        estimate_bytes=est.total(),
+        capacity_bytes=budget,
+        largest_term=name,
+        largest_term_bytes=nbytes,
+        terms=est.terms,
     )
 
 

@@ -682,6 +682,12 @@ class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
 
         return construct, predict, None
 
+    def _serve_workspace_terms(self, bucket_rows_count, itemsize):
+        # per-bucket predict workspace (docs/serving.md): the raw-margin and
+        # probability blocks logistic_predict materializes, [bucket, k] each
+        k_out = max(2, int(np.asarray(self.coef_).shape[0]))
+        return {"logits": 2 * int(bucket_rows_count) * k_out * itemsize}
+
     def _raw_prob(self, features) -> tuple:
         """Batched (raw, prob) arrays for a host feature block."""
         if np.isinf(self.intercept_).any():

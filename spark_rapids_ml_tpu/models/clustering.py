@@ -327,6 +327,46 @@ class KMeansModel(_KMeansParams, _TpuModelWithColumns):
 
         return construct, predict, None
 
+    # serving hooks (docs/serving.md) -------------------------------------
+
+    _serve_dtypes = (None, "float32", "float64", "bf16")
+
+    def _serve_program(self, serve_dtype=None, *, cap=None):
+        """KMeans serving hook: `serve_dtype="bf16"` routes assignment
+        through the distance core's parity-tested fast-bf16 mode (one-pass
+        bf16 MXU matmuls, f32 accumulation) — assignment flips only for
+        near-tied rows (docs/serving.md "bf16 serving" accuracy contract)."""
+        if serve_dtype != "bf16":
+            return super()._serve_program(serve_dtype, cap=cap)
+        self._serve_check(serve_dtype)
+        import jax
+
+        from ..core import PredictProgram
+        from ..ops.distance import argmin_assign
+        from ..parallel.mesh import default_local_device
+
+        centers = self.cluster_centers_
+        dtype = np.float32 if self._float32_inputs else np.float64
+
+        def construct():
+            return jax.device_put(centers.astype(dtype), default_local_device())
+
+        def predict(state, xb):
+            return argmin_assign(xb.astype(dtype), state, fast=True)
+
+        return PredictProgram(self, construct=construct, predict=predict, cap=cap)
+
+    def _serve_workspace_terms(self, bucket_rows_count, itemsize):
+        # the predict-side assignment tile: a [tile, k] distance block per
+        # dispatched bucket, row-tiled through the shared distance core at
+        # config["distance_tile_rows"] rows — the same term the fit-side
+        # budgeter charges as `predict_tile`
+        from ..ops.distance import tile_rows
+
+        k = int(self.cluster_centers_.shape[0])
+        tile = min(tile_rows(), max(1, int(bucket_rows_count)))
+        return {"predict_tile": tile * k * itemsize}
+
 
 class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol):
     """Param surface of the reference's DBSCAN (reference clustering.py:522-639):

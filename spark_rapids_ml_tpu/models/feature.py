@@ -231,3 +231,9 @@ class PCAModel(_PCAParams, _TpuModelWithColumns):
             return pca_transform(xb.astype(dtype), comps, ev, whiten=whiten)
 
         return construct, predict, None
+
+    def _serve_workspace_terms(self, bucket_rows_count, itemsize):
+        # per-bucket predict workspace (docs/serving.md): the [bucket, k]
+        # projection block
+        k = int(np.asarray(self.components_).shape[0])
+        return {"proj": int(bucket_rows_count) * k * itemsize}

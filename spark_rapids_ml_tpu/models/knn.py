@@ -349,6 +349,93 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
     def transform(self, dataset: Any):
         raise NotImplementedError("use kneighbors()/exactNearestNeighborsJoin() (reference parity)")
 
+    # serving hooks (docs/serving.md) -------------------------------------
+
+    _serve_dtypes = (None, "float32", "float64", "bf16")
+
+    def _serve_n_cols(self) -> int:
+        if self._item_extracted is None:
+            raise ValueError(
+                "NearestNeighborsModel is not bound to an item dataframe; "
+                "fit it before loading into the serving plane"
+            )
+        return int(self._item_extracted.n_cols)
+
+    def _serve_placement_terms(self) -> Dict[str, int]:
+        # the resident state is the ITEM BLOCK (plus its row norms and the
+        # int64 id map), not the tiny param surface
+        itemsize = 4 if self._float32_inputs else 8
+        n = int(self._item_extracted.n_rows) if self._item_extracted is not None else 0
+        d = self._serve_n_cols()
+        return {
+            "items": n * d * itemsize,
+            "item_sq": n * itemsize,
+            "item_ids": n * 8,
+        }
+
+    def _serve_workspace_terms(self, bucket_rows_count, itemsize) -> Dict[str, int]:
+        # the tiled top-k merge's live blocks per dispatched bucket: the
+        # [bucket, k_tile] distance block (VMEM-sized item tiles on the
+        # kernel path; the one-matmul [bucket, n] fallback on CPU/older
+        # jaxlibs) plus the [bucket, k] best-list carry x2 (d2 + index) —
+        # the distance core is exactly why no [bucket, n_items] block lands
+        # in HBM on the kernel path
+        from ..ops import distance as dist
+
+        n_items = int(self._item_extracted.n_rows) if self._item_extracted is not None else 0
+        k = int(self._solver_params["n_neighbors"])
+        b = max(1, int(bucket_rows_count))
+        if dist.kernel_mode() == "jnp":
+            k_tile = max(1, n_items)
+        else:
+            plan = dist.plan_blocks(b, max(1, n_items), self._serve_n_cols(), itemsize)
+            k_tile = max(plan[1], 128) if plan is not None else max(1, n_items)
+        return {
+            "topk_block": b * min(k_tile, max(1, n_items)) * itemsize,
+            "topk_carry": 2 * b * min(k, max(1, n_items)) * itemsize,
+        }
+
+    def _serve_program(self, serve_dtype=None, *, cap=None):
+        """kNN serving hook: queries route through the PR-10 tiled distance
+        core (`ops/distance.topk_tile`) so no `[batch, n_items]` distance
+        block lands in HBM on the kernel path. Returns per query row
+        (euclidean distances [B, k], USER item ids [B, k]) — the same values
+        `kneighbors`' knn_df carries. `serve_dtype="bf16"` scores through the
+        core's parity-tested fast-bf16 mode (docs/serving.md "bf16 serving")."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import PredictProgram
+        from ..ops import distance as dist
+        from ..parallel.mesh import default_local_device
+
+        self._serve_check(serve_dtype)  # dtype surface + bound item set
+        fast = serve_dtype == "bf16"
+        dtype = np.float32 if self._float32_inputs else np.float64
+        items = self._item_extracted.features
+        if hasattr(items, "todense"):
+            items = np.asarray(items.todense())
+        items_np = np.ascontiguousarray(np.asarray(items, dtype=dtype))
+        ids_np = np.asarray(
+            self._ensure_id(self._item_pdf, self._item_extracted), dtype=np.int64
+        )
+        k = min(int(self._solver_params["n_neighbors"]), items_np.shape[0])
+
+        def construct():
+            dev = default_local_device()
+            it = jax.device_put(items_np, dev)
+            return (it, dist.row_sq(it), jax.device_put(ids_np, dev))
+
+        @jax.jit
+        def predict(state, qb):
+            it, it_sq, ids = state
+            q = qb.astype(dtype)
+            d2, idx = dist.topk_tile(q, it, None, k, item_sq=it_sq, fast=fast)
+            d = jnp.sqrt(jnp.maximum(d2 + dist.row_sq(q)[:, None], 0.0))
+            return d, ids[idx]
+
+        return PredictProgram(self, construct=construct, predict=predict, cap=cap)
+
     def write(self):
         raise NotImplementedError("NearestNeighborsModel does not support saving (reference parity)")
 

@@ -491,3 +491,8 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
             return linear_predict(xb.astype(dtype), c, b)
 
         return construct, predict, None
+
+    def _serve_workspace_terms(self, bucket_rows_count, itemsize):
+        # per-bucket predict workspace (docs/serving.md): one prediction
+        # scalar per row
+        return {"pred": int(bucket_rows_count) * itemsize}

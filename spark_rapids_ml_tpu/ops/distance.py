@@ -528,26 +528,29 @@ def tile_assign_accumulate(
 
 
 def argmin_assign(
-    X: jax.Array, centers: jax.Array, *, batch_rows: Optional[int] = None
+    X: jax.Array, centers: jax.Array, *, batch_rows: Optional[int] = None,
+    fast: bool = False,
 ) -> jax.Array:
     """Nearest-center assignment over ALL rows, row-tiled through the core:
     int32 [n]. The predict-side entry (kmeans transform, k-means|| candidate
-    weighting, IVF/CAGRA anchor assignment) — an admission-approved fit must
-    not OOM at predict because the full [n, k] distance matrix materialized
-    (docs/performance.md "Tiled distance core"). Tiles are clamped back at
-    the ragged tail (overlap rows recompute the same assignment — writes are
-    idempotent), so no padded copy of X is ever made."""
+    weighting, IVF/CAGRA anchor assignment, the serving plane's bf16 query
+    path) — an admission-approved fit must not OOM at predict because the
+    full [n, k] distance matrix materialized (docs/performance.md "Tiled
+    distance core"). `fast` runs the distance matmuls in the parity-tested
+    fast-bf16 mode (docs/serving.md "bf16 serving"). Tiles are clamped back
+    at the ragged tail (overlap rows recompute the same assignment — writes
+    are idempotent), so no padded copy of X is ever made."""
     _note("distance.argmin_programs")
     n = X.shape[0]
     tr = min(batch_rows or tile_rows(), max(n, 1))
     if n <= tr:
-        return assign_argmin(X, centers)[1]
+        return assign_argmin(X, centers, fast=fast)[1]
     n_tiles = -(-n // tr)
 
     def body(i, out):
         s0 = jnp.minimum(i * tr, n - tr)
         xb = jax.lax.dynamic_slice_in_dim(X, s0, tr, 0)
-        a = assign_argmin(xb, centers)[1]
+        a = assign_argmin(xb, centers, fast=fast)[1]
         return jax.lax.dynamic_update_slice(out, a, (s0,))
 
     return jax.lax.fori_loop(0, n_tiles, body, jnp.zeros((n,), jnp.int32))

@@ -292,6 +292,29 @@ def bucket_size(n: int, *, multiple: int = 1, min_rows: int = 256, cap: Optional
     return b
 
 
+def bucket_ladder(
+    max_rows: int, *, multiple: int = 1, min_rows: int = 256, cap: Optional[int] = None
+) -> list:
+    """Every distinct rung `bucket_size` can return for batch sizes
+    1..max_rows — the set of predict-program shapes serving traffic in that
+    range can ever dispatch, and therefore exactly what the serving plane's
+    load-time prewarm compiles (docs/serving.md). Derived by WALKING
+    `bucket_size` itself (next probe = previous rung + 1), so the ladder can
+    never drift from the padding function that defines it."""
+    max_rows = max(1, int(max_rows))
+    rungs: list = []
+    n = 1
+    while True:  # blocking-ok: pure arithmetic walk — rungs strictly grow until max_rows/cap, no waiting
+        b = bucket_size(n, multiple=multiple, min_rows=min_rows, cap=cap)
+        if rungs and b <= rungs[-1]:
+            break  # the cap rung repeats for every larger n — ladder is done
+        rungs.append(b)
+        if b >= max_rows:
+            break
+        n = b + 1
+    return rungs
+
+
 def bucket_rows(
     x: np.ndarray, *, multiple: int = 1, min_rows: int = 256, cap: Optional[int] = None
 ) -> Tuple[np.ndarray, int]:

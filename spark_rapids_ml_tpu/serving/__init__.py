@@ -1,0 +1,34 @@
+#
+# The persistent serving plane: resident multi-model scoring (docs/serving.md).
+#
+# The reference's serving story (PAPER.md L5) re-enters Python and
+# re-dispatches a `pandas_udf` per query batch. This package composes what
+# the fit side already built — bucket-padded predict programs + the
+# persistent compile cache (PR 4), the HBM admission budgeter (PR 7), and
+# the tiled distance core (PR 10) — into a long-lived scoring service:
+#
+#   * `ModelRegistry` — many fitted models RESIDENT in HBM at once, each
+#     loaded under a `memory.admit_model_load` verdict (params placement +
+#     per-bucket predict workspace, exactly like fits; over-budget loads
+#     evict LRU residents or refuse typed with `HbmBudgetError`), with the
+#     bucket ladder's predict programs prewarmed at load time so the first
+#     query is compile-free;
+#   * `ScoringEngine` — concurrent predict requests, coalesced up the
+#     geometric bucket ladder inside a bounded window (micro-batching),
+#     dispatched async (`block_until_ready` only at response assembly), and
+#     sliced back out per request — bit-identical to serving each request
+#     solo.
+#
+# The async contract is CI-enforced: the ci/analysis `serve-dispatch` rule
+# forbids direct `jit`/`block_until_ready`/`device_get` in this package
+# outside the engine's one response-assembly point (`# serve-ok: <reason>`).
+#
+from .engine import ScoreFuture, ScoringEngine  # noqa: F401
+from .registry import ModelRegistry, ResidentModel  # noqa: F401
+
+__all__ = [
+    "ModelRegistry",
+    "ResidentModel",
+    "ScoringEngine",
+    "ScoreFuture",
+]

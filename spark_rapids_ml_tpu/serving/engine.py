@@ -1,0 +1,310 @@
+#
+# ScoringEngine: concurrent predict requests, micro-batched up the bucket
+# ladder, dispatched async (docs/serving.md "Scoring engine").
+#
+# The latency pipeline for one request:
+#
+#   submit() ──queue──▶ coalesce (bounded window, same-model requests merge
+#   into one block) ──▶ PredictProgram.dispatch per ≤cap chunk (pads up the
+#   geometric bucket ladder; NO host fetch — the device work is in flight)
+#   ──▶ response assembly: the ONE `block_until_ready` point ──▶ per-request
+#   output slices ──▶ futures resolve.
+#
+# Because `predict` is row-parallel by contract (the bucket-padding
+# invariant, core.PredictProgram), a coalesced batch's per-request slices are
+# bit-identical to serving each request solo — pinned by
+# tests/test_serving.py and measured live by benchmark/bench_serving.py.
+#
+# Telemetry (docs/observability.md "Serving plane"): serve.requests/rows/
+# batches, serve.coalesced_batches/coalesced_requests, serve.bucket_hits,
+# and the serve.queue_wait_s / serve.e2e_s latency histograms.
+#
+# The async contract is CI-enforced (ci/analysis `serve-dispatch`): no
+# direct jit/block_until_ready in this package outside the waived assembly
+# point below.
+#
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..utils import get_logger
+from .registry import ModelRegistry
+
+
+class ScoreFuture:
+    """Handle for one in-flight scoring request."""
+
+    __slots__ = ("name", "features", "_event", "_result", "_error", "t_submit")
+
+    def __init__(self, name: str, features: np.ndarray, t_submit: float) -> None:
+        self.name = name
+        self.features = features
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = t_submit
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = 30.0) -> Any:
+        """Block until the response is assembled. Returns the per-algo predict
+        output for THIS request's rows (array, or tuple of arrays for
+        multi-output models). Raises the scoring error if the dispatch
+        failed, TimeoutError if the deadline elapses first."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"scoring request for model {self.name!r} did not complete "
+                f"within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _resolve(self, result: Any = None, error: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+
+class ScoringEngine:
+    """Resident scoring service over a `ModelRegistry` (docs/serving.md).
+
+    One worker thread drains the request queue: the oldest request opens a
+    micro-batch, same-model requests arriving within the coalesce window
+    (``config["serve_coalesce_window_ms"]``) merge into it up the bucket
+    ladder, and the whole block dispatches as one predict program call per
+    ``config["serve_max_batch_rows"]`` chunk. Use as a context manager, or
+    `start()`/`stop()` explicitly."""
+
+    _POLL_S = 0.05  # worker wake-up bound: stop/new-work latency ceiling
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        *,
+        coalesce_window_s: Optional[float] = None,
+        max_batch_rows: Optional[int] = None,
+    ) -> None:
+        from ..core import config
+
+        self.registry = registry
+        if coalesce_window_s is None:
+            coalesce_window_s = float(config.get("serve_coalesce_window_ms", 2.0)) / 1e3
+        self._window_s = max(0.0, float(coalesce_window_s))
+        self._max_rows = int(max_batch_rows or config.get("serve_max_batch_rows", 8192))
+        self._cond = threading.Condition()
+        self._queue: "deque[ScoreFuture]" = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._logger = get_logger(type(self))
+
+    # ---------------------------------------------------------- lifecycle --
+    def start(self) -> "ScoringEngine":
+        with self._cond:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._loop, name="srml-scoring-engine", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Drain the queue, then stop the worker. Requests still queued when
+        the drain deadline elapses fail with RuntimeError."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        with self._cond:
+            while self._queue:
+                self._queue.popleft()._resolve(
+                    error=RuntimeError("scoring engine stopped before dispatch")
+                )
+            self._thread = None
+
+    def __enter__(self) -> "ScoringEngine":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ requests --
+    def submit(self, name: str, features: Any) -> ScoreFuture:
+        """Enqueue one scoring request against resident model `name`.
+        Validates residency and feature width AT SUBMIT so the caller gets
+        the error synchronously, not inside a future."""
+        entry = self.registry.get(name)  # KeyError for unknown/evicted models
+        feats = np.asarray(features)
+        if hasattr(features, "todense"):
+            feats = np.asarray(features.todense())
+        if feats.ndim != 2:
+            raise ValueError(
+                f"features must be a [rows, {entry.n_cols}] block; got shape "
+                f"{feats.shape}"
+            )
+        if entry.n_cols and feats.shape[1] != entry.n_cols:
+            raise ValueError(
+                f"model {name!r} expects {entry.n_cols} features; got "
+                f"{feats.shape[1]}"
+            )
+        fut = ScoreFuture(name, feats, time.monotonic())
+        with self._cond:
+            if self._stop or self._thread is None:
+                raise RuntimeError("scoring engine is not running (call start())")
+            self._queue.append(fut)
+            self._cond.notify_all()
+        return fut
+
+    def score(self, name: str, features: Any, timeout: Optional[float] = 30.0) -> Any:
+        """Blocking convenience: submit + wait for the response."""
+        return self.submit(name, features).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        """Latency-centric view of the serve.* telemetry (p50/p99 from the
+        registry's bounded histogram samples; None while telemetry is off or
+        nothing has been served)."""
+        reg = telemetry.registry()
+        return {
+            "queue_wait_p50_s": reg.quantile("serve.queue_wait_s", 0.5),
+            "queue_wait_p99_s": reg.quantile("serve.queue_wait_s", 0.99),
+            "e2e_p50_s": reg.quantile("serve.e2e_s", 0.5),
+            "e2e_p99_s": reg.quantile("serve.e2e_s", 0.99),
+        }
+
+    # -------------------------------------------------------------- worker --
+    def _loop(self) -> None:
+        while True:  # blocking-ok: every wait below is bounded by _POLL_S; exits when _stop is set and the queue drained
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(self._POLL_S)
+                if not self._queue:
+                    if self._stop:
+                        return
+                    continue
+                first = self._queue.popleft()
+            group = self._coalesce(first)
+            self._dispatch_group(group)
+
+    def _coalesce(self, first: ScoreFuture) -> List[ScoreFuture]:
+        """Grow a micro-batch from `first`: same-model requests already
+        queued (or arriving inside the bounded coalesce window) merge until
+        the batch reaches the row cap. Other models' requests stay queued
+        in order for the next batch. A zero window disables coalescing
+        entirely (pure latency mode, docs/serving.md) — even already-queued
+        same-model requests dispatch solo."""
+        if self._window_s <= 0.0:
+            return [first]
+        group = [first]
+        rows = int(first.features.shape[0])
+        deadline = time.monotonic() + self._window_s
+        while rows < self._max_rows:
+            with self._cond:
+                took = None
+                for i, fut in enumerate(self._queue):
+                    if fut.name == first.name:
+                        took = fut
+                        del self._queue[i]
+                        break
+                if took is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._stop:
+                        break
+                    self._cond.wait(min(remaining, self._POLL_S))
+                    continue
+            group.append(took)
+            rows += int(took.features.shape[0])
+        return group
+
+    def _dispatch_group(self, group: List[ScoreFuture]) -> None:
+        import jax
+
+        from ..parallel.mesh import dtype_scope
+
+        t0 = time.monotonic()
+        reg = telemetry.registry() if telemetry.enabled() else None
+        if reg is not None:
+            reg.inc("serve.requests", len(group))
+            reg.inc("serve.batches")
+            if len(group) > 1:
+                reg.inc("serve.coalesced_batches")
+                reg.inc("serve.coalesced_requests", len(group))
+            for fut in group:
+                reg.observe("serve.queue_wait_s", t0 - fut.t_submit)
+        try:
+            entry = self.registry.get(group[0].name)  # use-touch: keeps it MRU
+            program = entry.program
+            if program is None:
+                # evicted between get() and here (_evict_locked nulls the
+                # program — the entry object may still be in a caller's
+                # hands): fail typed like a never-resident model, not with
+                # an AttributeError off the None
+                raise KeyError(
+                    f"model {group[0].name!r} was evicted mid-flight"
+                )
+            sizes = [int(f.features.shape[0]) for f in group]
+            block = (
+                np.concatenate([f.features for f in group], axis=0)
+                if len(group) > 1
+                else group[0].features
+            )
+            n = int(block.shape[0])
+            model = entry.model
+            with dtype_scope(
+                np.float32 if model._float32_inputs else np.float64,
+                model._matmul_precision,
+            ):
+                in_flight = []
+                # chunk oversized blocks at the program's ladder cap; a
+                # zero-row block still dispatches once (shaped empty outputs)
+                for start in range(0, n, program.cap) if n else (0,):
+                    chunk = block[start : min(start + program.cap, n)]
+                    in_flight.append(program.dispatch(chunk))
+                    if reg is not None and not program.last_dispatch_new_shape:
+                        reg.inc("serve.bucket_hits")
+                # ---- response assembly: THE one blocking point -----------
+                jax.block_until_ready([r for r, _ in in_flight])  # serve-ok: the engine's single response-assembly sync point (docs/serving.md async contract)
+                outs = [program.fetch(r, nv) for r, nv in in_flight]
+            self._resolve_group(group, sizes, outs)
+            if reg is not None:
+                reg.inc("serve.rows", n)
+                t1 = time.monotonic()
+                for fut in group:
+                    reg.observe("serve.e2e_s", t1 - fut.t_submit)
+        except Exception as e:
+            self._logger.warning(
+                "scoring dispatch for model %r failed: %s: %s",
+                group[0].name, type(e).__name__, e,
+            )
+            for fut in group:
+                fut._resolve(error=e)
+
+    @staticmethod
+    def _resolve_group(
+        group: List[ScoreFuture], sizes: List[int], outs: List[Any]
+    ) -> None:
+        """Concatenate the per-chunk outputs and slice each request's rows
+        back out, preserving the per-algo output structure (array or tuple)."""
+        if isinstance(outs[0], tuple):
+            merged: Any = tuple(
+                np.concatenate(parts, axis=0) for parts in zip(*outs)
+            )
+        else:
+            merged = np.concatenate(outs, axis=0)
+        offset = 0
+        for fut, rows in zip(group, sizes):
+            if isinstance(merged, tuple):
+                fut._resolve(tuple(m[offset : offset + rows] for m in merged))
+            else:
+                fut._resolve(merged[offset : offset + rows])
+            offset += rows

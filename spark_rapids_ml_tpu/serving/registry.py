@@ -1,0 +1,248 @@
+#
+# ModelRegistry: many fitted models resident in HBM under admission control
+# (docs/serving.md "Registry lifecycle").
+#
+# A load is a three-step transaction, all under the registry lock:
+#
+#   1. ADMISSION — `memory.admit_model_load` charges the model's placement
+#      terms plus a per-bucket predict workspace term against the per-device
+#      budget MINUS what already-resident models hold. Over budget: evict the
+#      least-recently-USED resident (scoring touches move entries to MRU) and
+#      retry; nothing left to evict: the typed `HbmBudgetError` propagates,
+#      and the refusal — naming its largest byte term — is stamped on
+#      `model._serve_metrics["admission"]`, mirroring the fit-side
+#      `_fit_metrics["admission"]` stamp.
+#   2. PLACEMENT — the model's serving hook (`_serve_program`) constructs the
+#      resident `PredictProgram` (device state placed once, held for the
+#      entry's lifetime).
+#   3. PREWARM — every bucket-ladder rung up to
+#      `config["serve_prewarm_rows"]` is compiled through the persistent
+#      compile cache (`PredictProgram.prewarm`), so the model's first query
+#      pays dispatch, never compile.
+#
+# Eviction (explicit `evict()`, pressure during a later load, or a reload of
+# the same name) drops the entry's program/state references — the only HBM
+# pins — and re-stamps the evicted model's `_serve_metrics["admission"]`
+# with verdict "evicted" so the model itself records why it left.
+#
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry
+from ..errors import HbmBudgetError
+from ..utils import get_logger
+
+
+@dataclass
+class ResidentModel:
+    """One registry entry: the model, its resident PredictProgram, and the
+    admission verdict that let it in."""
+
+    name: str
+    model: Any
+    program: Any  # core.PredictProgram (or a duck-typed per-estimator handle)
+    admission: Any  # memory.AdmissionDecision
+    serve_dtype: Optional[str] = None
+    n_cols: int = 0
+    prewarmed_rungs: int = 0
+    stats: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def resident_bytes(self) -> int:
+        return int(self.admission.estimate.total())
+
+
+class ModelRegistry:
+    """Resident multi-model store for the serving plane (docs/serving.md).
+
+    Thread-safe; `get()` is a use-touch (moves the entry to MRU), so pressure
+    eviction during a load removes the model that has served least recently.
+    """
+
+    def __init__(
+        self, *, prewarm: bool = True, max_batch_rows: Optional[int] = None
+    ) -> None:
+        from ..core import config
+
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()
+        # bytes admitted to loads still building OUTSIDE the lock (placement
+        # + prewarm): counted against later admissions so two concurrent
+        # loads cannot jointly overshoot the budget
+        self._reserved_bytes = 0
+        self._prewarm_default = bool(prewarm)
+        self._cap = int(max_batch_rows or config.get("serve_max_batch_rows", 8192))
+        self._logger = get_logger(type(self))
+
+    # ------------------------------------------------------------- reads --
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def resident_bytes(self) -> int:
+        """Admitted per-device bytes currently held by resident models —
+        what the next load's admission is charged against."""
+        with self._lock:
+            return sum(e.resident_bytes for e in self._entries.values())
+
+    def get(self, name: str) -> ResidentModel:
+        """The resident entry for `name` (KeyError when absent/evicted).
+        A USE-touch: moves the entry to most-recently-used, so serving
+        traffic keeps hot models resident under eviction pressure."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(
+                    f"model {name!r} is not resident (never loaded, or evicted)"
+                )
+            self._entries.move_to_end(name)
+            return entry
+
+    # ------------------------------------------------------------- loads --
+    def load(
+        self,
+        name: str,
+        model: Any,
+        *,
+        serve_dtype: Optional[str] = None,
+        prewarm: Optional[bool] = None,
+    ) -> ResidentModel:
+        """Load a fitted model as `name` (see the module docstring for the
+        admission → placement → prewarm transaction). Reloading an existing
+        name evicts the previous entry first. Raises the typed
+        `HbmBudgetError` when the model cannot fit even with every other
+        resident evicted.
+
+        Locking: serveability is PREFLIGHTED (`model._serve_check`) before
+        anything is evicted — a load that can never succeed must not drop
+        residents as a side effect — and placement + prewarm run OUTSIDE the
+        registry lock (prewarm is tens of seconds of compile on a cold TPU
+        cache; holding the lock would stall every concurrent `get()` and
+        with it all scoring). The admitted bytes are reserved while the
+        build runs, so concurrent loads cannot jointly overshoot the
+        budget."""
+        from .. import memory
+        from ..parallel.mesh import (
+            default_local_device,
+            dtype_scope,
+            ensure_compilation_cache,
+        )
+
+        ensure_compilation_cache()  # prewarmed rungs should come off disk
+        do_prewarm = self._prewarm_default if prewarm is None else bool(prewarm)
+        # cheap preflight OUTSIDE any eviction: raises exactly what
+        # _serve_program would (no hook / bad serve_dtype / unbound items)
+        model._serve_check(serve_dtype)
+        with self._lock:
+            if name in self._entries:
+                self._evict_locked(name, reason="reloaded")
+            devices = [default_local_device()]
+            while True:  # blocking-ok: each pass either admits or evicts one LRU entry; an empty registry re-raises — no waiting
+                try:
+                    adm = memory.admit_model_load(
+                        model,
+                        resident_bytes=self.resident_bytes() + self._reserved_bytes,
+                        bucket_rows_count=self._cap,
+                        devices=devices,
+                    )
+                    break
+                except HbmBudgetError as e:
+                    victim = next(iter(self._entries), None)
+                    if victim is None:
+                        # refused with nothing left to evict: stamp the
+                        # refusal (largest term and all) on the model so the
+                        # failure is carried, not just raised
+                        model._serve_metrics["admission"] = {
+                            "verdict": "refused",
+                            "reason": str(e),
+                            "estimate_bytes": e.estimate_bytes,
+                            "capacity_bytes": e.capacity_bytes,
+                            "largest_term": e.largest_term,
+                            "largest_term_bytes": e.largest_term_bytes,
+                        }
+                        raise
+                    self._logger.warning(
+                        "serving budget pressure loading %r: evicting LRU "
+                        "resident %r (%s)", name, victim, e,
+                    )
+                    self._evict_locked(victim, reason=f"pressure from load of {name!r}")
+            self._reserved_bytes += adm.estimate.total()
+        # ---- placement + prewarm: NO registry lock held ------------------
+        try:
+            dtype = "float64" if not model._float32_inputs else "float32"
+            with telemetry.span(
+                "serve_load", model=type(model).__name__, entry=name
+            ):
+                with dtype_scope(dtype, model._matmul_precision):
+                    program = model._serve_program(serve_dtype, cap=self._cap)
+                    n_cols = model._serve_n_cols()
+                    rungs = 0
+                    if do_prewarm:
+                        from ..core import config
+
+                        max_rows = int(config.get("serve_prewarm_rows", 4096))
+                        if max_rows > 0:
+                            rungs = program.prewarm(n_cols, max_rows=max_rows)
+        finally:
+            with self._lock:
+                self._reserved_bytes -= adm.estimate.total()
+        with self._lock:
+            if name in self._entries:  # a concurrent load published first
+                self._evict_locked(name, reason="reloaded")
+            entry = ResidentModel(
+                name=name,
+                model=model,
+                program=program,
+                admission=adm,
+                serve_dtype=serve_dtype,
+                n_cols=n_cols,
+                prewarmed_rungs=rungs,
+            )
+            self._entries[name] = entry
+            model._serve_metrics["admission"] = adm.stamp()
+            if telemetry.enabled():
+                reg = telemetry.registry()
+                reg.inc("serve.models_loaded")
+                reg.inc("serve.prewarmed_programs", rungs)
+                reg.gauge("serve.resident_bytes", self.resident_bytes())
+                reg.gauge("serve.resident_models", len(self._entries))
+            return entry
+
+    # --------------------------------------------------------- evictions --
+    def evict(self, name: str) -> None:
+        """Explicitly drop a resident model (KeyError when absent)."""
+        with self._lock:
+            if name not in self._entries:
+                raise KeyError(f"model {name!r} is not resident")
+            self._evict_locked(name, reason="explicit evict")
+
+    def clear(self) -> None:
+        """Drop every resident model (registry shutdown)."""
+        with self._lock:
+            for name in list(self._entries):
+                self._evict_locked(name, reason="registry cleared")
+
+    def _evict_locked(self, name: str, reason: str) -> None:
+        entry = self._entries.pop(name)
+        # the model carries WHY it left residency, largest byte term and all
+        # — mirroring a refused load's stamp
+        stamp = dict(entry.admission.stamp())
+        stamp["verdict"] = "evicted"
+        stamp["reason"] = reason
+        entry.model._serve_metrics["admission"] = stamp
+        # the program (and its device state) are the only HBM pins
+        entry.program = None
+        if telemetry.enabled():
+            reg = telemetry.registry()
+            reg.inc("serve.model_evictions")
+            reg.gauge("serve.resident_bytes", self.resident_bytes())
+            reg.gauge("serve.resident_models", len(self._entries))
+        self._logger.info("evicted serving model %r (%s)", name, reason)

@@ -64,6 +64,10 @@ __all__ = [
 # in-memory list is for snapshot()/summary() and stays bounded).
 _MAX_SPAN_RECORDS = 4096
 _MAX_CONVERGENCE_POINTS = 10_000
+# Most-recent observations retained per histogram for quantile() estimation
+# (serving latency p50/p99); the count/sum/min/max summary sees EVERY
+# observation — only the quantile view is windowed.
+_MAX_HIST_SAMPLES = 1024
 
 
 class _State:
@@ -146,6 +150,8 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Dict[str, float]] = {}
+        # per-histogram ring of the most recent observations (quantile())
+        self._hist_samples: Dict[str, List[float]] = {}
         self._spans: List[Dict[str, Any]] = []
         # monotone count of ALL spans ever recorded — `_spans` is trimmed to a
         # bound, so marks must not be absolute list indices
@@ -184,6 +190,10 @@ class MetricsRegistry:
             h["sum"] += value
             h["min"] = min(h["min"], value)
             h["max"] = max(h["max"], value)
+            samples = self._hist_samples.setdefault(name, [])
+            samples.append(float(value))
+            if len(samples) > _MAX_HIST_SAMPLES:
+                del samples[: -_MAX_HIST_SAMPLES // 2]
 
     def record_span(
         self,
@@ -225,6 +235,20 @@ class MetricsRegistry:
             pts.append([int(iteration), float(value)])
 
     # -- read --------------------------------------------------------------
+    def quantile(self, name: str, q: float) -> Optional[float]:
+        """Quantile estimate over histogram `name`'s retained sample window
+        (the most recent ``_MAX_HIST_SAMPLES`` observations — a long-lived
+        serving process reads CURRENT latency, not all-time). None when no
+        observations exist. Nearest-rank on the sorted window."""
+        with self._lock:
+            samples = self._hist_samples.get(name)
+            if not samples:
+                return None
+            ordered = sorted(samples)
+        q = min(max(float(q), 0.0), 1.0)
+        idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+        return ordered[idx]
+
     def convergence_trace(self, solver: str) -> List[List[float]]:
         """[(iteration, value), ...] points recorded for `solver`."""
         with self._lock:
@@ -308,6 +332,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._hist_samples.clear()
             self._spans.clear()
             self._convergence.clear()
 

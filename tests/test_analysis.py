@@ -32,6 +32,7 @@ from ci.analysis.rules import (  # noqa: E402
     PadRowsRule,
     PerfCounterRule,
     RawDistanceRule,
+    ServeDispatchRule,
     SleepRule,
     SpmdDivergenceRule,
     TracedImpurityRule,
@@ -232,6 +233,83 @@ def test_raw_distance_clean_rebinding_clears_taint():
         return jnp.argmin(d2, axis=1)
     """
     assert run(src, RawDistanceRule) == []
+
+
+# --------------------------------------------------------------------------
+# serve-dispatch: the serving plane's async contract (docs/serving.md)
+# --------------------------------------------------------------------------
+
+_SERVING_PATH = "spark_rapids_ml_tpu/serving/snippet.py"
+
+
+def test_serve_dispatch_direct_jit_fires():
+    src = """
+    import jax
+    def load(predict):
+        return jax.jit(predict)
+    """
+    fs = run(src, ServeDispatchRule, relpath=_SERVING_PATH)
+    assert rule_ids(fs) == ["serve-dispatch"]
+
+
+def test_serve_dispatch_block_until_ready_both_forms_fire():
+    src = """
+    import jax
+    def assemble(result):
+        jax.block_until_ready(result)
+        result.block_until_ready()
+        return jax.device_get(result)
+    """
+    fs = run(src, ServeDispatchRule, relpath=_SERVING_PATH)
+    assert rule_ids(fs) == ["serve-dispatch"] * 3
+
+
+def test_serve_dispatch_waiver_and_import_alias():
+    waived = """
+    import jax
+    def assemble(results):
+        jax.block_until_ready(results)  # serve-ok: the one response-assembly sync point
+        return results
+    """
+    assert run(waived, ServeDispatchRule, relpath=_SERVING_PATH) == []
+    aliased = """
+    from jax import jit as J
+    def load(predict):
+        return J(predict)
+    """
+    assert rule_ids(run(aliased, ServeDispatchRule, relpath=_SERVING_PATH)) == [
+        "serve-dispatch"
+    ]
+
+
+def test_serve_dispatch_scoped_to_serving_only():
+    # the same constructs are legal everywhere else in the framework (the
+    # fit side jits freely) — and prose mentions never fire under AST rules
+    src = """
+    import jax
+    def f(predict, result):
+        g = jax.jit(predict)
+        return g(result).block_until_ready()
+    """
+    assert run(src, ServeDispatchRule) == []  # default core-tree relpath
+    prose = '''
+    def doc():
+        """Engines must not call jax.jit or block_until_ready directly."""
+        s = "jax.jit(predict).block_until_ready()"
+        return s
+    '''
+    assert run(prose, ServeDispatchRule, relpath=_SERVING_PATH) == []
+
+
+def test_serve_dispatch_program_calls_pass():
+    # the sanctioned surface: PredictProgram dispatch/fetch and plain numpy
+    src = """
+    import numpy as np
+    def group(program, block):
+        result, n = program.dispatch(block)
+        return np.concatenate([program.fetch(result, n)])
+    """
+    assert run(src, ServeDispatchRule, relpath=_SERVING_PATH) == []
 
 
 # --------------------------------------------------------------------------

@@ -12,6 +12,8 @@ from benchmark.benchmark_runner import ALGORITHMS, PROTOCOL
 
 
 SMOKE = {
+    "serving": ["--num_cols", "24", "--k", "16", "--n_requests", "32",
+                "--concurrency", "4"],
     "ingest": ["--num_rows", "4000", "--num_cols", "64"],
     "pca": ["--num_rows", "2000", "--num_cols", "32"],
     "kmeans": ["--num_rows", "2000", "--num_cols", "16", "--k", "8", "--maxIter", "3"],
@@ -184,6 +186,40 @@ def test_benchmark_sparse_logistic_lane(tmp_path):
     assert row["fit_sec"] > 0
     assert row["accuracy"] > 0.75
     assert os.path.exists(report)
+
+
+def test_benchmark_serving_lane(tmp_path):
+    # the serving lane's acceptance numbers (docs/serving.md): p50 <= p99,
+    # QPS > 0, prewarm happened, and — the bit-identity criterion — every
+    # coalesced response equal to the same request served solo
+    from benchmark.bench_serving import run_serving_bench
+
+    out = run_serving_bench(
+        n_cols=24, k=16, n_requests=32, concurrency=4,
+        coalesce_window_ms=10.0, seed=3,
+    )
+    assert out["qps"] > 0 and out["rows_per_sec"] > 0
+    assert 0 < out["p50_ms"] <= out["p99_ms"]
+    assert out["prewarmed_programs"] > 0
+    assert out["max_abs_diff"] == 0.0  # coalesced == solo, bitwise
+    assert out["coalesced_batches"] >= 1  # micro-batching actually engaged
+
+
+def test_bench_emit_embeds_latency_lanes(capsys):
+    # bench.py's record carries the serving lane's p50/p99 under
+    # latency_lanes — what benchmark/regression.py's latency gates read
+    import json
+
+    import bench
+
+    bench.emit(
+        {"pca": 1e5, "serving": 2e5},
+        latency_lanes={"serving_p50_ms": 1.25, "serving_p99_ms": 4.5},
+    )
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["latency_lanes"] == {"serving_p50_ms": 1.25, "serving_p99_ms": 4.5}
+    assert rec["lanes"]["serving"] == 2e5
+    assert "serving" in rec["geomean_lanes"]
 
 
 def test_benchmark_ingest_records_chunked_vs_monolithic(tmp_path):

@@ -611,6 +611,61 @@ def test_regression_counter_reference_is_one_coherent_snapshot():
     assert verdict["verdict"] == "pass"
 
 
+def test_regression_latency_lanes_gate_lower_better():
+    # serving p50/p99 gate as LOWER-is-better lanes (the counter machinery,
+    # generalized): within tolerance passes, a p99 blowup fails even though
+    # every throughput lane is fine
+    from benchmark.regression import run_gate
+
+    def lat_rec(value, p50, p99):
+        rec = _bench_record(value)
+        rec["latency_lanes"] = {"serving_p50_ms": p50, "serving_p99_ms": p99}
+        return rec
+
+    hist = [lat_rec(100_000.0, 1.0, 5.0), lat_rec(102_000.0, 1.2, 5.5)]
+    ok = run_gate(lat_rec(101_000.0, 1.1, 6.0), hist)
+    lanes = {ln["lane"]: ln for ln in ok["lanes"]}
+    assert lanes["latency:serving_p99_ms"]["status"] == "pass"
+    assert lanes["latency:serving_p99_ms"]["direction"] == "lower-better"
+    assert ok["verdict"] == "pass"
+
+    bad = run_gate(lat_rec(103_000.0, 1.1, 12.0), hist)  # p99 blowup only
+    assert bad["verdict"] == "fail"
+    assert bad["failed_lanes"] == ["latency:serving_p99_ms"]
+    lanes = {ln["lane"]: ln for ln in bad["lanes"]}
+    assert lanes["throughput_geomean"]["status"] == "pass"
+    assert lanes["latency:serving_p50_ms"]["status"] == "pass"
+
+
+def test_regression_latency_lane_trajectory_start_is_skipped():
+    # the first artifact carrying latency_lanes must not false-fail against
+    # history that predates the serving lane
+    from benchmark.regression import run_gate
+
+    cur = _bench_record(101_000.0)
+    cur["latency_lanes"] = {"serving_p99_ms": 4.0}
+    verdict = run_gate(cur, HIST)
+    lanes = {ln["lane"]: ln for ln in verdict["lanes"]}
+    assert lanes["latency:serving_p99_ms"]["status"] == "skipped"
+    assert "trajectory start" in lanes["latency:serving_p99_ms"]["note"]
+    assert verdict["verdict"] == "pass"
+
+
+def test_regression_latency_ratio_is_configurable():
+    from benchmark.regression import run_gate
+
+    def lat_rec(value, p99):
+        rec = _bench_record(value)
+        rec["latency_lanes"] = {"serving_p99_ms": p99}
+        return rec
+
+    hist = [lat_rec(100_000.0, 5.0)]
+    strict = run_gate(lat_rec(100_000.0, 6.0), hist, max_latency_ratio=1.1)
+    assert strict["verdict"] == "fail"
+    loose = run_gate(lat_rec(100_000.0, 6.0), hist, max_latency_ratio=2.0)
+    assert loose["verdict"] == "pass"
+
+
 def test_regression_new_lanes_start_their_own_trajectory():
     # the first artifact carrying per-lane values (kmeans_scale/knn joining
     # the geomean) must NOT false-fail against history that lacks them: the

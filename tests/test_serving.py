@@ -1,0 +1,421 @@
+#
+# Persistent serving plane tests (docs/serving.md): registry admission +
+# LRU eviction, load-time ladder prewarm (compile-count pins via
+# transform.bucket_programs), micro-batch coalescing bit-identity vs solo
+# predicts, zero-row requests through the bucket ladder, the bf16 query path
+# on the distance-core models, and the knn serve program's tiled-core route.
+#
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import HbmBudgetError, core, telemetry
+from spark_rapids_ml_tpu.models.classification import LogisticRegression
+from spark_rapids_ml_tpu.models.clustering import KMeansModel
+from spark_rapids_ml_tpu.models.knn import NearestNeighbors
+from spark_rapids_ml_tpu.serving import ModelRegistry, ScoringEngine
+
+
+@pytest.fixture
+def tele():
+    """Enable telemetry with a fresh registry; restore after."""
+    telemetry.registry().reset()
+    telemetry.enable()
+    yield telemetry.registry()
+    telemetry.disable()
+    telemetry.registry().reset()
+
+
+@pytest.fixture
+def serve_cfg():
+    """Small bucket ladder + prewarm so compile-count pins are cheap."""
+    saved = {
+        k: core.config[k]
+        for k in (
+            "transform_bucket_min_rows",
+            "serve_prewarm_rows",
+            "serve_max_batch_rows",
+            "serve_coalesce_window_ms",
+            "hbm_budget_bytes",
+        )
+    }
+    core.config["transform_bucket_min_rows"] = 8
+    core.config["serve_prewarm_rows"] = 64
+    core.config["serve_max_batch_rows"] = 256
+    core.config["serve_coalesce_window_ms"] = 25.0
+    yield
+    core.config.update(saved)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _kmeans_model(rng, k=6, d=10, scale=10.0):
+    centers = (rng.standard_normal((k, d)) * scale).astype(np.float32)
+    return KMeansModel(cluster_centers_=centers, n_cols=d, dtype="float32")
+
+
+def _logistic_model(rng, n=160, d=6):
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    return LogisticRegression(maxIter=30, regParam=0.01).setFeaturesCol("features").fit(df)
+
+
+def _knn_model(rng, n=150, d=5, k=4):
+    items = rng.normal(size=(n, d))
+    df = pd.DataFrame({"features": list(items), "id": np.arange(1000, 1000 + n)})
+    model = NearestNeighbors(k=k).setInputCol("features").setIdCol("id").fit(df)
+    return model, items
+
+
+# ------------------------------------------------------------ registry -----
+
+
+def test_load_stamps_resident_admission(tele, serve_cfg, rng):
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    entry = registry.load("km", model)
+    stamp = model._serve_metrics["admission"]
+    assert stamp["verdict"] == "resident"
+    assert stamp["largest_term"]  # names its dominant byte line item
+    assert entry.resident_bytes > 0
+    assert registry.resident_bytes() == entry.resident_bytes
+    snap = tele.snapshot()
+    assert snap["counters"]["serve.models_loaded"] == 1
+    assert snap["gauges"]["serve.resident_models"] == 1
+
+
+def test_prewarm_compiles_exactly_the_ladder(tele, serve_cfg, rng):
+    # d=11 is unique to this test: the process-wide bucket-shape set
+    # deliberately survives registry resets (it mirrors the jit cache), so
+    # the compile-count pin needs shapes no other test dispatches
+    model = _kmeans_model(rng, d=11)
+    registry = ModelRegistry()
+    before = tele.snapshot()["counters"].get("transform.bucket_programs", 0)
+    entry = registry.load("km", model)
+    after = tele.snapshot()["counters"].get("transform.bucket_programs", 0)
+    ladder = entry.program.ladder(core.config["serve_prewarm_rows"])
+    assert entry.prewarmed_rungs == len(ladder) == 4  # 8,16,32,64
+    # compile-count pin: prewarm minted exactly one program per rung
+    assert after - before == len(ladder)
+    # ...and ragged post-load traffic mints NOTHING new inside the prewarmed
+    # range: every dispatch is a bucket hit
+    with ScoringEngine(registry) as engine:
+        for n in (1, 5, 8, 13, 31, 64, 40):
+            engine.score("km", rng.standard_normal((n, 11)).astype(np.float32))
+    final = tele.snapshot()["counters"]
+    assert final.get("transform.bucket_programs", 0) == after
+    assert final["serve.bucket_hits"] > 0
+
+
+def test_eviction_under_pressure_stamps_and_frees(tele, serve_cfg, rng):
+    from spark_rapids_ml_tpu import memory
+
+    m_a, m_b = _kmeans_model(rng), _kmeans_model(rng, scale=3.0)
+    one = memory.model_serve_estimate(m_a, core.config["serve_max_batch_rows"]).total()
+    # budget fits ONE model (plus headroom), not two
+    core.config["hbm_budget_bytes"] = int(one * 1.5 / 0.9)
+    registry = ModelRegistry()
+    registry.load("A", m_a)
+    registry.load("B", m_b)
+    assert "A" not in registry and "B" in registry
+    stamp = m_a._serve_metrics["admission"]
+    assert stamp["verdict"] == "evicted"
+    assert "pressure" in stamp["reason"]
+    assert stamp["largest_term"]  # an evicted load names its largest term
+    with pytest.raises(KeyError):
+        registry.get("A")
+    assert tele.snapshot()["counters"]["serve.model_evictions"] == 1
+
+
+def test_refused_load_is_typed_and_stamped(tele, serve_cfg, rng):
+    core.config["hbm_budget_bytes"] = 2048  # below any model's working set
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    with pytest.raises(HbmBudgetError) as ei:
+        registry.load("km", model)
+    assert ei.value.largest_term  # the typed refusal names what doesn't fit
+    stamp = model._serve_metrics["admission"]
+    assert stamp["verdict"] == "refused"
+    assert stamp["largest_term"] == ei.value.largest_term
+    assert "km" not in registry
+
+
+def test_lru_eviction_respects_serving_touch(tele, serve_cfg, rng):
+    from spark_rapids_ml_tpu import memory
+
+    m_a, m_b, m_c = (_kmeans_model(rng) for _ in range(3))
+    one = memory.model_serve_estimate(m_a, core.config["serve_max_batch_rows"]).total()
+    core.config["hbm_budget_bytes"] = int(one * 2.5 / 0.9)  # fits two, not three
+    registry = ModelRegistry()
+    registry.load("A", m_a)
+    registry.load("B", m_b)
+    registry.get("A")  # touch: A becomes MRU, B is now the LRU victim
+    registry.load("C", m_c)
+    assert "A" in registry and "C" in registry and "B" not in registry
+
+
+def test_reload_replaces_entry(tele, serve_cfg, rng):
+    registry = ModelRegistry()
+    m1, m2 = _kmeans_model(rng), _kmeans_model(rng, k=4)
+    registry.load("km", m1)
+    registry.load("km", m2)
+    assert registry.get("km").model is m2
+    assert m1._serve_metrics["admission"]["verdict"] == "evicted"
+    assert len(registry.names()) == 1
+
+
+# -------------------------------------------------------------- engine -----
+
+
+def test_coalesced_responses_bit_identical_to_solo(tele, serve_cfg, rng):
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    sizes = (1, 3, 17, 40, 2, 9, 64, 5)
+    requests = [rng.standard_normal((n, 10)).astype(np.float32) for n in sizes]
+    solo = [np.asarray(model._transform_arrays(q)) for q in requests]
+    with ScoringEngine(registry) as engine:
+        # submit from threads so requests genuinely interleave in the window
+        futs = [None] * len(requests)
+
+        def submit(i):
+            futs[i] = engine.submit("km", requests[i])
+
+        threads = [threading.Thread(target=submit, args=(i,)) for i in range(len(requests))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for fut, ref in zip(futs, solo):
+            got = fut.result(timeout=60)
+            assert np.array_equal(np.asarray(got), ref)  # BIT-identical
+    counters = tele.snapshot()["counters"]
+    assert counters["serve.requests"] == len(requests)
+    assert counters["serve.coalesced_batches"] >= 1  # micro-batching happened
+    assert counters["serve.batches"] < len(requests)
+
+
+def test_zero_row_request_through_the_ladder(tele, serve_cfg, rng):
+    km = _kmeans_model(rng)
+    lr = _logistic_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", km)
+    registry.load("lr", lr)
+    with ScoringEngine(registry) as engine:
+        z = engine.score("km", np.zeros((0, 10), np.float32))
+        assert z.shape == (0,)
+        # multi-output model: one correctly-shaped empty array PER output
+        raw, prob = engine.score("lr", np.zeros((0, 6)))
+        assert raw.shape == (0, 2) and prob.shape == (0, 2)
+
+
+def test_multi_output_and_oversized_requests(tele, serve_cfg, rng):
+    lr = _logistic_model(rng)
+    registry = ModelRegistry()
+    registry.load("lr", lr)
+    # rows > serve_max_batch_rows: the engine splits across dispatches
+    big = rng.normal(size=(2 * core.config["serve_max_batch_rows"] + 37, 6))
+    ref_raw, ref_prob = lr._transform_arrays(big)
+    with ScoringEngine(registry) as engine:
+        raw, prob = engine.score("lr", big, timeout=120)
+    assert np.array_equal(raw, ref_raw) and np.array_equal(prob, ref_prob)
+
+
+def test_mixed_model_routing(tele, serve_cfg, rng):
+    km, lr = _kmeans_model(rng, d=6), _logistic_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", km)
+    registry.load("lr", lr)
+    with ScoringEngine(registry) as engine:
+        q_km = rng.standard_normal((11, 6)).astype(np.float32)
+        q_lr = rng.normal(size=(13, 6))
+        f1 = engine.submit("km", q_km)
+        f2 = engine.submit("lr", q_lr)
+        assert np.array_equal(f1.result(), np.asarray(km._transform_arrays(q_km)))
+        raw, _ = f2.result()
+        assert np.array_equal(raw, lr._transform_arrays(q_lr)[0])
+
+
+def test_submit_validates_synchronously(tele, serve_cfg, rng):
+    registry = ModelRegistry()
+    registry.load("km", _kmeans_model(rng))
+    with ScoringEngine(registry) as engine:
+        with pytest.raises(KeyError):
+            engine.submit("nope", np.zeros((1, 10), np.float32))
+        with pytest.raises(ValueError):
+            engine.submit("km", np.zeros((3, 4), np.float32))  # wrong width
+        with pytest.raises(ValueError):
+            engine.submit("km", np.zeros(10, np.float32))  # not 2-D
+    with pytest.raises(RuntimeError):
+        engine.submit("km", np.zeros((1, 10), np.float32))  # stopped engine
+
+
+def test_latency_histograms_and_stats(tele, serve_cfg, rng):
+    registry = ModelRegistry()
+    registry.load("km", _kmeans_model(rng))
+    with ScoringEngine(registry) as engine:
+        for _ in range(5):
+            engine.score("km", rng.standard_normal((4, 10)).astype(np.float32))
+        stats = engine.stats()
+    hists = tele.snapshot()["histograms"]
+    assert hists["serve.queue_wait_s"]["count"] == 5
+    assert hists["serve.e2e_s"]["count"] == 5
+    assert stats["e2e_p99_s"] >= stats["e2e_p50_s"] > 0
+    assert tele.quantile("serve.e2e_s", 0.5) is not None
+    assert tele.quantile("no.such.histogram", 0.5) is None
+
+
+def test_evicted_mid_flight_fails_typed(tele, serve_cfg, rng):
+    registry = ModelRegistry()
+    registry.load("km", _kmeans_model(rng))
+    engine = ScoringEngine(registry).start()
+    try:
+        fut = engine.submit("km", rng.standard_normal((4, 10)).astype(np.float32))
+        fut.result(timeout=30)  # drain so the evict below is unambiguous
+        registry.evict("km")
+        with pytest.raises(KeyError):
+            engine.submit("km", np.zeros((1, 10), np.float32))
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------- bf16 + knn -----
+
+
+def test_bf16_kmeans_assignments_match_f32(tele, serve_cfg, rng):
+    # well-separated centers: the ~1e-3 bf16 rounding cannot flip assignments
+    model = _kmeans_model(rng, scale=50.0)
+    registry = ModelRegistry()
+    registry.load("km16", model, serve_dtype="bf16")
+    q = rng.standard_normal((37, 10)).astype(np.float32)
+    with ScoringEngine(registry) as engine:
+        a16 = engine.score("km16", q)
+    assert np.array_equal(a16, np.asarray(model._transform_arrays(q)))
+
+
+def test_bf16_rejected_off_the_distance_core(tele, serve_cfg, rng):
+    lr = _logistic_model(rng)
+    registry = ModelRegistry()
+    with pytest.raises(ValueError, match="distance-core"):
+        registry.load("lr", lr, serve_dtype="bf16")
+
+
+def test_knn_serving_matches_kneighbors(tele, serve_cfg, rng):
+    model, items = _knn_model(rng)
+    _, _, knn_df = model.kneighbors(
+        pd.DataFrame({"features": list(items[:9]), "id": np.arange(9)})
+    )
+    ref_idx = np.stack(knn_df["indices"].to_numpy())
+    ref_d = np.stack(knn_df["distances"].to_numpy())
+    before = tele.snapshot()["counters"].get("distance.topk_programs", 0)
+    registry = ModelRegistry()
+    registry.load("knn", model)
+    with ScoringEngine(registry) as engine:
+        d, idx = engine.score("knn", items[:9])
+    # the serve program routes through the tiled distance core
+    assert tele.snapshot()["counters"].get("distance.topk_programs", 0) > before
+    assert np.array_equal(idx, ref_idx)
+    np.testing.assert_allclose(d, ref_d, atol=2e-3)  # f32 expansion rounding
+
+
+def test_knn_bf16_neighbor_sets_on_separated_items(tele, serve_cfg, rng):
+    # items on a coarse lattice: neighbor gaps far above bf16 rounding
+    items = (rng.integers(-4, 5, size=(80, 5)) * 10.0).astype(np.float64)
+    items += rng.normal(scale=0.01, size=items.shape)
+    df = pd.DataFrame({"features": list(items), "id": np.arange(80)})
+    model = NearestNeighbors(k=3).setInputCol("features").setIdCol("id").fit(df)
+    registry = ModelRegistry()
+    registry.load("knn16", model, serve_dtype="bf16")
+    registry2 = ModelRegistry()
+    registry2.load("knn32", model)
+    q = items[:7] + 0.05
+    with ScoringEngine(registry) as engine:
+        _, idx16 = engine.score("knn16", q)
+    with ScoringEngine(registry2) as engine:
+        _, idx32 = engine.score("knn32", q)
+    assert np.array_equal(idx16, idx32)
+
+
+def test_knn_admission_prices_the_item_block(tele, serve_cfg, rng):
+    from spark_rapids_ml_tpu import memory
+
+    model, items = _knn_model(rng, n=150, d=5)
+    est = memory.model_serve_estimate(
+        model, core.config["serve_max_batch_rows"]
+    )
+    # the resident item block is a named placement term, and the top-k tile
+    # workspace is bounded (never a [bucket, n_items] block on the kernel path)
+    assert est.terms["placement.items"] == items.size * 4  # f32
+    assert "workspace.topk_block" in est.terms
+
+
+def test_doomed_load_does_not_evict_residents(tele, serve_cfg, rng):
+    # a load that can never succeed (no serving hook / bad serve_dtype) must
+    # preflight-fail BEFORE the admission/eviction loop — previously-serving
+    # models stay resident
+    from spark_rapids_ml_tpu import memory
+    from spark_rapids_ml_tpu.models.clustering import DBSCAN
+
+    m_a = _kmeans_model(rng)
+    one = memory.model_serve_estimate(m_a, core.config["serve_max_batch_rows"]).total()
+    core.config["hbm_budget_bytes"] = int(one * 1.5 / 0.9)  # tight: fits one
+    registry = ModelRegistry()
+    registry.load("A", m_a)
+    x = rng.normal(size=(20, 3))
+    dbm = DBSCAN(eps=2.0, min_samples=3).setFeaturesCol("features").fit(
+        pd.DataFrame({"features": list(x)})
+    )
+    with pytest.raises(NotImplementedError):
+        registry.load("dbscan", dbm)
+    lr = _logistic_model(rng)
+    with pytest.raises(ValueError, match="distance-core"):
+        registry.load("lr16", lr, serve_dtype="bf16")
+    assert "A" in registry  # survived both doomed loads
+    assert tele.snapshot()["counters"].get("serve.model_evictions", 0) == 0
+
+
+def test_zero_window_disables_coalescing(tele, serve_cfg, rng):
+    registry = ModelRegistry()
+    registry.load("km", _kmeans_model(rng))
+    requests = [rng.standard_normal((n, 10)).astype(np.float32) for n in (3, 5, 7, 9)]
+    with ScoringEngine(registry, coalesce_window_s=0.0) as engine:
+        futs = [engine.submit("km", q) for q in requests]  # backlog builds
+        outs = [f.result(30) for f in futs]
+    for out, q in zip(outs, requests):
+        assert out.shape == (q.shape[0],)
+    counters = tele.snapshot()["counters"]
+    # 0 disables coalescing even with a queued same-model backlog: one
+    # dispatched batch per request, nothing coalesced
+    assert counters["serve.batches"] == len(requests)
+    assert counters.get("serve.coalesced_batches", 0) == 0
+
+
+def test_unserveable_model_raises(tele, serve_cfg, rng):
+    from spark_rapids_ml_tpu.models.clustering import DBSCAN
+
+    registry = ModelRegistry()
+    x = rng.normal(size=(20, 3))
+    dbs = DBSCAN(eps=2.0, min_samples=3).setFeaturesCol("features")
+    dbm = dbs.fit(pd.DataFrame({"features": list(x)}))
+    with pytest.raises(NotImplementedError, match="serving hook"):
+        registry.load("dbscan", dbm)
+
+
+def test_predict_program_shared_with_transform(tele, serve_cfg, rng):
+    """The serving handle and _transform_arrays share one implementation:
+    a program built directly gives the same outputs as the transform path."""
+    from spark_rapids_ml_tpu.core import PredictProgram
+
+    model = _logistic_model(rng)
+    q = rng.normal(size=(23, 6))
+    program = PredictProgram(model, cap=core.config["serve_max_batch_rows"])
+    result, n_valid = program.dispatch(q)
+    raw, prob = program.fetch(result, n_valid)
+    ref_raw, ref_prob = model._transform_arrays(q)
+    assert np.array_equal(raw, ref_raw) and np.array_equal(prob, ref_prob)
