@@ -77,7 +77,7 @@ ALGOS = ("serving", "pca", "logreg", "kmeans", "kmeans_scale", "knn")
 # registry/engine are single-device): their rows/sec is already per-chip —
 # dividing by the mesh size would underreport them n_chips-fold on
 # multi-chip rounds and false-fail the lane gate vs single-chip history
-SINGLE_DEVICE_LANES = {"serving"}
+SINGLE_DEVICE_LANES = {"serving", "sched_contention"}
 KNN_QUERIES = int(os.environ.get("BENCH_KNN_QUERIES", 4096))
 KNN_K = int(os.environ.get("BENCH_KNN_K", 64))
 SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 256))
@@ -115,6 +115,17 @@ OOCORE_ROWS = int(os.environ.get("BENCH_OOCORE_ROWS", 400_000))
 OOCORE_COLS = int(os.environ.get("BENCH_OOCORE_COLS", 500))
 OOCORE_CHUNK = int(os.environ.get("BENCH_OOCORE_CHUNK", 65_536))
 
+# Optional multi-tenant scheduler contention lane (BENCH_SCHED=1): N tenants
+# with adversarial job sizes through one FitScheduler over the shared HBM
+# ledger (benchmark/bench_scheduler.py, docs/scheduling.md) — reports ledger
+# utilization, per-tenant queue-wait p50/p99, and preemption counts. Own
+# @RESULT line; NOT part of the headline geomean until the lane history
+# stabilizes (no BASELINES entry).
+SCHED_ALGO = "sched_contention"
+SCHED_TENANTS = int(os.environ.get("BENCH_SCHED_TENANTS", 4))
+SCHED_ROWS = int(os.environ.get("BENCH_SCHED_ROWS", 60_000))
+SCHED_COLS = int(os.environ.get("BENCH_SCHED_COLS", 32))
+
 
 def bench_algos() -> tuple:
     extra: tuple = ()
@@ -130,6 +141,10 @@ def bench_algos() -> tuple:
         # streaming lane ahead of the dense block too: its resident baseline
         # fit is freed before the protocol X lands
         extra += (OOCORE_ALGO,)
+    if os.environ.get("BENCH_SCHED"):
+        # contention lane ahead of the dense block for the same HBM reason
+        # (its per-tenant datasets are freed when the scheduler drains)
+        extra += (SCHED_ALGO,)
     return extra + ALGOS
 
 # Parent retry policy (override for tests): attempts x per-attempt timeout,
@@ -357,6 +372,31 @@ def bench_oocore_lane() -> float:
     return out["stream_rows_per_sec"]
 
 
+def bench_scheduler_lane() -> float:
+    """Multi-tenant contention lane (docs/scheduling.md): N tenants with
+    adversarial sizes through one FitScheduler over the shared HBM ledger.
+    Reports ledger utilization, per-tenant queue-wait p50/p99, and
+    preemption/demotion counts; over-budget admissions are a correctness
+    failure, not a slow lane. The lane metric is total fit rows/sec."""
+    from benchmark.bench_scheduler import run_scheduler_bench
+
+    out = run_scheduler_bench(SCHED_TENANTS, SCHED_ROWS, SCHED_COLS)
+    _log(
+        f"sched_contention: {out['wall_s']:.2f}s for {int(out['jobs'])} jobs "
+        f"({out['rows_per_sec']:,.0f} rows/s, utilization "
+        f"{out['utilization']:.2f}, queue-wait p50 {out['queue_wait_p50_s']*1e3:.1f}ms "
+        f"/ p99 {out['queue_wait_p99_s']*1e3:.1f}ms, "
+        f"{int(out['preemptions'])} preemption(s), "
+        f"{int(out['demotions'])} demotion(s))"
+    )
+    if out["ledger_over_budget_admissions"]:
+        raise RuntimeError(
+            "sched_contention lane: ledger exceeded the budget at "
+            f"{int(out['ledger_over_budget_admissions'])} admission(s)"
+        )
+    return out["rows_per_sec"]
+
+
 def bench_serving_lane() -> tuple:
     """Serving-plane lane (docs/serving.md): mixed-size concurrent predict
     requests against a resident k=SERVE_K model at the protocol width through
@@ -457,6 +497,7 @@ def run_child() -> int:
         SPARSE_ALGO: lambda: bench_sparse_logreg(mesh),
         CV_ALGO: lambda: bench_cv_lane(),
         OOCORE_ALGO: lambda: bench_oocore_lane(),
+        SCHED_ALGO: lambda: bench_scheduler_lane(),
         "serving": lambda: bench_serving_lane(),
         "pca": lambda: bench_pca(dense_data()["X"], dense_data()["w"], mesh),
         "logreg": lambda: bench_logreg(

@@ -1,0 +1,205 @@
+#
+# Multi-tenant scheduler contention lane (docs/scheduling.md "Benchmark").
+#
+# N tenants with ADVERSARIAL job sizes — one big low-priority fit per pair of
+# tenants, interleaved with bursts of small high-priority fits — submitted
+# through one `FitScheduler` against a budget sized so the big jobs cannot
+# co-reside with each other. What the lane measures is the scheduling plane
+# itself:
+#
+#   * utilization — byte-seconds reserved in the shared ledger over
+#     budget × wall (bin-packing efficiency: idle HBM is the waste this
+#     subsystem exists to reclaim);
+#   * per-tenant queue-wait p50/p99 — the fairness numbers (high-priority
+#     tenants should wait ~one checkpoint segment, never a whole big fit);
+#   * preemption/resume/demotion counts — the ladder actually exercising;
+#   * total fit throughput (rows/sec across every completed job) — the
+#     headline `@RESULT` value.
+#
+# Excluded from the gated geomean until the lane history stabilizes
+# (bench.py BASELINES carries no entry; trajectory-start gating in
+# benchmark/regression.py makes later promotion cheap).
+#
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .base import BenchmarkBase
+
+
+def _quantile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+    return float(ordered[idx])
+
+
+def run_scheduler_bench(
+    n_tenants: int = 4,
+    big_rows: int = 60_000,
+    n_cols: int = 32,
+    *,
+    small_rows: int = 2_000,
+    small_jobs_per_tenant: int = 3,
+    max_iter_big: int = 120,
+    max_iter_small: int = 10,
+    checkpoint_every: int = 3,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """One contention scenario (module docstring): even tenants submit one
+    big priority-0 fit each; odd tenants burst `small_jobs_per_tenant`
+    priority-10 fits that must bin-pack beside — or preempt — the big ones.
+    Returns utilization, per-tenant queue-wait quantiles, preemption counts,
+    and total rows/sec. Shared by the BenchmarkBase lane below and bench.py's
+    BENCH_SCHED lane."""
+    from spark_rapids_ml_tpu import core, memory, telemetry
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.scheduler import FitScheduler, reset_global_ledger
+
+    telemetry.enable()
+    rng = np.random.default_rng(seed)
+    x_big = rng.standard_normal((big_rows, n_cols), dtype=np.float32)
+    x_small = rng.standard_normal((small_rows, n_cols), dtype=np.float32)
+    df_big = {"features": x_big}
+    df_small = {"features": x_small}
+
+    def mk_big():
+        est = KMeans(k=16, maxIter=max_iter_big, tol=0.0, seed=7)
+        est.num_workers = 1
+        return est
+
+    def mk_small():
+        est = KMeans(k=4, maxIter=max_iter_small, seed=3)
+        est.num_workers = 1
+        return est
+
+    # budget: a big job fits ALONE but not beside even one small job — the
+    # adversarial shape: a high-priority small burst must preempt the running
+    # big fit (which resumes from its boundary checkpoint), and big jobs
+    # serialize against each other
+    ext_b = mk_big()._pre_process_data(df_big, for_fit=True, defer_validation=True)
+    need_b = memory.resident_estimate(mk_big(), ext_b, 1).total()
+    ext_s = mk_small()._pre_process_data(df_small, for_fit=True, defer_validation=True)
+    need_s = memory.resident_estimate(mk_small(), ext_s, 1).total()
+    saved = {
+        k: core.config[k]
+        for k in ("hbm_budget_bytes", "checkpoint_every_iters", "sched_max_preemptions")
+    }
+    core.config["hbm_budget_bytes"] = int((need_b + 0.5 * need_s) / 0.9)
+    core.config["checkpoint_every_iters"] = int(checkpoint_every)
+    core.config["sched_max_preemptions"] = 2
+
+    ledger = reset_global_ledger()
+    # budget-conformance samples: (reserved, budget) at EVERY admission
+    over = [0]
+
+    def _check(reserved: int, budget: Any) -> None:
+        if budget is not None and reserved > budget:
+            over[0] += 1
+
+    ledger.admission_hooks.append(_check)
+
+    sched = FitScheduler()
+    jobs = []
+    t0 = time.perf_counter()
+    try:
+        for t in range(n_tenants):
+            tenant = f"tenant{t}"
+            if t % 2 == 0:
+                jobs.append(
+                    (sched.submit(mk_big(), df_big, tenant=tenant, priority=0), big_rows)
+                )
+            else:
+                for _ in range(small_jobs_per_tenant):
+                    jobs.append(
+                        (
+                            sched.submit(
+                                mk_small(), df_small, tenant=tenant, priority=10
+                            ),
+                            small_rows,
+                        )
+                    )
+        for job, _ in jobs:
+            job.result(timeout=900)
+        wall = time.perf_counter() - t0
+        stats = sched.stats()
+        budget = core.config["hbm_budget_bytes"] * 0.9
+        # time-integrated utilization: byte-seconds each job held its
+        # reservation while running, over budget x wall
+        byte_seconds = sum(j.admitted_bytes * j.run_s for j, _ in jobs)
+        utilization = byte_seconds / (budget * wall) if budget and wall else 0.0
+        waits = [j.queue_wait_s for j, _ in jobs]
+        hi_waits = [j.queue_wait_s for j, _ in jobs if j.priority > 0]
+        per_tenant = {
+            name: {
+                "queue_wait_p50_s": _quantile(t_stats["queue_wait_s"], 0.50),
+                "queue_wait_p99_s": _quantile(t_stats["queue_wait_s"], 0.99),
+                "preemptions": t_stats["preemptions"],
+                "demotions": t_stats["demotions"],
+            }
+            for name, t_stats in stats["tenants"].items()
+        }
+        counters = telemetry.registry().snapshot()["counters"]
+        total_rows = float(sum(rows for _, rows in jobs))
+        out: Dict[str, float] = {
+            "fit": wall,
+            "wall_s": wall,
+            "jobs": float(len(jobs)),
+            "rows_per_sec": total_rows / wall,
+            "utilization": utilization,
+            "ledger_high_watermark": float(ledger.high_watermark),
+            "ledger_over_budget_admissions": float(over[0]),
+            "queue_wait_p50_s": _quantile(waits, 0.50),
+            "queue_wait_p99_s": _quantile(waits, 0.99),
+            "hi_priority_wait_p99_s": _quantile(hi_waits, 0.99),
+            "preemptions": float(counters.get("scheduler.jobs_preempted", 0.0)),
+            "resumes": float(counters.get("scheduler.jobs_resumed", 0.0)),
+            "demotions": float(counters.get("scheduler.jobs_demoted", 0.0)),
+        }
+        out["per_tenant"] = per_tenant  # type: ignore[assignment]
+        return out
+    finally:
+        sched.shutdown(wait=True, timeout=60)
+        ledger.admission_hooks.remove(_check)
+        core.config.update(saved)
+
+
+class BenchmarkScheduler(BenchmarkBase):
+    name = "scheduler"
+    extra_args = {
+        "tenants": (int, 4, "tenant count (even: big batch jobs; odd: small bursts)"),
+        "small_rows": (int, 2000, "rows per small high-priority job"),
+        "maxIter": (int, 120, "big-job solver iterations"),
+        "checkpoint_every": (int, 3, "preemption granularity (checkpoint cadence)"),
+    }
+
+    def gen_dataset(self, args, mesh) -> Dict[str, Any]:
+        # data is generated inside run_scheduler_bench: each tenant's jobs
+        # ingest independently — ingest contention is part of what the lane
+        # measures
+        return {}
+
+    def run_once(self, args, data, mesh) -> Dict[str, float]:
+        out = run_scheduler_bench(
+            args.tenants, args.num_rows, args.num_cols,
+            small_rows=args.small_rows, max_iter_big=args.maxIter,
+            checkpoint_every=args.checkpoint_every, seed=args.seed,
+        )
+        data["counters"] = {
+            k: v for k, v in out.items() if k not in ("fit", "per_tenant")
+        }
+        data["per_tenant"] = out.get("per_tenant", {})
+        return {"fit": out["fit"]}
+
+    def quality(self, args, data) -> Dict[str, float]:
+        # utilization + fairness + budget conformance: the lane's acceptance
+        # numbers (over_budget_admissions must stay 0)
+        return data.get("counters", {})
+
+
+if __name__ == "__main__":
+    BenchmarkScheduler().run()

@@ -24,6 +24,7 @@ from .bench_nearest_neighbors import BenchmarkNearestNeighbors
 from .bench_oocore import BenchmarkOOCore
 from .bench_pca import BenchmarkPCA
 from .bench_random_forest import BenchmarkRandomForest
+from .bench_scheduler import BenchmarkScheduler
 from .bench_serving import BenchmarkServing
 from .bench_umap import BenchmarkUMAP
 from .utils import log
@@ -32,6 +33,7 @@ ALGORITHMS = {
     "cv": BenchmarkCV,
     "ingest": BenchmarkIngest,
     "oocore": BenchmarkOOCore,
+    "scheduler": BenchmarkScheduler,
     "serving": BenchmarkServing,
     "pca": BenchmarkPCA,
     "kmeans": BenchmarkKMeans,

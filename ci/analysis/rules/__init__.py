@@ -14,6 +14,7 @@ from .distance import RawDistanceRule
 from .hostsync import HostSyncRule
 from .hygiene import KNOWN_WAIVER_TAGS, HygieneRule
 from .jsonl import JsonlRule
+from .ledger import LedgerBypassRule
 from .memstats import MemStatsRule
 from .padrows import PadRowsRule
 from .purity import TracedImpurityRule
@@ -40,6 +41,7 @@ def default_rules() -> List[RuleBase]:
         TracedImpurityRule(),
         RawDistanceRule(),
         ServeDispatchRule(),
+        LedgerBypassRule(),
         ConfigKeyRule(),
         MetricNameRule(),
     ]
@@ -64,6 +66,7 @@ __all__ = [
     "TracedImpurityRule",
     "RawDistanceRule",
     "ServeDispatchRule",
+    "LedgerBypassRule",
     "ConfigKeyRule",
     "MetricNameRule",
 ]

@@ -27,6 +27,7 @@ KNOWN_WAIVER_TAGS = {
     "metric",
     "distance",
     "serve",
+    "ledger",
 }
 
 

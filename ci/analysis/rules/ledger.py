@@ -1,0 +1,74 @@
+#
+# ledger-bypass: capacity math stays behind the shared HBM ledger
+# (docs/scheduling.md "The shared ledger").
+#
+# The admission controllers in memory.py and the scheduler are the ONLY
+# places allowed to decide what fits: they charge against capacity minus the
+# process-wide `scheduler.HbmLedger` and reserve what they admit. A direct
+# `admit_fit` / `admit_model_load` call elsewhere is an admission the ledger
+# lifecycle (reserve -> hold -> release) doesn't manage — its bytes either
+# never appear in the book (other tenants overshoot) or leak forever; a
+# direct `memory_stats()` is capacity read outside the budget/override/chaos
+# resolution (the split-brain the direct-memstats rule already polices —
+# re-checked here because a scheduler-era bypass breaks BOTH planes).
+# The two sanctioned call sites — core's fit entry and the serving
+# registry's load — carry `# ledger-ok: <reason>`; the baseline stays EMPTY.
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase, dotted
+
+_ADMISSION_CALLS = {"admit_fit", "admit_model_load"}
+
+
+class LedgerBypassRule(RuleBase):
+    id = "ledger-bypass"
+    waiver = "ledger"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    # the budgeter owns admission + capacity; telemetry.py is the sanctioned
+    # watermark sampler (same exemption as direct-memstats)
+    exempt_files = frozenset({"memory.py", "telemetry.py"})
+    description = (
+        "direct admit_fit/admit_model_load/memory_stats capacity math "
+        "outside memory.py and scheduler/"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        if not super().applies(ctx):
+            return False
+        # the scheduler package IS the ledger owner
+        return not ctx.relpath.startswith("spark_rapids_ml_tpu/scheduler/")
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Attribute):
+                # any attribute spelling: memory.admit_fit(...),
+                # _memory.admit_model_load(...), d.memory_stats()
+                if func.attr in _ADMISSION_CALLS or func.attr == "memory_stats":
+                    name = func.attr
+            elif isinstance(func, ast.Name):
+                # bare names only when the import resolves to the budgeter's
+                # functions — a local helper that happens to share the name
+                # is not an admission call
+                origin = ctx.imports.get(func.id, "")
+                tail = origin.rsplit(".", 1)[-1]
+                if tail in _ADMISSION_CALLS and "memory" in origin:
+                    name = tail
+            if name is None:
+                continue
+            ctx.emit(
+                self,
+                node,
+                f"direct `{name}` outside memory.py/scheduler/ — admission "
+                "and capacity math flow through the shared HBM ledger "
+                "(memory.admit_* reserve in scheduler.HbmLedger; releases "
+                "are owned by core/the registry/the scheduler). Route "
+                "through those layers or mark the sanctioned site "
+                "`# ledger-ok: <reason>` (docs/scheduling.md)",
+            )

@@ -12,8 +12,10 @@ __version__ = "0.1.0"
 from .errors import (  # noqa: F401
     HbmBudgetError,
     IngestValidationError,
+    PreemptedError,
     RankFailedError,
     RendezvousTimeoutError,
+    SchedulerSaturatedError,
     SolverDivergedError,
     SrmlError,
 )
@@ -29,6 +31,19 @@ def device_dataset_scope():
     return _scope()
 
 
+def __getattr__(name):
+    """Lazy re-export (PEP 562) of `scheduler.FitScheduler` — the
+    multi-tenant fit queue (priority submit, bin-packed co-admission,
+    checkpoint preemption over the shared HBM ledger; docs/scheduling.md).
+    The REAL class is returned, so isinstance/subclass/positional
+    construction behave identically to `scheduler.FitScheduler`."""
+    if name == "FitScheduler":
+        from .scheduler import FitScheduler
+
+        return FitScheduler
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "DenseVector",
     "SparseVector",
@@ -39,7 +54,10 @@ __all__ = [
     "SolverDivergedError",
     "IngestValidationError",
     "HbmBudgetError",
+    "PreemptedError",
+    "SchedulerSaturatedError",
     "device_dataset_scope",
+    "FitScheduler",
     "__version__",
 ]
 

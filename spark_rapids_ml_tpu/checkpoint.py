@@ -302,6 +302,13 @@ def run_segmented_while(
             # the bounded retry exercises the real resume-from-checkpoint
             # path instead of restarting the whole loop
             chaos.maybe_fail_stage("solve", it_after)
+            # cooperative scheduler preemption (docs/scheduling.md): same
+            # placement in the ladder as the chaos hooks — the boundary
+            # checkpoint is down, so yielding here loses zero work and the
+            # resumed job is bit-identical to an uninterrupted segmented run
+            from .scheduler.context import preemption_point
+
+            preemption_point(solver, it_after)
         if seg_end >= max_iter:
             break
     return state

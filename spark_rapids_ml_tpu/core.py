@@ -128,6 +128,16 @@ config: Dict[str, Any] = {
     # evicted beyond this, so a scope wrapped around a loop over FRESH
     # dataset objects cannot stack placements until HBM OOMs
     "device_dataset_cache_entries": 2,
+    # --- multi-tenant fit scheduler (docs/scheduling.md) -----------------
+    # preemptions one job may absorb before the scheduler demotes it to the
+    # out-of-core streaming path (a floor-chunk footprint that packs into
+    # almost any budget — degraded-mode service instead of starvation);
+    # estimators without a streaming path become non-preemptible instead
+    "sched_max_preemptions": 2,
+    # co-admitted jobs running concurrently at most, regardless of how many
+    # bin-pack into the ledger — bounds worker threads and per-job compile
+    # pressure (a fairness/safety knob, docs/scheduling.md)
+    "sched_max_concurrent": 4,
     # --- serving plane (docs/serving.md) ---------------------------------
     # how long the ScoringEngine holds a dispatched request open for
     # same-model coalescing (micro-batching up the bucket ladder): the
@@ -667,6 +677,30 @@ class _TpuCaller(_TpuCommon):
     # onto model._fit_metrics by _call_fit_func)
     _last_admission: Any = None
 
+    # this fit's live claim in the shared HBM ledger (scheduler.HbmLedger,
+    # docs/scheduling.md): one reservation spanning admission -> fit end,
+    # swapped on every re-admission (retry/recovery/OOM-demotion) and
+    # released in _call_fit_func's finally. None inside a scheduler job
+    # (the job's own reservation is resized instead) and between fits.
+    _fit_reservation: Any = None
+
+    # portable warm-start payload for the NEXT fit call (set by
+    # _TpuEstimator.fit(..., warm_start_from=...), consumed by the
+    # per-estimator fit closures, cleared in fit's finally)
+    _warm_start: Any = None
+
+    def _adopt_reservation(self, reservation: Any) -> None:
+        """Swap this fit's ledger claim: release the previous one (a retry's
+        or a prior fit's leftover — idempotent) and hold the new. Decisions
+        hand their reservation over here so the SHARED AdmissionDecision
+        objects cached on DeviceDatasets never carry a live claim."""
+        from .scheduler.ledger import global_ledger
+
+        old = self._fit_reservation
+        if old is not None:
+            global_ledger().release(old)
+        self._fit_reservation = reservation
+
     def _solver_workspace_terms(
         self, rows_per_device: int, n_cols: int, params: Dict[str, Any], itemsize: int
     ) -> Dict[str, int]:
@@ -821,8 +855,19 @@ class _TpuCaller(_TpuCommon):
         from .data import run_deferred_validation
         from .parallel import chaos
 
-        adm = _memory.admit_fit(self, extracted, ctx, force_stream=force_stream)
+        # hand back this fit call's PREVIOUS claim before re-admitting: a
+        # retry/recovery/OOM-demotion re-entry still holds the failed
+        # attempt's reservation, and the fresh admission must not count the
+        # fit's own doomed bytes against itself (a resident fit at ~0.9x
+        # budget would otherwise spuriously demote — or refuse — on retry)
+        self._adopt_reservation(None)
+        adm = _memory.admit_fit(self, extracted, ctx, force_stream=force_stream)  # ledger-ok: THE fit-side admission entry — reserves through the shared ledger
         self._last_admission = adm
+        # the admission's shared-ledger claim now belongs to THIS fit call
+        # (released in _call_fit_func's finally); the decision object itself
+        # may be cached on the DeviceDataset and must not carry a live claim
+        self._adopt_reservation(adm.reservation)
+        adm.reservation = None
         if adm.verdict == _memory.STREAM:
             if telemetry.enabled():
                 reg = telemetry.registry()
@@ -912,6 +957,7 @@ class _TpuCaller(_TpuCommon):
         CrossValidator fit performs exactly ONE ingest and ONE layout.
         Streamed (demoted) datasets are never cached; a cached entry is by
         construction a RESIDENT placement that already passed admission."""
+        from . import memory as _memory
         from . import telemetry
 
         scope = _DDS_SCOPE.get()
@@ -932,8 +978,14 @@ class _TpuCaller(_TpuCommon):
                 telemetry.registry().inc("fit.device_dataset_reuses")
                 if dds.admission is not None:
                     # a cache hit skipped _admit_and_layout: re-stamp the
-                    # verdict that admitted the reused placement
+                    # verdict that admitted the reused placement, and
+                    # re-reserve its bytes in the shared ledger (the
+                    # placement is physically held; serving loads and other
+                    # tenants must see it — docs/scheduling.md)
                     self._last_admission = dds.admission
+                    self._adopt_reservation(
+                        _memory.rereserve_admission(dds.admission)
+                    )
             else:
                 # host-retained re-placement (docs/robustness.md "Elastic
                 # recovery"): a cached entry for the SAME data on a DIFFERENT
@@ -1036,29 +1088,35 @@ class _TpuCaller(_TpuCommon):
         # trace_id + fit_id on every rank — under SPMD, rank 0 mints the id
         # and propagates it through one rendezvous round (docs/observability.md
         # "Trace correlation")
-        with diagnostics.trace_scope(
-            type(self).__name__, active
-        ), profile_cm, telemetry.fit_scope(
-            type(self).__name__
-        ) as tele_scope, telemetry.span(
-            "fit", logger=stage_logger, estimator=type(self).__name__
-        ):
-            # the whole traced fit (ingest -> layout -> solve) is ONE
-            # recoverable stage: a transient retry re-derives its state from
-            # the immutable dataset (bit-identical to an unfaulted fit —
-            # pinned by tests/test_chaos.py), and a rank loss on a
-            # reform-capable rendezvous opens a recovery epoch — the
-            # survivor mesh re-ingests from host-retained chunks and the
-            # solvers resume from the checkpoint store
-            rows = recoverable_stage(
-                lambda attempt: self._call_fit_func_traced(
-                    dataset, param_maps, logger, stage_logger, row_mask,
-                    attempt=attempt,
-                ),
-                stage="fit",
-                ctx=active,
-                logger=logger,
-            )
+        try:
+            with diagnostics.trace_scope(
+                type(self).__name__, active
+            ), profile_cm, telemetry.fit_scope(
+                type(self).__name__
+            ) as tele_scope, telemetry.span(
+                "fit", logger=stage_logger, estimator=type(self).__name__
+            ):
+                # the whole traced fit (ingest -> layout -> solve) is ONE
+                # recoverable stage: a transient retry re-derives its state from
+                # the immutable dataset (bit-identical to an unfaulted fit —
+                # pinned by tests/test_chaos.py), and a rank loss on a
+                # reform-capable rendezvous opens a recovery epoch — the
+                # survivor mesh re-ingests from host-retained chunks and the
+                # solvers resume from the checkpoint store
+                rows = recoverable_stage(
+                    lambda attempt: self._call_fit_func_traced(
+                        dataset, param_maps, logger, stage_logger, row_mask,
+                        attempt=attempt,
+                    ),
+                    stage="fit",
+                    ctx=active,
+                    logger=logger,
+                )
+        finally:
+            # the fit's shared-ledger claim ends with the fit — success,
+            # failure, or preemption (the workspace is gone; a scope-cached
+            # placement re-reserves on its next cache hit)
+            self._adopt_reservation(None)
         self._last_fit_metrics = tele_scope["metrics"]
         adm = getattr(self, "_last_admission", None)
         if (
@@ -1313,13 +1371,50 @@ class _TpuCaller(_TpuCommon):
 class _TpuEstimator(_TpuCaller):
     """Estimator base (reference `_CumlEstimator`, core.py:853-1074)."""
 
-    def fit(self, dataset: Any, params: Optional[Union[Dict, List[Dict]]] = None):
+    def fit(
+        self,
+        dataset: Any,
+        params: Optional[Union[Dict, List[Dict]]] = None,
+        warm_start_from: Any = None,
+    ):
+        """Fit on `dataset`. `warm_start_from` seeds the solver from a
+        previous result's PORTABLE iterate (docs/scheduling.md "Warm
+        starts") instead of a cold init: a fitted model of the same
+        estimator family (k-means centers, the GLM coefficient iterate) or a
+        `checkpoint.SolverCheckpoint` (the PR-6 portable subset — what a
+        preempted/recovered fit resumes from, now a public API). Estimators
+        whose solvers have no iterate to seed (closed-form linear/PCA,
+        DBSCAN/UMAP) raise `NotImplementedError`; a shape-mismatched donor
+        raises `ValueError`. Adoption is counted (``fit.warm_starts``) along
+        with the donor's already-paid iterations
+        (``fit.warm_start_iterations_saved``)."""
         if isinstance(params, (list, tuple)):
+            if warm_start_from is not None:
+                raise ValueError(
+                    "warm_start_from is a single-fit seed; combine it with "
+                    "one param dict, not a param-map list"
+                )
             return [m for _, m in sorted(self.fitMultiple(dataset, list(params)))]
         if isinstance(params, dict) and params:
-            return self.copy(params).fit(dataset)
-        models = self._fit_internal(dataset, None)
+            return self.copy(params).fit(dataset, warm_start_from=warm_start_from)
+        if warm_start_from is not None:
+            self._warm_start = self._resolve_warm_start(warm_start_from)
+        try:
+            models = self._fit_internal(dataset, None)
+        finally:
+            self._warm_start = None
         return models[0]
+
+    def _resolve_warm_start(self, source: Any) -> Dict[str, Any]:
+        """Per-estimator hook: extract the portable warm-start payload from
+        `source` (a fitted model or a `SolverCheckpoint`). Overridden by the
+        iterative estimators (KMeans, LogisticRegression); the default names
+        the gap instead of silently cold-starting."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support warm_start_from: its "
+            "solver has no portable iterate to seed (closed-form or "
+            "non-iterative fit)"
+        )
 
     def fitMultiple(self, dataset: Any, paramMaps: Sequence[Dict[Param, Any]]) -> "_FitMultipleIterator":
         """Train all param maps in ONE pass over the data (reference core.py:877-911)."""

@@ -19,13 +19,30 @@
 #   │                            callers can resume/diagnose
 #   ├── IngestValidationError    permanent — NaN/Inf found in an input column
 #   │                            (config["validate_ingest"]); names the column
-#   └── HbmBudgetError           permanent — the fit's working set cannot fit
-#                                device memory even on the out-of-core
-#                                streaming path (or a real backend OOM was
-#                                caught and the streaming retry is impossible
-#                                or also failed); carries the estimate, the
-#                                capacity, and the largest term so the fix
-#                                points at WHAT doesn't fit
+#   ├── HbmBudgetError           permanent — the fit's working set cannot fit
+#   │                            device memory even on the out-of-core
+#   │                            streaming path (or a real backend OOM was
+#   │                            caught and the streaming retry is impossible
+#   │                            or also failed); carries the estimate, the
+#   │                            capacity, and the largest term so the fix
+#   │                            points at WHAT doesn't fit
+#   ├── PreemptedError           internal — the multi-tenant scheduler asked
+#   │                            a running fit to yield at its next solver
+#   │                            segment boundary; TRANSIENT from the
+#   │                            tenant's view (the job requeues and resumes
+#   │                            from its checkpoint) but never retried in
+#   │                            place, so `is_transient` stays False —
+#   │                            the scheduler, not `retryable_stage`, owns
+#   │                            the resume
+#   └── SchedulerSaturatedError  permanent — a submitted job's SMALLEST
+#                                possible footprint (the streaming floor, or
+#                                the resident estimate when the estimator
+#                                has no out-of-core path) exceeds the whole
+#                                HBM budget: no amount of queueing or
+#                                preemption can ever place it. Mirrors
+#                                `HbmBudgetError`: carries the estimate, the
+#                                budget, and the largest term so the refusal
+#                                names WHAT doesn't fit
 #
 # Multiple inheritance keeps old call sites working: RendezvousTimeoutError
 # IS-A TimeoutError (FileRendezvous raised bare TimeoutError before),
@@ -42,6 +59,8 @@ __all__ = [
     "SolverDivergedError",
     "IngestValidationError",
     "HbmBudgetError",
+    "PreemptedError",
+    "SchedulerSaturatedError",
     "is_transient",
 ]
 
@@ -218,14 +237,100 @@ class HbmBudgetError(SrmlError, MemoryError):
         super().__init__(" ".join(parts))
 
 
+class PreemptedError(SrmlError):
+    """The multi-tenant fit scheduler (`spark_rapids_ml_tpu/scheduler/`,
+    docs/scheduling.md) asked this fit to yield: a higher-priority job needs
+    its HBM reservation. Raised COOPERATIVELY — only at a solver segment
+    boundary (``config["checkpoint_every_iters"]``), immediately AFTER the
+    boundary's `SolverCheckpoint` landed in the job's store — so the fit
+    unwinds with zero lost work and a later re-admission resumes
+    bit-identically on the same mesh.
+
+    Internal and transient FROM THE TENANT'S VIEW (the job requeues; its
+    future still resolves), but deliberately NOT `is_transient`: an in-place
+    `retryable_stage` retry would re-enter the solve while the scheduler is
+    trying to free its reservation. The scheduler's job runner is the one
+    sanctioned catcher."""
+
+    def __init__(
+        self,
+        job_id: int,
+        *,
+        solver: str = "",
+        iteration: int = 0,
+        reason: str = "",
+    ):
+        # attributes BEFORE super().__init__: the flight-recorder hook fires
+        # inside it and records whatever diagnostic fields are already set
+        self.job_id = int(job_id)
+        self.solver = solver
+        self.iteration = int(iteration)
+        self.reason = reason
+        at = f" at {solver} iteration {iteration}" if solver else ""
+        super().__init__(
+            f"job {job_id} preempted{at}: "
+            f"{reason or 'higher-priority job needs the reservation'}"
+        )
+
+
+class SchedulerSaturatedError(SrmlError, MemoryError):
+    """A job submitted to the multi-tenant fit scheduler can NEVER be placed:
+    its smallest possible working set — the streaming floor for estimators
+    with an out-of-core path, the resident estimate otherwise — exceeds the
+    whole per-device budget even with every other job drained. PERMANENT,
+    refused at `FitScheduler.submit` so the tenant learns immediately
+    instead of queueing forever. Mirrors `HbmBudgetError`'s accounting:
+    ``estimate_bytes`` / ``budget_bytes`` / ``largest_term`` /
+    ``largest_term_bytes`` / ``terms`` name WHAT doesn't fit
+    (docs/scheduling.md)."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        tenant: Optional[str] = None,
+        estimate_bytes: Optional[int] = None,
+        budget_bytes: Optional[int] = None,
+        largest_term: Optional[str] = None,
+        largest_term_bytes: Optional[int] = None,
+        terms: Optional[Dict[str, int]] = None,
+    ):
+        # attributes BEFORE super().__init__ (flight-recorder contract above)
+        self.tenant = tenant
+        self.estimate_bytes = None if estimate_bytes is None else int(estimate_bytes)
+        self.budget_bytes = None if budget_bytes is None else int(budget_bytes)
+        self.largest_term = largest_term
+        self.largest_term_bytes = (
+            None if largest_term_bytes is None else int(largest_term_bytes)
+        )
+        self.terms: Dict[str, int] = dict(terms) if terms else {}
+        parts = [message]
+        if estimate_bytes is not None and budget_bytes is not None:
+            parts.append(
+                f"(minimal working set {self.estimate_bytes} bytes/device "
+                f"against a {self.budget_bytes}-byte budget)"
+            )
+        if largest_term is not None:
+            parts.append(
+                f"[largest term: {largest_term}"
+                + (
+                    f" = {self.largest_term_bytes} bytes]"
+                    if largest_term_bytes is not None
+                    else "]"
+                )
+            )
+        super().__init__(" ".join(parts))
+
+
 def is_transient(exc: BaseException) -> bool:
     """Whether the fit driver may retry the stage after this error.
 
     Transient today: rendezvous round timeouts (symmetric — every rank
     unwinds together) and the distributed-init race (two fits standing up
     `jax.distributed` concurrently; the loser sees an 'already initialized'
-    RuntimeError and succeeds on retry). `RankFailedError` and
-    `SolverDivergedError` are deliberately NOT transient."""
+    RuntimeError and succeeds on retry). `RankFailedError`,
+    `SolverDivergedError`, and `PreemptedError` (the scheduler owns that
+    resume, not the in-place retry loop) are deliberately NOT transient."""
     if isinstance(exc, RendezvousTimeoutError):
         return True
     if isinstance(exc, RuntimeError) and not isinstance(exc, SrmlError):

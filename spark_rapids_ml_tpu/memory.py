@@ -39,6 +39,16 @@
 # `memory_stats()` owner — the ci/analysis gate forbids direct calls elsewhere in the
 # framework (`# hbm-ok` waiver).
 #
+# SHARED LEDGER (docs/scheduling.md "The shared ledger"): both admission
+# controllers here — `admit_fit` and `admit_model_load` — charge against the
+# budget MINUS what the process-wide `scheduler.HbmLedger` already holds, and
+# every admission reserves its estimate there. A fit running next to resident
+# serving models (or other co-admitted fits) can no longer jointly overshoot
+# HBM: the fit sees the models' reserved bytes and demotes/refuses
+# accordingly, and vice versa. The companion ci/analysis rule `ledger-bypass`
+# keeps capacity math in this module and `scheduler/` (`# ledger-ok` waiver
+# at the two sanctioned call sites).
+#
 from __future__ import annotations
 
 from dataclasses import dataclass, field
@@ -90,6 +100,12 @@ class AdmissionDecision:
     chunk_rows: int = 0
     reason: str = ""
     demoted: bool = False
+    # the shared-ledger claim backing this admission (scheduler.HbmReservation),
+    # or None when a scheduler job owns the claim (the job's reservation was
+    # RESIZED instead — the scheduler releases it at job end). Fit-side claims
+    # are released by the fit driver's finally (core._call_fit_func); serving
+    # claims by ModelRegistry eviction.
+    reservation: Any = None
 
     def stamp(self) -> Dict[str, Any]:
         """The JSON-able summary `core` stamps onto ``model._fit_metrics``."""
@@ -219,7 +235,9 @@ def streaming_estimate(
     return est
 
 
-def device_capacity_bytes(mesh: Any = None, devices: Any = None) -> Optional[int]:
+def device_capacity_bytes(
+    mesh: Any = None, devices: Any = None, *, consume_chaos: bool = True
+) -> Optional[int]:
     """Per-device HBM capacity the admission check budgets against.
 
     Resolution order: chaos-injected budget (`oom:budget=` fault — the
@@ -228,13 +246,17 @@ def device_capacity_bytes(mesh: Any = None, devices: Any = None) -> Optional[int
     ``Device.memory_stats()['bytes_limit']`` over the mesh devices (or the
     explicit `devices` list — the serving plane budgets its one local device
     without standing up a mesh). Returns None when nothing is known (CPU
-    backend, no override) — no budgeting."""
+    backend, no override) — no budgeting. ``consume_chaos=False`` skips the
+    injected-budget probe WITHOUT spending a plan firing — the scheduler's
+    bin-packing passes read capacity many times per admission, and each
+    `oom:budget=` entry must demote exactly `times` FIT admissions."""
     from .core import config
     from .parallel import chaos
 
-    injected = chaos.injected_hbm_budget()
-    if injected is not None:
-        return int(injected)
+    if consume_chaos:
+        injected = chaos.injected_hbm_budget()
+        if injected is not None:
+            return int(injected)
     override = config.get("hbm_budget_bytes")
     if override:
         return int(override)
@@ -284,14 +306,25 @@ def admit_fit(
 ) -> AdmissionDecision:
     """Issue the admission verdict for one fit (see module docstring).
 
+    Budgets against the capacity MINUS what the shared `scheduler.HbmLedger`
+    already holds (resident serving models, co-admitted fits), and reserves
+    the admitted estimate there — under the ledger's admission lock, so
+    concurrent admissions cannot both claim the same free bytes. Inside a
+    scheduler job (`scheduler.context.current_job`) the job's queue-time
+    reservation is RESIZED instead of duplicated, and a job demoted after
+    repeated preemption is force-streamed.
+
     Raises `HbmBudgetError` — naming the largest term — when even the
-    streaming working set exceeds the budget, when the estimator has no
-    out-of-core path, or when the fit runs under multi-process SPMD (the
-    streaming pipeline is single-controller; an SPMD over-budget fit must
-    fail typed rather than OOM the clique). `force_stream` is the OOM-retry
-    entry: skip the resident check and admit the streaming path (capacity
-    may be unknown — a real allocation failure is evidence enough)."""
+    streaming working set exceeds the remaining budget, when the estimator
+    has no out-of-core path, or when the fit runs under multi-process SPMD
+    (the streaming pipeline is single-controller; an SPMD over-budget fit
+    must fail typed rather than OOM the clique). `force_stream` is the
+    OOM-retry entry: skip the resident check and admit the streaming path
+    (capacity may be unknown — a real allocation failure is evidence
+    enough)."""
     from . import telemetry
+    from .scheduler import context as _sched_ctx
+    from .scheduler.ledger import global_ledger
 
     mesh = ctx.mesh
     n_devices = int(mesh.devices.size)
@@ -302,105 +335,146 @@ def admit_fit(
     if telemetry.enabled() and capacity is not None:
         telemetry.registry().gauge("memory.capacity_bytes", capacity)
 
-    if not force_stream:
-        res = resident_estimate(estimator, extracted, n_devices)
-        if telemetry.enabled():
-            telemetry.registry().gauge("memory.estimate_bytes", res.total())
-        if budget is None or res.total() <= budget:
+    led = global_ledger()
+    job = _sched_ctx.current_job()
+    sched_demoted = job is not None and getattr(job, "demote_to_stream", False)
+    if sched_demoted:
+        force_stream = True
+    job_res = getattr(job, "reservation", None) if job is not None else None
+
+    with led.admission():
+        held = led.reserved_bytes(exclude=job_res) if budget is not None else 0
+        avail = None if budget is None else max(0, budget - held)
+        held_note = (
+            f" ({held} bytes/device already reserved in the shared ledger "
+            "by other fits/serving models)"
+            if held
+            else ""
+        )
+
+        def _grant(est_obj, verdict, chunk_rows=0, reason="", demoted=False):
+            """Record the admitted claim in the shared ledger and build the
+            decision. Job-owned claims resize; standalone fits reserve."""
+            if job_res is not None:
+                led.resize(job_res, est_obj.total())
+                reservation = None  # the scheduler releases the job's claim
+            else:
+                reservation = led.reserve(
+                    f"fit:{type(estimator).__name__}", "fit", est_obj.total()
+                )
+            led.note_admission(budget)
             return AdmissionDecision(
-                verdict=RESIDENT,
-                estimate=res,
+                verdict=verdict,
+                estimate=est_obj,
                 capacity_bytes=capacity,
                 budget_bytes=budget,
-                reason="fits" if budget is not None else "no capacity information",
+                chunk_rows=int(chunk_rows),
+                reason=reason,
+                demoted=demoted,
+                reservation=reservation,
             )
-        reason = (
-            f"resident working set {res.total()} bytes/device exceeds the "
-            f"{budget}-byte budget"
-        )
-    else:
+
+        def _refuse(exc):
+            led.note_admission(budget)  # refusals fire the admission hooks too
+            raise exc
+
         res = resident_estimate(estimator, extracted, n_devices)
-        reason = "backend OOM caught; retrying out-of-core"
-
-    # ---- the streaming side of the ladder --------------------------------
-    if not getattr(estimator, "_supports_streaming_fit", False):
-        name, nbytes = res.largest()
-        raise HbmBudgetError(
-            f"{type(estimator).__name__} fit does not fit device memory and "
-            "has no out-of-core streaming path",
-            estimate_bytes=res.total(),
-            capacity_bytes=budget,
-            largest_term=name,
-            largest_term_bytes=nbytes,
-            terms=res.terms,
-        )
-    if ctx is not None and getattr(ctx, "is_spmd", False):
-        name, nbytes = res.largest()
-        raise HbmBudgetError(
-            f"{type(estimator).__name__} fit does not fit device memory; the "
-            "out-of-core streaming path is single-controller only (multi-"
-            "process SPMD fits must fit resident)",
-            estimate_bytes=res.total(),
-            capacity_bytes=budget,
-            largest_term=name,
-            largest_term_bytes=nbytes,
-            terms=res.terms,
-        )
-
-    dtype = np.float32 if getattr(estimator, "_float32_inputs", True) else np.float64
-    rb = row_bytes(extracted, dtype)
-    chunk_rows = _configured_chunk_rows()
-    if chunk_rows <= 0:
-        if budget is None:
-            chunk_rows = DEFAULT_STREAM_CHUNK_ROWS
+        if not force_stream:
+            if telemetry.enabled():
+                telemetry.registry().gauge("memory.estimate_bytes", res.total())
+            if avail is None or res.total() <= avail:
+                return _grant(
+                    res, RESIDENT,
+                    reason="fits" if budget is not None else "no capacity information",
+                )
+            reason = (
+                f"resident working set {res.total()} bytes/device exceeds the "
+                f"{budget}-byte budget{held_note}"
+            )
+        elif sched_demoted:
+            reason = (
+                "scheduler demotion: preempted "
+                f"{getattr(job, 'preemptions', 0)} time(s) "
+                "(config['sched_max_preemptions'])"
+            )
         else:
-            # size against the floor-chunk workspace (row-scaling workspace
-            # terms grow with the chunk; the post-sizing check below shrinks
-            # back toward the floor if the chosen chunk's full estimate
-            # overshoots)
-            floor_dev = rows_per_device(
-                min(MIN_STREAM_CHUNK_ROWS, max(1, int(extracted.n_rows))), n_devices
-            )
-            ws = workspace_estimate(
-                estimator, extracted, n_devices, rows_dev=floor_dev
-            ).total()
-            avail = budget - ws
-            # two in-flight chunks per device; chunk rows are a whole-chunk
-            # (all-devices) count, so a device holds chunk_rows/n_devices rows
-            chunk_rows = max(
-                MIN_STREAM_CHUNK_ROWS, (avail // (2 * rb)) * n_devices if avail > 0 else 0
-            )
-    chunk_rows = max(1, min(int(chunk_rows), max(1, int(extracted.n_rows))))
+            reason = "backend OOM caught; retrying out-of-core"
 
-    stream = streaming_estimate(estimator, extracted, n_devices, chunk_rows)
-    if budget is not None and stream.total() > budget:
-        # shrink toward the floor before giving up: the chunk size is the only
-        # knob the admission controller owns
-        floor = min(MIN_STREAM_CHUNK_ROWS, chunk_rows)
-        stream_floor = streaming_estimate(estimator, extracted, n_devices, floor)
-        if stream_floor.total() > budget:
-            name, nbytes = stream_floor.largest()
-            raise HbmBudgetError(
+        # ---- the streaming side of the ladder ----------------------------
+        if not getattr(estimator, "_supports_streaming_fit", False):
+            name, nbytes = res.largest()
+            _refuse(HbmBudgetError(
                 f"{type(estimator).__name__} fit does not fit device memory "
-                "even on the out-of-core streaming path",
-                estimate_bytes=stream_floor.total(),
+                f"and has no out-of-core streaming path{held_note}",
+                estimate_bytes=res.total(),
                 capacity_bytes=budget,
                 largest_term=name,
                 largest_term_bytes=nbytes,
-                terms=stream_floor.terms,
-            )
-        chunk_rows, stream = floor, stream_floor
-    if telemetry.enabled():
-        telemetry.registry().gauge("memory.estimate_bytes", stream.total())
-    return AdmissionDecision(
-        verdict=STREAM,
-        estimate=stream,
-        capacity_bytes=capacity,
-        budget_bytes=budget,
-        chunk_rows=int(chunk_rows),
-        reason=reason,
-        demoted=True,
-    )
+                terms=res.terms,
+            ))
+        if ctx is not None and getattr(ctx, "is_spmd", False):
+            name, nbytes = res.largest()
+            _refuse(HbmBudgetError(
+                f"{type(estimator).__name__} fit does not fit device memory; "
+                "the out-of-core streaming path is single-controller only "
+                "(multi-process SPMD fits must fit resident)",
+                estimate_bytes=res.total(),
+                capacity_bytes=budget,
+                largest_term=name,
+                largest_term_bytes=nbytes,
+                terms=res.terms,
+            ))
+
+        dtype = np.float32 if getattr(estimator, "_float32_inputs", True) else np.float64
+        rb = row_bytes(extracted, dtype)
+        chunk_rows = _configured_chunk_rows()
+        if chunk_rows <= 0:
+            if avail is None:
+                chunk_rows = DEFAULT_STREAM_CHUNK_ROWS
+            else:
+                # size against the floor-chunk workspace (row-scaling
+                # workspace terms grow with the chunk; the post-sizing check
+                # below shrinks back toward the floor if the chosen chunk's
+                # full estimate overshoots)
+                floor_dev = rows_per_device(
+                    min(MIN_STREAM_CHUNK_ROWS, max(1, int(extracted.n_rows))), n_devices
+                )
+                ws = workspace_estimate(
+                    estimator, extracted, n_devices, rows_dev=floor_dev
+                ).total()
+                room = avail - ws
+                # two in-flight chunks per device; chunk rows are a whole-chunk
+                # (all-devices) count, so a device holds chunk_rows/n_devices rows
+                chunk_rows = max(
+                    MIN_STREAM_CHUNK_ROWS,
+                    (room // (2 * rb)) * n_devices if room > 0 else 0,
+                )
+        chunk_rows = max(1, min(int(chunk_rows), max(1, int(extracted.n_rows))))
+
+        stream = streaming_estimate(estimator, extracted, n_devices, chunk_rows)
+        if avail is not None and stream.total() > avail:
+            # shrink toward the floor before giving up: the chunk size is the
+            # only knob the admission controller owns
+            floor = min(MIN_STREAM_CHUNK_ROWS, chunk_rows)
+            stream_floor = streaming_estimate(estimator, extracted, n_devices, floor)
+            if stream_floor.total() > avail:
+                name, nbytes = stream_floor.largest()
+                _refuse(HbmBudgetError(
+                    f"{type(estimator).__name__} fit does not fit device "
+                    "memory even on the out-of-core streaming "
+                    f"path{held_note}",
+                    estimate_bytes=stream_floor.total(),
+                    capacity_bytes=budget,
+                    largest_term=name,
+                    largest_term_bytes=nbytes,
+                    terms=stream_floor.terms,
+                ))
+            chunk_rows, stream = floor, stream_floor
+        if telemetry.enabled():
+            telemetry.registry().gauge("memory.estimate_bytes", stream.total())
+        return _grant(
+            stream, STREAM, chunk_rows=chunk_rows, reason=reason, demoted=True
+        )
 
 
 # ------------------------------------------------------- serving plane ------
@@ -441,9 +515,19 @@ def admit_model_load(
     the REMAINING budget. There is no streaming demotion for serving (a
     model either resides or the load is refused typed), so the two verdicts
     are RESIDENT or a raised `HbmBudgetError` naming the largest term; the
-    caller (serving.ModelRegistry) may evict LRU residents and retry."""
+    caller (serving.ModelRegistry) may evict LRU residents and retry.
+
+    Charges against the budget MINUS the shared ledger's held bytes — a
+    concurrently running fit's placement + workspace now counts against a
+    model load exactly as resident models count against fits (the
+    shared-ledger contract, docs/scheduling.md) — and reserves the admitted
+    estimate there (kind "serve", released by the registry on eviction).
+    `resident_bytes` remains for callers outside the registry that account
+    residents themselves; the registry passes 0 (its residents already hold
+    ledger reservations)."""
     from . import telemetry
     from .core import config
+    from .scheduler.ledger import global_ledger
 
     if bucket_rows_count is None:
         bucket_rows_count = int(config.get("serve_max_batch_rows", 8192))
@@ -451,27 +535,68 @@ def admit_model_load(
     budget = (
         None if capacity is None else int(capacity * (1.0 - headroom_fraction()))
     )
-    est = model_serve_estimate(model, bucket_rows_count)
-    if telemetry.enabled():
-        telemetry.registry().gauge("memory.serve_estimate_bytes", est.total())
-    if budget is None or est.total() + int(resident_bytes) <= budget:
-        return AdmissionDecision(
-            verdict=RESIDENT,
-            estimate=est,
-            capacity_bytes=capacity,
-            budget_bytes=budget,
-            reason="fits" if budget is not None else "no capacity information",
+    led = global_ledger()
+    with led.admission():
+        held = led.reserved_bytes() if budget is not None else 0
+        est = model_serve_estimate(model, bucket_rows_count)
+        if telemetry.enabled():
+            telemetry.registry().gauge("memory.serve_estimate_bytes", est.total())
+        if budget is None or est.total() + int(resident_bytes) + held <= budget:
+            reservation = led.reserve(
+                f"serve:{type(model).__name__}", "serve", est.total()
+            )
+            led.note_admission(budget)
+            return AdmissionDecision(
+                verdict=RESIDENT,
+                estimate=est,
+                capacity_bytes=capacity,
+                budget_bytes=budget,
+                reason="fits" if budget is not None else "no capacity information",
+                reservation=reservation,
+            )
+        led.note_admission(budget)
+        name, nbytes = est.largest()
+        raise HbmBudgetError(
+            f"{type(model).__name__} load does not fit the serving budget "
+            f"({int(resident_bytes)} bytes already resident, {held} "
+            "bytes/device held in the shared ledger)",
+            estimate_bytes=est.total(),
+            capacity_bytes=budget,
+            largest_term=name,
+            largest_term_bytes=nbytes,
+            terms=est.terms,
         )
-    name, nbytes = est.largest()
-    raise HbmBudgetError(
-        f"{type(model).__name__} load does not fit the serving budget "
-        f"({int(resident_bytes)} bytes already resident)",
-        estimate_bytes=est.total(),
-        capacity_bytes=budget,
-        largest_term=name,
-        largest_term_bytes=nbytes,
-        terms=est.terms,
-    )
+
+
+def release_admission(adm: Optional[AdmissionDecision]) -> None:
+    """Return an admission's shared-ledger claim (idempotent; None-safe for
+    `finally` blocks). No-op for job-owned admissions (their `reservation`
+    is None — the scheduler releases the job's claim at job end)."""
+    if adm is None or adm.reservation is None:
+        return
+    from .scheduler.ledger import global_ledger
+
+    global_ledger().release(adm.reservation)
+    adm.reservation = None
+
+
+def rereserve_admission(adm: AdmissionDecision, owner: str = "fit:cache-hit"):
+    """Shared-ledger claim for a fit served from the device-dataset scope
+    CACHE (the placement physically exists; a cache hit skips `admit_fit`).
+    Bookkeeping-only — no budget check: the bytes are already held, so the
+    honest move is to record them, and later admissions will see them.
+    Inside a scheduler job the job's reservation is resized instead and
+    None is returned (job-owned)."""
+    from .scheduler import context as _sched_ctx
+    from .scheduler.ledger import global_ledger
+
+    led = global_ledger()
+    job = _sched_ctx.current_job()
+    job_res = getattr(job, "reservation", None) if job is not None else None
+    if job_res is not None:
+        led.resize(job_res, adm.estimate.total())
+        return None
+    return led.reserve(owner, "fit", adm.estimate.total())
 
 
 # ------------------------------------------------------------------ OOM -----

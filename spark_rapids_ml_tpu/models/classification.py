@@ -437,6 +437,50 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             lbfgs_memory=int(params["lbfgs_memory"]),
         )
 
+    def _resolve_warm_start(self, source: Any) -> Dict[str, Any]:
+        """Warm-start payload for `fit(..., warm_start_from=...)`: a fitted
+        `LogisticRegressionModel`'s original-space (coef_, intercept_)
+        iterate, or a `SolverCheckpoint` carrying one. GLM segment
+        checkpoints store the STANDARDIZED flat iterate — dataset-specific
+        scaling, not portable across fits — so those are rejected with a
+        pointer at the model route (the scheduler resumes them through the
+        checkpoint store instead, where the placement is pinned equal)."""
+        from .. import checkpoint as _ckpt
+
+        if isinstance(source, _ckpt.SolverCheckpoint):
+            st = dict(source.portable or {})
+            st.update({k: v for k, v in (source.state or {}).items() if k not in st})
+            if "coef_" not in st:
+                raise ValueError(
+                    "SolverCheckpoint warm start for LogisticRegression needs "
+                    "an original-space 'coef_' payload; GLM segment "
+                    "checkpoints carry the standardized iterate (dataset-"
+                    "specific) — warm-start from the fitted model instead"
+                )
+            coef = np.asarray(st["coef_"])
+            return {
+                "coef_": coef,
+                "intercept_": np.asarray(
+                    st.get("intercept_", np.zeros(coef.shape[0], coef.dtype))
+                ),
+                "n_iter_": int(st.get("n_iter_", source.iteration) or 0),
+            }
+        coef = getattr(source, "coef_", None)
+        if coef is None:
+            raise TypeError(
+                f"cannot warm-start LogisticRegression from "
+                f"{type(source).__name__}: expected a fitted "
+                "LogisticRegressionModel or a SolverCheckpoint"
+            )
+        coef = np.asarray(coef)
+        return {
+            "coef_": coef,
+            "intercept_": np.asarray(
+                getattr(source, "intercept_", np.zeros(coef.shape[0], coef.dtype))
+            ),
+            "n_iter_": int(np.max(getattr(source, "n_iter_", 0)) or 0),
+        }
+
     def _get_tpu_fit_func(self, extracted: ExtractedData):
         from .. import checkpoint as _ckpt
         from ..ops.logistic import (
@@ -467,6 +511,34 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 use_l1=alpha * l1_ratio > 0,
                 **self._solver_statics(params),
             )
+            # public warm start (fit(..., warm_start_from=...),
+            # docs/scheduling.md "Warm starts"): seed the L-BFGS/OWL-QN
+            # iterate from the donor's original-space coefficients — the
+            # solver rebuilds the standardized flat iterate via the exact
+            # inverse of its own fold-out (ops/logistic._warm_x0)
+            warm_tuple = None
+            _warm = getattr(self, "_warm_start", None)
+            if _warm is not None:
+                k_out = len(classes) if multinomial else 1
+                wcoef = np.asarray(_warm["coef_"])
+                if tuple(wcoef.shape) != (k_out, int(inputs.n_cols)):
+                    raise ValueError(
+                        f"warm-start coef shape {tuple(wcoef.shape)} does not "
+                        f"match this fit (k_out={k_out}, d={inputs.n_cols})"
+                    )
+                from .. import telemetry as _telemetry
+
+                if _telemetry.enabled():
+                    reg = _telemetry.registry()
+                    reg.inc("fit.warm_starts")
+                    reg.inc(
+                        "fit.warm_start_iterations_saved",
+                        int(_warm.get("n_iter_", 0) or 0),
+                    )
+                warm_tuple = (
+                    wcoef.astype(inputs.dtype),
+                    np.asarray(_warm["intercept_"]).astype(inputs.dtype),
+                )
             # elastic recovery: with a checkpoint cadence configured and a
             # store installed by the enclosing recoverable stage, the solver
             # loop runs host-segmented so an interrupted fit resumes from
@@ -490,11 +562,14 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 fit_fn = logistic_fit_ell_checkpointed if use_ckpt else logistic_fit_ell
                 state = fit_fn(
                     ell_val, ell_idx, y_idx, w_dev, d=inputs.n_cols,
-                    **common, **ckpt_common,
+                    warm_start=warm_tuple, **common, **ckpt_common,
                 )
             else:
                 fit_fn = logistic_fit_checkpointed if use_ckpt else logistic_fit
-                state = fit_fn(inputs.X, y_idx, inputs.w, **common, **ckpt_common)
+                state = fit_fn(
+                    inputs.X, y_idx, inputs.w, warm_start=warm_tuple,
+                    **common, **ckpt_common,
+                )
             # ONE device->host fetch of the whole result, then the divergence
             # guard runs on the already-fetched scalars (no extra sync)
             state = {k: np.asarray(v) for k, v in state.items()}

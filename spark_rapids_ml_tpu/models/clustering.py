@@ -160,6 +160,36 @@ class KMeans(_KMeansParams, _TpuEstimator):
     def setWeightCol(self, value: str) -> "KMeans":
         return self._set_params(weightCol=value)
 
+    def _resolve_warm_start(self, source: Any) -> Dict[str, Any]:
+        """Warm-start payload for `fit(..., warm_start_from=...)`: a fitted
+        `KMeansModel`'s centers, or a `SolverCheckpoint`'s portable center
+        subset (the PR-6 elastic-recovery iterate, public API here)."""
+        from .. import checkpoint as _ckpt
+
+        if isinstance(source, _ckpt.SolverCheckpoint):
+            centers = (source.portable or {}).get(
+                "centers", (source.state or {}).get("centers")
+            )
+            if centers is None:
+                raise ValueError(
+                    "SolverCheckpoint warm start for KMeans needs a "
+                    "'centers' payload (k-means checkpoints carry one)"
+                )
+            return {
+                "cluster_centers_": np.asarray(centers),
+                "n_iter_": int(source.iteration),
+            }
+        centers = getattr(source, "cluster_centers_", None)
+        if centers is None:
+            raise TypeError(
+                f"cannot warm-start KMeans from {type(source).__name__}: "
+                "expected a fitted KMeansModel or a SolverCheckpoint"
+            )
+        return {
+            "cluster_centers_": np.asarray(centers),
+            "n_iter_": int(getattr(source, "n_iter_", 0) or 0),
+        }
+
     def _get_tpu_fit_func(self, extracted: ExtractedData):
         from ..ops.kmeans import (
             kmeans_fit,
@@ -177,6 +207,30 @@ class KMeans(_KMeansParams, _TpuEstimator):
                 raise ValueError(f"k={k} exceeds number of rows {inputs.n_valid}")
             init_mode = params.get("init", "scalable-k-means++")
             seed = int(params.get("random_state", 1) or 1)
+            # public warm start (fit(..., warm_start_from=model_or_checkpoint),
+            # docs/scheduling.md "Warm starts"): the donor's centers ARE the
+            # init — the seeding passes below are skipped entirely, and Lloyd
+            # continues the donor's trajectory (adoption + the donor's
+            # already-paid iterations are counted)
+            warm = getattr(self, "_warm_start", None)
+            warm_centers = None
+            if warm is not None:
+                c0 = np.asarray(warm["cluster_centers_"])
+                if tuple(c0.shape) != (k, int(inputs.n_cols)):
+                    raise ValueError(
+                        f"warm-start centers shape {tuple(c0.shape)} does not "
+                        f"match this fit (k={k}, d={inputs.n_cols})"
+                    )
+                from .. import telemetry as _telemetry
+
+                if _telemetry.enabled():
+                    reg = _telemetry.registry()
+                    reg.inc("fit.warm_starts")
+                    reg.inc(
+                        "fit.warm_start_iterations_saved",
+                        int(warm.get("n_iter_", 0) or 0),
+                    )
+                warm_centers = c0
             # under multi-process SPMD the init must be computed from GLOBAL
             # rows: every rank contributes a bounded sample (the whole local
             # block when small), the rendezvous concatenates them in rank
@@ -184,7 +238,7 @@ class KMeans(_KMeansParams, _TpuEstimator):
             # so all ranks enter the Lloyd loop with identical centers (the
             # reference's distributed k-means|| init runs inside KMeansMG)
             x_init, w_init = x_host, w_host
-            if inputs.ctx is not None and inputs.ctx.is_spmd:
+            if warm_centers is None and inputs.ctx is not None and inputs.ctx.is_spmd:
                 cap = max(4 * k, 262_144 // inputs.ctx.nranks)
                 n_loc = x_host.shape[0]
                 if n_loc > cap:
@@ -197,7 +251,9 @@ class KMeans(_KMeansParams, _TpuEstimator):
                     ws = None if w_host is None else np.asarray(w_host, dtype=np.float64)
                 x_init = inputs.allgather_array(xs)
                 w_init = None if ws is None else inputs.allgather_array(ws)
-            if init_mode == "random":
+            if warm_centers is not None:
+                centers0 = warm_centers  # the donor's iterate IS the init
+            elif init_mode == "random":
                 centers0 = random_init(x_init, k, seed)
             elif k >= 64:
                 # true k-means|| for large k: O(rounds) device passes instead

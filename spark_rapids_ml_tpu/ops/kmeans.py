@@ -323,6 +323,13 @@ def kmeans_fit(
 
             chaos.maybe_fail_oom("solve", n_iter)
             chaos.maybe_fail_stage("solve", n_iter)
+            # cooperative scheduler preemption (docs/scheduling.md): checked
+            # where the loop already host-fetched (the cadence shift fetch
+            # above), AFTER the boundary checkpoint landed — a preempted
+            # fit resumes from exactly this iterate
+            from ..scheduler.context import preemption_point
+
+            preemption_point("kmeans", n_iter)
     if telemetry.enabled():
         telemetry.record_solver_result("kmeans", n_iter=n_iter)
     # inertia reported is one iteration stale; recompute once with final

@@ -173,16 +173,17 @@ def _glm_qn_setup(
 def _glm_qn_minimize(
     z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat: int, dtype,
     penalty_terms, max_iter: int, tol: float, memory: int = 10,
-    n_alphas: int = 12, c1: float = 1e-4,
+    n_alphas: int = 12, c1: float = 1e-4, x0=None,
 ):
     """One-program GLM quasi-Newton minimization (see `_glm_qn_setup` for
-    the algorithm and its two structural exploits of linearity). Returns
+    the algorithm and its two structural exploits of linearity). `x0`
+    warm-starts the iterate (the public warm_start_from API). Returns
     (flat_params, objective, n_iter, stalled)."""
     from .owlqn import freeze_when_done
 
     cond, body, state0 = _glm_qn_setup(
         z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat, dtype,
-        penalty_terms, max_iter, tol, memory, n_alphas, c1,
+        penalty_terms, max_iter, tol, memory, n_alphas, c1, x0=x0,
     )
     # freeze_when_done makes the loop vmap-safe: batched hyperparameter
     # sweeps (vmap over lam_l2/lam_l1) step until the SLOWEST grid element
@@ -197,7 +198,7 @@ def glm_qn_minimize_segmented(
     z_of, rowloss, rowloss_alphas, grad_from_z, z_shape, n_flat: int, dtype,
     penalty_terms, max_iter: int, tol: float, memory: int = 10,
     n_alphas: int = 12, c1: float = 1e-4, *,
-    ckpt_key: str = "glm_qn", placement_key=None,
+    ckpt_key: str = "glm_qn", placement_key=None, x0=None,
 ):
     """`_glm_qn_minimize` with the one big ``lax.while_loop`` segmented into
     outer HOST segments of ``config["checkpoint_every_iters"]`` inner
@@ -216,7 +217,7 @@ def glm_qn_minimize_segmented(
     from .. import checkpoint as _ckpt
 
     store = _ckpt.active_store()
-    x_warm = None
+    x_warm = x0  # user warm start (warm_start_from); checkpoints override
     if store is not None:
         saved = store.peek(ckpt_key)
         if saved is not None and saved.placement_key != placement_key:
@@ -343,9 +344,12 @@ def logistic_fit(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    warm_start=None,  # (coef [k_out, d], intercept [k_out]) original-space seed
 ) -> Dict[str, jax.Array]:
     """Returns coef_ [k_out, d] and intercept_ [k_out] in ORIGINAL feature space
-    (standardization folded out), plus objective_ and n_iter_."""
+    (standardization folded out), plus objective_ and n_iter_. `warm_start`
+    seeds the iterate from a previous model's coefficients (the public
+    warm_start_from API, docs/scheduling.md "Warm starts")."""
     d = X.shape[1]
     mu, d_scale, total_w = _make_scaling(X, w, standardize, fit_intercept)
     return _fit_common(
@@ -353,6 +357,7 @@ def logistic_fit(
         X.dtype, d, y_idx, w, mu, d_scale, total_w,
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol, lbfgs_memory=lbfgs_memory,
+        warm_start=warm_start,
     )
 
 
@@ -380,6 +385,7 @@ def logistic_fit_ell(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    warm_start=None,
 ) -> Dict[str, jax.Array]:
     """Sparse (padded-ELL) logistic fit. Standardization is SCALE-ONLY — the
     data is divided by the per-column std but never centered, preserving
@@ -393,6 +399,7 @@ def logistic_fit_ell(
         values.dtype, d, y_idx, w, mu, d_scale, total_w,
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol, lbfgs_memory=lbfgs_memory,
+        warm_start=warm_start,
     )
 
 
@@ -609,9 +616,25 @@ def _finish_glm(
     }
 
 
+def _warm_x0(warm_start, d, k_out, mu, d_scale, fit_intercept, dtype):
+    """ORIGINAL-space (coef [k_out, d], intercept [k_out]) -> the flat
+    STANDARDIZED iterate the solvers walk — the exact inverse of
+    `_finish_glm`'s fold-out, so seeding from a converged model restarts the
+    solver AT that model (docs/scheduling.md "Warm starts"). Columns whose
+    d_scale is 0 (constant features) carry zero coefficient either way."""
+    coef, intercept = warm_start
+    coef = jnp.asarray(coef, dtype).reshape(k_out, d)
+    intercept = jnp.asarray(intercept, dtype).reshape(k_out)
+    scale = d_scale[:, None]
+    B = jnp.where(scale != 0, coef.T / jnp.where(scale == 0, 1.0, scale), 0.0)
+    b0 = (intercept + coef @ mu) if fit_intercept else jnp.zeros((k_out,), dtype)
+    return jnp.concatenate([B.ravel(), b0])
+
+
 def _fit_common(
     matvec, rmat, n_rows, dtype, d, y_idx, w, mu, d_scale, total_w,
     *, k, multinomial, lam_l2, lam_l1, use_l1, fit_intercept, max_iter, tol, lbfgs_memory,
+    warm_start=None,
 ) -> Dict[str, jax.Array]:
     prob = _build_glm_problem(
         matvec, rmat, dtype, d, y_idx, w, mu, d_scale, total_w,
@@ -620,6 +643,11 @@ def _fit_common(
     k_out, n_flat, unflatten = prob["k_out"], prob["n_flat"], prob["unflatten"]
     z_of, rowloss, rowloss_alphas = prob["z_of"], prob["rowloss"], prob["rowloss_alphas"]
     penalty_terms, grad_from_z = prob["penalty_terms"], prob["grad_from_z"]
+    x_warm = (
+        _warm_x0(warm_start, d, k_out, mu, d_scale, fit_intercept, dtype)
+        if warm_start is not None
+        else None
+    )
 
     if use_l1:
         # L1/ElasticNet: OWL-QN over the flattened (B, b0) with the L1 mask
@@ -634,7 +662,7 @@ def _fit_common(
         l1_mask = jnp.concatenate(
             [jnp.ones((d * k_out,), dtype), jnp.zeros((k_out,), dtype)]
         )
-        x0 = jnp.zeros((n_flat,), dtype)
+        x0 = x_warm if x_warm is not None else jnp.zeros((n_flat,), dtype)
         xf, obj, n_iter = owlqn_minimize(
             flat_loss, x0, l1_mask, lam_l1,
             max_iter=max_iter, tol=tol, memory=lbfgs_memory,
@@ -644,6 +672,7 @@ def _fit_common(
         xf, obj, n_iter, stalled = _glm_qn_minimize(
             z_of, rowloss, rowloss_alphas, grad_from_z, (n_rows, k_out), n_flat,
             dtype, penalty_terms, max_iter=max_iter, tol=tol, memory=lbfgs_memory,
+            x0=x_warm,
         )
     return _finish_glm(
         xf, obj, n_iter, stalled, unflatten, d_scale, mu,
@@ -654,7 +683,7 @@ def _fit_common(
 def _fit_common_checkpointed(
     matvec, rmat, n_rows, dtype, d, y_idx, w, mu, d_scale, total_w,
     *, k, multinomial, lam_l2, lam_l1, use_l1, fit_intercept, max_iter, tol,
-    lbfgs_memory, ckpt_key, placement_key,
+    lbfgs_memory, ckpt_key, placement_key, warm_start=None,
 ) -> Dict[str, jax.Array]:
     """`_fit_common` with the solver loop segmented for checkpointing
     (docs/robustness.md "Elastic recovery"): the IDENTICAL objective closures
@@ -670,6 +699,11 @@ def _fit_common_checkpointed(
     k_out, n_flat, unflatten = prob["k_out"], prob["n_flat"], prob["unflatten"]
     z_of, rowloss, rowloss_alphas = prob["z_of"], prob["rowloss"], prob["rowloss_alphas"]
     penalty_terms, grad_from_z = prob["penalty_terms"], prob["grad_from_z"]
+    x_warm = (
+        _warm_x0(warm_start, d, k_out, mu, d_scale, fit_intercept, dtype)
+        if warm_start is not None
+        else None
+    )
 
     if use_l1:
         from .owlqn import owlqn_minimize_segmented
@@ -681,7 +715,7 @@ def _fit_common_checkpointed(
         l1_mask = jnp.concatenate(
             [jnp.ones((d * k_out,), dtype), jnp.zeros((k_out,), dtype)]
         )
-        x0 = jnp.zeros((n_flat,), dtype)
+        x0 = x_warm if x_warm is not None else jnp.zeros((n_flat,), dtype)
         xf, obj, n_iter = owlqn_minimize_segmented(
             flat_loss, x0, l1_mask, lam_l1,
             max_iter=max_iter, tol=tol, memory=lbfgs_memory,
@@ -692,7 +726,7 @@ def _fit_common_checkpointed(
         xf, obj, n_iter, stalled = glm_qn_minimize_segmented(
             z_of, rowloss, rowloss_alphas, grad_from_z, (n_rows, k_out), n_flat,
             dtype, penalty_terms, max_iter=max_iter, tol=tol, memory=lbfgs_memory,
-            ckpt_key=ckpt_key, placement_key=placement_key,
+            ckpt_key=ckpt_key, placement_key=placement_key, x0=x_warm,
         )
     return _finish_glm(
         xf, obj, n_iter, stalled, unflatten, d_scale, mu,
@@ -717,6 +751,7 @@ def logistic_fit_checkpointed(
     lbfgs_memory: int = 10,
     ckpt_key: str = "logistic",
     placement_key=None,
+    warm_start=None,
 ) -> Dict[str, jax.Array]:
     """`logistic_fit` with solver checkpoints: same returns, same math
     (shared closures), segmented loop. The model layer routes here when
@@ -731,6 +766,7 @@ def logistic_fit_checkpointed(
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
         lbfgs_memory=lbfgs_memory, ckpt_key=ckpt_key, placement_key=placement_key,
+        warm_start=warm_start,
     )
 
 
@@ -753,6 +789,7 @@ def logistic_fit_ell_checkpointed(
     lbfgs_memory: int = 10,
     ckpt_key: str = "logistic_ell",
     placement_key=None,
+    warm_start=None,
 ) -> Dict[str, jax.Array]:
     """Sparse (padded-ELL) analog of `logistic_fit_checkpointed` — scale-only
     standardization, same closures as `logistic_fit_ell`, segmented loop."""
@@ -764,6 +801,7 @@ def logistic_fit_ell_checkpointed(
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
         lbfgs_memory=lbfgs_memory, ckpt_key=ckpt_key, placement_key=placement_key,
+        warm_start=warm_start,
     )
 
 

@@ -376,6 +376,11 @@ def kmeans_fit_streaming(
             ))
             chaos.maybe_fail_oom("solve", n_iter)
             chaos.maybe_fail_stage("solve", n_iter)
+            # cooperative scheduler preemption — post-checkpoint, like the
+            # resident loop (a demoted job can still yield to higher priority)
+            from ..scheduler.context import preemption_point
+
+            preemption_point("kmeans_stream", n_iter)
     if telemetry.enabled():
         telemetry.record_solver_result("kmeans", n_iter=n_iter)
     if final_inertia:
@@ -791,6 +796,10 @@ def logistic_fit_streaming(
             ))
             chaos.maybe_fail_oom("solve", it)
             chaos.maybe_fail_stage("solve", it)
+            # cooperative scheduler preemption — post-checkpoint boundary
+            from ..scheduler.context import preemption_point
+
+            preemption_point("glm_qn_stream", it)
 
     def unflat_jnp(xf):
         return xf[: d * k_out].reshape(d, k_out), xf[d * k_out :]

@@ -1,0 +1,37 @@
+#
+# Multi-tenant fit scheduler (docs/scheduling.md): priority queues,
+# bin-packed co-admission, and checkpoint preemption over one shared HBM
+# ledger. Three parts:
+#
+#   ledger.py   `HbmLedger` — the ONE per-device byte book every HBM
+#               consumer charges: fit admissions, serving model loads, and
+#               scheduler jobs (fixes the split-brain where fits and
+#               resident served models each budgeted against full capacity);
+#   context.py  the job context + cooperative `preemption_point` the solvers
+#               check at their checkpoint-cadence boundaries;
+#   queue.py    `FitScheduler` / `FitJob` — submit(estimator, dataset,
+#               tenant=, priority=) returning a future; co-admission,
+#               preemption, resume, and streaming demotion.
+#
+from __future__ import annotations
+
+from .context import current_job, job_scope, preemption_point  # noqa: F401
+from .ledger import (  # noqa: F401
+    HbmLedger,
+    HbmReservation,
+    global_ledger,
+    reset_global_ledger,
+)
+from .queue import FitJob, FitScheduler  # noqa: F401
+
+__all__ = [
+    "HbmLedger",
+    "HbmReservation",
+    "global_ledger",
+    "reset_global_ledger",
+    "current_job",
+    "job_scope",
+    "preemption_point",
+    "FitJob",
+    "FitScheduler",
+]

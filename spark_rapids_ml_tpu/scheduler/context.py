@@ -1,0 +1,63 @@
+#
+# Job context + the cooperative preemption flag (docs/scheduling.md
+# "Preemption").
+#
+# A scheduler job's worker thread runs its whole fit inside `job_scope(job)`;
+# everything downstream can then ask two questions without plumbing a job
+# handle through every layer:
+#
+#   * `memory.admit_fit` asks `current_job()` — to RESIZE the job's ledger
+#     reservation instead of double-reserving, and to honor a demoted job's
+#     forced streaming verdict;
+#   * the solvers ask `preemption_point(solver, iteration)` at their
+#     checkpoint-cadence boundaries — the places they ALREADY host-fetch
+#     (k-means' deferred-shift fetch, `run_segmented_while`'s segment
+#     boundary, the streaming GLM loop), immediately AFTER the boundary's
+#     `SolverCheckpoint` landed. A flagged job raises `PreemptedError` there
+#     with ZERO lost work: the checkpoint it just saved is exactly what the
+#     resume restores, so preempted-then-resumed is bit-identical to an
+#     uninterrupted checkpointed fit (pinned by tests/test_scheduler.py).
+#
+# Context-local (same isolation argument as core's DeviceDataset scope and
+# the checkpoint store): concurrent jobs on different worker threads must
+# never see each other's flags. Outside any job both calls are near-free
+# no-ops — one ContextVar read.
+#
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any, Optional
+
+__all__ = ["current_job", "job_scope", "preemption_point"]
+
+_CURRENT_JOB: "contextvars.ContextVar[Optional[Any]]" = contextvars.ContextVar(
+    "srml_scheduler_job", default=None
+)
+
+
+def current_job() -> Optional[Any]:
+    """The `FitJob` whose worker thread is running this code, or None (the
+    common, scheduler-less case)."""
+    return _CURRENT_JOB.get()
+
+
+@contextlib.contextmanager
+def job_scope(job: Any):
+    """Install `job` as the current job for the dynamic extent (the worker
+    thread's whole fit attempt)."""
+    token = _CURRENT_JOB.set(job)
+    try:
+        yield job
+    finally:
+        _CURRENT_JOB.reset(token)
+
+
+def preemption_point(solver: str = "", iteration: int = 0) -> None:
+    """Cooperative yield check — called by solvers at checkpoint-cadence
+    boundaries, after the boundary checkpoint saved. Raises `PreemptedError`
+    when the enclosing scheduler job has been asked to yield; a no-op (one
+    ContextVar read) everywhere else."""
+    job = _CURRENT_JOB.get()
+    if job is not None:
+        job.check_preempt(solver, iteration)

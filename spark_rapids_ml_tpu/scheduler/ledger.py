@@ -1,0 +1,210 @@
+#
+# HbmLedger: ONE per-device byte ledger for every HBM consumer in the
+# process (docs/scheduling.md "The shared ledger").
+#
+# Before this ledger, the two admission controllers each budgeted against the
+# FULL device capacity: `memory.admit_fit` ignored bytes held by resident
+# serving models, and `memory.admit_model_load` ignored a concurrently
+# running fit's placement + workspace — so a fit plus resident models could
+# jointly overshoot HBM even though each admission individually "fit". Both
+# controllers now charge against capacity MINUS what this ledger already
+# holds, and every admission RESERVES its estimate here:
+#
+#   kind "fit"    one reservation per fit, held from admission until the fit
+#                 completes or fails (core releases it in the fit driver's
+#                 finally); a scope-cached placement BETWEEN fits is pinned
+#                 HBM but unreserved — the next fit over it re-reserves on
+#                 the cache hit (documented gap: the idle window between
+#                 fits in one device_dataset_scope is unaccounted).
+#   kind "serve"  one reservation per resident serving model, held from
+#                 admission through placement + prewarm + residency,
+#                 released on eviction (serving.ModelRegistry).
+#   kind "job"    one reservation per scheduler job, made by FitScheduler at
+#                 queue admission and RESIZED (not duplicated) by the job's
+#                 own `admit_fit` when the fit trues up the estimate;
+#                 released when the job completes, fails, or is preempted.
+#
+# The ledger never decides anything — admission logic stays in `memory.py`
+# (the ci/analysis `ledger-bypass` rule keeps capacity math there). It is
+# bookkeeping with one atomicity guarantee: `admission()` is the lock every
+# admission decision runs under, so check-then-reserve is race-free across
+# concurrent fits, model loads, and scheduler passes.
+#
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["HbmReservation", "HbmLedger", "global_ledger", "reset_global_ledger"]
+
+
+@dataclass
+class HbmReservation:
+    """One admitted per-device byte claim. `nbytes` is mutable via
+    `HbmLedger.resize` (a scheduler job's queue-time estimate is trued up by
+    the fit's own admission); `active` flips False exactly once on release —
+    double-release is a harmless no-op, never a double-credit."""
+
+    owner: str
+    kind: str  # "fit" | "serve" | "job"
+    nbytes: int
+    rid: int = 0
+    active: bool = True
+
+
+class HbmLedger:
+    """Thread-safe reservation ledger (see module docstring).
+
+    `admission_hooks` fire after every admission DECISION (admit or refuse)
+    with ``(reserved_bytes, budget_bytes_or_None)`` — the test harness's
+    "ledger never over capacity, asserted at every admission" hook, and the
+    utilization gauge's feed."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._admission_lock = threading.RLock()
+        self._by_id: Dict[int, HbmReservation] = {}
+        self._ids = itertools.count(1)
+        self.high_watermark: int = 0
+        self.last_budget: Optional[int] = None
+        self.admission_hooks: List[Callable[[int, Optional[int]], None]] = []
+
+    # ------------------------------------------------------------ locking --
+    def admission(self):
+        """The one lock every admission decision (check-then-reserve) runs
+        under — `memory.admit_fit`, `memory.admit_model_load`, and the
+        scheduler's co-admission pass all serialize here, so two concurrent
+        admissions cannot both see the same free bytes."""
+        return self._admission_lock
+
+    # ------------------------------------------------------------- reads ---
+    def reserved_bytes(
+        self, *, kind: Optional[str] = None, exclude: Optional[HbmReservation] = None
+    ) -> int:
+        """Active reserved bytes, optionally one `kind` only, optionally
+        excluding one reservation (an admission re-truing a job's own claim
+        must not double-count itself)."""
+        with self._lock:
+            return sum(
+                r.nbytes
+                for r in self._by_id.values()
+                if r.active
+                and (kind is None or r.kind == kind)
+                and r is not exclude
+            )
+
+    def reservations(self) -> List[HbmReservation]:
+        with self._lock:
+            return [r for r in self._by_id.values() if r.active]
+
+    def utilization(self) -> Optional[float]:
+        """reserved / last-known budget, or None while no budget was ever
+        observed (CPU without an `hbm_budget_bytes` override)."""
+        with self._lock:
+            if not self.last_budget:
+                return None
+            return self.reserved_bytes() / float(self.last_budget)
+
+    # ------------------------------------------------------------ writes ---
+    def reserve(self, owner: str, kind: str, nbytes: int) -> HbmReservation:
+        """Unconditional bookkeeping reserve — admission logic (memory.py)
+        decides WHETHER; this records THAT. Updates the high watermark and
+        the `scheduler.ledger_reserved_bytes` gauge."""
+        r = HbmReservation(owner=owner, kind=kind, nbytes=max(0, int(nbytes)))
+        with self._lock:
+            r.rid = next(self._ids)
+            self._by_id[r.rid] = r
+            self._note_locked()
+        return r
+
+    def try_reserve(
+        self,
+        owner: str,
+        kind: str,
+        nbytes: int,
+        *,
+        budget: Optional[int] = None,
+        exclude: Optional[HbmReservation] = None,
+    ) -> Optional[HbmReservation]:
+        """Atomic check-then-reserve: None when ``held + nbytes`` would
+        exceed `budget` (a None budget always admits — no capacity
+        information means no budgeting, the pre-ledger contract)."""
+        with self._lock:
+            if budget is not None:
+                held = self.reserved_bytes(exclude=exclude)
+                if held + max(0, int(nbytes)) > budget:
+                    return None
+            return self.reserve(owner, kind, nbytes)
+
+    def resize(self, r: HbmReservation, nbytes: int) -> None:
+        """True an existing claim up (or down) to `nbytes` — the scheduler
+        job's queue-time estimate replaced by the fit admission's exact
+        working set. The caller validated the new size against the budget
+        (under `admission()`); resize itself is bookkeeping."""
+        with self._lock:
+            r.nbytes = max(0, int(nbytes))
+            self._note_locked()
+
+    def release(self, r: Optional[HbmReservation]) -> None:
+        """Return a claim's bytes. Idempotent (a released reservation stays
+        released); None is a no-op so callers can release unconditionally in
+        `finally` blocks."""
+        if r is None:
+            return
+        with self._lock:
+            if not r.active:
+                return
+            r.active = False
+            self._by_id.pop(r.rid, None)
+            self._note_locked()
+
+    # ---------------------------------------------------------- telemetry --
+    def note_admission(self, budget: Optional[int]) -> None:
+        """Record one admission DECISION against `budget`: remembers the
+        budget (utilization denominator), publishes the
+        `scheduler.ledger_utilization` gauge, and fires every admission hook
+        — the acceptance harness asserts ``reserved <= budget`` here, at
+        every admission, not just at the end."""
+        from .. import telemetry
+
+        with self._lock:
+            if budget is not None:
+                self.last_budget = int(budget)
+            reserved = self.reserved_bytes()
+            last = self.last_budget
+        if telemetry.enabled() and last:
+            telemetry.registry().gauge(
+                "scheduler.ledger_utilization", reserved / float(last)
+            )
+        for hook in list(self.admission_hooks):
+            hook(reserved, budget)
+
+    def _note_locked(self) -> None:
+        reserved = sum(r.nbytes for r in self._by_id.values() if r.active)
+        if reserved > self.high_watermark:
+            self.high_watermark = reserved
+        from .. import telemetry
+
+        if telemetry.enabled():
+            telemetry.registry().gauge("scheduler.ledger_reserved_bytes", reserved)
+
+
+# One ledger per process: fits, serving loads, and scheduler jobs all charge
+# the same HBM, so they must share one book.
+_GLOBAL = HbmLedger()
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_ledger() -> HbmLedger:
+    return _GLOBAL
+
+
+def reset_global_ledger() -> HbmLedger:
+    """Fresh process-global ledger (test isolation — a leaked reservation
+    from a failed test must not shrink every later test's budget)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = HbmLedger()
+    return _GLOBAL

@@ -1,0 +1,557 @@
+#
+# FitScheduler: the multi-tenant fit queue (docs/scheduling.md).
+#
+# The reference gets multi-job behavior for free from Spark's stage-level
+# scheduler (PAPER.md L1/L5: the driver queues barrier stages against a
+# shared executor pool); this stack runs fits as single-controller programs
+# against one mesh, so a production service with many tenants needs its own
+# scheduling layer. This module composes three things earlier layers built:
+#
+#   * the HBM budgeter's per-fit byte estimates (memory.resident_estimate /
+#     streaming_estimate) become the BIN-PACKING input: jobs whose
+#     placements + workspaces fit the shared `HbmLedger` together are
+#     CO-ADMITTED and run concurrently; the rest queue in priority order;
+#   * the checkpoint store (checkpoint.CheckpointStore) becomes PREEMPTION:
+#     a high-priority job that doesn't fit evicts the lowest-priority
+#     running fit at its next segment boundary (the cooperative flag in
+#     scheduler/context.py, checked where the solvers already host-fetch);
+#     the preempted fit's `SolverCheckpoint` persists in the job-owned
+#     store, its reservation frees immediately, and a later re-admission
+#     resumes bit-identically on the same mesh;
+#   * admission demotion gives DEGRADED-MODE service: a job preempted
+#     `config["sched_max_preemptions"]` times is demoted to the out-of-core
+#     streaming path — a floor-chunk footprint that packs into almost any
+#     budget, so chronically displaced tenants make progress instead of
+#     starving (estimators without a streaming path become non-preemptible
+#     instead: they run to completion once admitted).
+#
+# Scheduling passes run on submit and on every job transition (no dispatcher
+# thread); each pass scans the priority-ordered queue first-fit under the
+# ledger's admission lock, stops backfilling while a preemption is pending
+# for a blocked higher-priority job (space is coming — filling it with
+# lower-priority work would re-starve the blocked job), and otherwise
+# backfills smaller jobs into the remaining budget (bin-packing).
+#
+# Preemption requires a checkpoint cadence: with
+# ``config["checkpoint_every_iters"] == 0`` solvers never reach a boundary,
+# so running fits are effectively non-preemptible and high-priority jobs
+# wait for completions (documented in docs/scheduling.md "Fairness knobs").
+#
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..errors import PreemptedError, SchedulerSaturatedError
+from ..utils import get_logger
+from .context import job_scope
+from .ledger import HbmLedger, global_ledger
+
+__all__ = ["FitJob", "FitScheduler"]
+
+_STATES = ("queued", "running", "preempted", "completed", "failed", "refused")
+
+
+class FitJob:
+    """One submitted fit: the estimator/dataset pair, its tenant + priority,
+    the job-owned `CheckpointStore` (survives preemptions — the resume
+    substrate), and a future-like result surface (`result()` / `done()`).
+
+    `stats()` is the per-tenant telemetry the scheduler stamps into the
+    finished model's ``_fit_metrics["scheduler"]`` — queue wait, preemption
+    and resume counts, demotion, and the job's HBM share at admission."""
+
+    def __init__(
+        self,
+        job_id: int,
+        estimator: Any,
+        dataset: Any,
+        tenant: str,
+        priority: int,
+        warm_start_from: Any = None,
+    ) -> None:
+        from .. import checkpoint as _ckpt
+
+        self.job_id = int(job_id)
+        self.estimator = estimator
+        self.dataset = dataset
+        self.tenant = str(tenant)
+        self.priority = int(priority)
+        self.warm_start_from = warm_start_from
+        # job-owned checkpoint store: installed around every run attempt via
+        # checkpoint_scope(store=...), so the solver checkpoints a preemption
+        # leaves behind are exactly what the resumed attempt restores
+        self.store = _ckpt.CheckpointStore()
+        self.state = "queued"
+        self.preemptions = 0
+        self.resumes = 0
+        self.demoted = False
+        self.demote_to_stream = False
+        self.reservation: Any = None  # ledger HbmReservation while admitted
+        self.admitted_bytes = 0
+        self.hbm_share = 0.0
+        self.queue_wait_s = 0.0
+        self.run_s = 0.0
+        self._wait_since = time.monotonic()
+        self._run_since: Optional[float] = None
+        # byte estimates (filled by the scheduler's preflight)
+        self.resident_estimate: Any = None
+        self.stream_floor_estimate: Any = None
+        self._preempt = threading.Event()
+        self._preempt_reason = ""
+        self._done = threading.Event()
+        self._model: Any = None
+        self._error: Optional[BaseException] = None
+
+    # ------------------------------------------------------------ future --
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block until the job finishes; returns the fitted model or raises
+        the job's failure (including `SchedulerSaturatedError` refusals and
+        shutdown). The model's ``_fit_metrics["scheduler"]`` carries this
+        job's per-tenant telemetry."""
+        if not self._done.wait(timeout):  # blocking-ok: caller-bounded result wait (timeout passed through)
+            raise TimeoutError(
+                f"job {self.job_id} ({self.tenant!r}) not done within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._model
+
+    # -------------------------------------------------------- preemption --
+    def request_preempt(self, reason: str) -> None:
+        self._preempt_reason = reason
+        self._preempt.set()
+
+    def preempt_requested(self) -> bool:
+        return self._preempt.is_set()
+
+    def check_preempt(self, solver: str, iteration: int) -> None:
+        """The cooperative yield point (`scheduler.context.preemption_point`
+        delegates here): raises `PreemptedError` when flagged. Called only
+        at checkpoint-cadence boundaries, AFTER the boundary checkpoint
+        saved — unwinding here loses zero work."""
+        if self._preempt.is_set():
+            raise PreemptedError(
+                self.job_id,
+                solver=solver,
+                iteration=iteration,
+                reason=self._preempt_reason,
+            )
+
+    # ------------------------------------------------------------- stats --
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "state": self.state,
+            "queue_wait_s": self.queue_wait_s,
+            "run_s": self.run_s,
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
+            "demoted": self.demoted,
+            "admitted_bytes": self.admitted_bytes,
+            "hbm_share": self.hbm_share,
+        }
+
+    def _finish(self, model: Any) -> None:
+        self.state = "completed"
+        self._model = model
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self.state = "failed" if not isinstance(exc, SchedulerSaturatedError) else "refused"
+        self._error = exc
+        self._done.set()
+
+
+class FitScheduler:
+    """Priority job queue with bin-packed co-admission and checkpoint
+    preemption over the shared `HbmLedger` (module docstring,
+    docs/scheduling.md).
+
+    ``submit(estimator, dataset, tenant=, priority=)`` returns a `FitJob`
+    future. Higher `priority` values run first; ties are FIFO. Jobs run on
+    worker threads — one per admitted job — so co-admitted fits genuinely
+    overlap; callers wanting collective-free concurrency on a shared mesh
+    should submit single-device estimators (``est.num_workers = 1``)."""
+
+    def __init__(
+        self,
+        *,
+        ledger: Optional[HbmLedger] = None,
+        max_concurrent: Optional[int] = None,
+        max_preemptions: Optional[int] = None,
+    ) -> None:
+        from ..core import config
+
+        self._ledger = ledger if ledger is not None else global_ledger()
+        self._max_concurrent = int(
+            max_concurrent
+            if max_concurrent is not None
+            else config.get("sched_max_concurrent", 4)
+        )
+        self._max_preemptions = int(
+            max_preemptions
+            if max_preemptions is not None
+            else config.get("sched_max_preemptions", 2)
+        )
+        self._lock = threading.RLock()
+        self._queue: List[FitJob] = []
+        self._running: Dict[int, FitJob] = {}
+        self._threads: List[threading.Thread] = []
+        self._jobs: List[FitJob] = []
+        self._next_id = 1
+        self._closed = False
+        self._logger = get_logger(type(self))
+
+    # ------------------------------------------------------------ submit --
+    def submit(
+        self,
+        estimator: Any,
+        dataset: Any,
+        *,
+        tenant: str = "default",
+        priority: int = 0,
+        warm_start_from: Any = None,
+    ) -> FitJob:
+        """Queue one fit. Returns immediately with a `FitJob` future.
+
+        Raises `SchedulerSaturatedError` — the typed refusal mirroring
+        `HbmBudgetError` — when the job's SMALLEST possible footprint (the
+        streaming floor, or the resident estimate for estimators with no
+        out-of-core path) exceeds the whole budget: no amount of queueing or
+        preemption can ever place it, so the tenant learns at submit time."""
+        from .. import telemetry
+
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("FitScheduler is shut down")
+            job = FitJob(
+                self._next_id, estimator, dataset,
+                tenant, priority, warm_start_from,
+            )
+            self._next_id += 1
+            # registered BEFORE preflight: a refused job must still show up
+            # in stats()'s per-tenant roll-up (state "refused")
+            self._jobs.append(job)
+        self._preflight(job)  # may raise SchedulerSaturatedError (typed refusal)
+        reg = telemetry.registry()
+        reg.inc("scheduler.jobs_submitted")
+        with self._lock:
+            self._queue.append(job)
+            self._schedule_locked()
+            if job.state == "queued":
+                reg.inc("scheduler.jobs_queued")
+        return job
+
+    def _preflight(self, job: FitJob) -> None:
+        """Byte estimates for bin-packing (the PR-7 budgeter's formulas are
+        the input — docs/scheduling.md "Co-admission"), plus the
+        cannot-ever-fit refusal. The extraction is host-side column
+        selection only; the extracted blocks are dropped after estimating
+        (the fit re-extracts — holding every queued job's dataset twice
+        would defeat the memory plane)."""
+        from .. import memory, telemetry
+        from ..parallel.mesh import default_devices
+
+        est = job.estimator
+        extracted = est._pre_process_data(job.dataset, for_fit=True, defer_validation=True)
+        n_dev = max(1, min(int(est.num_workers), len(default_devices())))
+        job.resident_estimate = memory.resident_estimate(est, extracted, n_dev)
+        if getattr(est, "_supports_streaming_fit", False):
+            floor = min(memory.MIN_STREAM_CHUNK_ROWS, max(1, int(extracted.n_rows)))
+            job.stream_floor_estimate = memory.streaming_estimate(
+                est, extracted, n_dev, floor
+            )
+        budget = self._budget()
+        minimal = (
+            job.stream_floor_estimate
+            if job.stream_floor_estimate is not None
+            else job.resident_estimate
+        )
+        if budget is not None and minimal.total() > budget:
+            name, nbytes = minimal.largest()
+            exc = SchedulerSaturatedError(
+                f"job for tenant {job.tenant!r} "
+                f"({type(est).__name__}) cannot ever be scheduled: its "
+                "smallest working set exceeds the whole budget",
+                tenant=job.tenant,
+                estimate_bytes=minimal.total(),
+                budget_bytes=budget,
+                largest_term=name,
+                largest_term_bytes=nbytes,
+                terms=minimal.terms,
+            )
+            telemetry.registry().inc("scheduler.jobs_refused")
+            job._fail(exc)
+            raise exc
+
+    # -------------------------------------------------------- scheduling --
+    def _budget(self) -> Optional[int]:
+        from .. import memory
+        from ..parallel.mesh import default_devices
+
+        capacity = memory.device_capacity_bytes(
+            devices=default_devices(), consume_chaos=False
+        )
+        if capacity is None:
+            return None
+        return int(capacity * (1.0 - memory.headroom_fraction()))
+
+    def _need_bytes(self, job: FitJob, budget: Optional[int]) -> int:
+        """The bytes this job's NEXT admission will claim: the streaming
+        floor once demoted (or when the resident set alone exceeds the
+        budget — the fit's own admission would demote it anyway), else the
+        resident estimate."""
+        resident = job.resident_estimate.total()
+        if job.stream_floor_estimate is not None and (
+            job.demote_to_stream or (budget is not None and resident > budget)
+        ):
+            return int(job.stream_floor_estimate.total())
+        return int(resident)
+
+    def _schedule_locked(self) -> None:
+        """One co-admission pass (caller holds `self._lock`): first-fit over
+        the priority-ordered queue under the ledger's admission lock, with
+        preemption for a blocked higher-priority head and bin-packing
+        backfill otherwise."""
+        from .. import telemetry
+
+        if self._closed:
+            return
+        budget = self._budget()
+        self._queue.sort(key=lambda j: (-j.priority, j.job_id))  # FIFO tiebreak
+        reg = telemetry.registry()
+        to_start: List[FitJob] = []
+        with self._ledger.admission():
+            for job in list(self._queue):
+                if len(self._running) + len(to_start) >= self._max_concurrent:
+                    break
+                need = self._need_bytes(job, budget)
+                r = self._ledger.try_reserve(
+                    f"job:{job.job_id}:{job.tenant}", "job", need, budget=budget
+                )
+                self._ledger.note_admission(budget)
+                if r is not None:
+                    job.reservation = r
+                    job.admitted_bytes = need
+                    job.hbm_share = (need / budget) if budget else 0.0
+                    to_start.append(job)
+                    continue
+                # blocked: the highest-priority job that doesn't fit may
+                # preempt; while its preemption is pending, do NOT backfill
+                # (filling the space it is waiting for would starve it)
+                if self._maybe_preempt_locked(job, need, budget):
+                    break
+                if any(v.preempt_requested() for v in self._running.values()):
+                    break
+                # no victim to preempt: keep scanning — a smaller job lower
+                # in the queue may still bin-pack into the remaining budget
+        now = time.monotonic()
+        if to_start:
+            # a long-lived scheduler must not accumulate finished worker
+            # threads; live ones stay joinable for shutdown(wait=True)
+            self._threads = [t for t in self._threads if t.is_alive()]
+        for job in to_start:
+            self._queue.remove(job)
+            wait = now - job._wait_since
+            job.queue_wait_s += wait
+            reg.inc("scheduler.jobs_admitted")
+            reg.observe("scheduler.queue_wait_s", wait)
+            reg.observe("scheduler.hbm_share", job.hbm_share)
+            if job.state == "preempted":
+                job.resumes += 1
+                reg.inc("scheduler.jobs_resumed")
+            job.state = "running"
+            job._run_since = now
+            self._running[job.job_id] = job
+            t = threading.Thread(
+                target=self._run_job, args=(job,),
+                name=f"srml-sched-job-{job.job_id}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def _maybe_preempt_locked(
+        self, job: FitJob, need: int, budget: Optional[int]
+    ) -> bool:
+        """Request preemption of the lowest-priority running fit when that
+        can actually make room for `job`. Returns whether a preemption is
+        now pending for it. One victim at a time: the pass re-runs when the
+        victim unwinds, and escalates only if still blocked.
+
+        With no checkpoint cadence (``config["checkpoint_every_iters"] ==
+        0``) solvers never reach a yield point, so a requested preemption
+        could never be observed — and its pending flag would halt ALL
+        backfill for the victim's whole runtime. Don't request: running
+        fits are non-preemptible then (docs/scheduling.md "Fairness
+        knobs"), high-priority jobs wait for completions, and smaller jobs
+        keep bin-packing."""
+        from .. import checkpoint as _ckpt
+
+        if budget is None or _ckpt.every_iters() <= 0:
+            return False
+        victims = [
+            v for v in self._running.values() if v.priority < job.priority
+        ]
+        if not victims:
+            return False
+        freeable = sum(
+            v.reservation.nbytes for v in victims if v.reservation is not None
+        )
+        held = self._ledger.reserved_bytes()
+        if held - freeable + need > budget:
+            return False  # even evicting every lower-priority fit cannot make room
+        pending = [v for v in victims if v.preempt_requested()]
+        if pending:
+            return True  # already waiting on a boundary
+        victim = min(victims, key=lambda v: (v.priority, -v.job_id))
+        self._logger.info(
+            "preempting job %d (tenant %r, priority %d) for job %d "
+            "(tenant %r, priority %d)",
+            victim.job_id, victim.tenant, victim.priority,
+            job.job_id, job.tenant, job.priority,
+        )
+        victim.request_preempt(
+            f"higher-priority job {job.job_id} (tenant {job.tenant!r}) "
+            "needs the reservation"
+        )
+        return True
+
+    # ----------------------------------------------------------- running --
+    def _run_job(self, job: FitJob) -> None:
+        """Worker-thread body: the whole fit inside `job_scope` (so
+        `memory.admit_fit` trues up the job's reservation and the solvers
+        see the preemption flag) and the job-owned checkpoint store (so a
+        preempted attempt's checkpoints survive into the resume)."""
+        from .. import checkpoint as _ckpt
+        from .. import telemetry
+
+        reg = telemetry.registry()
+        requeue = False
+        try:
+            with job_scope(job), _ckpt.checkpoint_scope(store=job.store):
+                if job.warm_start_from is not None:
+                    model = job.estimator.fit(
+                        job.dataset, warm_start_from=job.warm_start_from
+                    )
+                else:
+                    model = job.estimator.fit(job.dataset)
+            # per-tenant scheduler telemetry rides the job result — always,
+            # like the admission stamp: WHY a fit waited/preempted/streamed
+            # is robustness state, not a metric (the _fit_metrics dict is
+            # shared across a fit's models, so stamp a copy)
+            job.state = "completed"
+            metrics = dict(getattr(model, "_fit_metrics", {}) or {})
+            metrics["scheduler"] = job.stats()
+            model._fit_metrics = metrics
+            job._finish(model)
+            reg.inc("scheduler.jobs_completed")
+        except PreemptedError:
+            requeue = True
+        except BaseException as e:  # a dead tenant job must never leak its
+            # reservation or wedge the queue — reclaim and keep scheduling
+            job._fail(e)
+            reg.inc("scheduler.jobs_failed")
+            self._logger.warning(
+                "job %d (tenant %r) failed: %s: %s",
+                job.job_id, job.tenant, type(e).__name__, e,
+            )
+        finally:
+            with self._lock:
+                self._running.pop(job.job_id, None)
+                if job._run_since is not None:
+                    job.run_s += time.monotonic() - job._run_since
+                    job._run_since = None
+                self._ledger.release(job.reservation)
+                job.reservation = None
+                if requeue and not self._closed:
+                    job.preemptions += 1
+                    job._preempt.clear()
+                    job._preempt_reason = ""
+                    job.state = "preempted"
+                    job._wait_since = time.monotonic()
+                    reg.inc("scheduler.jobs_preempted")
+                    if (
+                        job.preemptions >= self._max_preemptions
+                        and job.stream_floor_estimate is not None
+                        and not job.demote_to_stream
+                    ):
+                        # degraded-mode service: the chronically displaced
+                        # job streams from here on — a floor-chunk footprint
+                        # that packs into almost any budget
+                        job.demote_to_stream = True
+                        job.demoted = True
+                        reg.inc("scheduler.jobs_demoted")
+                        self._logger.warning(
+                            "job %d (tenant %r) preempted %d time(s) — "
+                            "demoting to the streaming path",
+                            job.job_id, job.tenant, job.preemptions,
+                        )
+                    self._queue.append(job)
+                elif requeue:
+                    job._fail(RuntimeError("FitScheduler shut down mid-preemption"))
+                self._schedule_locked()
+
+    # ------------------------------------------------------------- stats --
+    def stats(self) -> Dict[str, Any]:
+        """Per-tenant roll-up of every job this scheduler has seen — queue
+        waits (mean/max), preemptions, resumes, demotions, completion
+        counts — plus the ledger view (reserved bytes, high watermark,
+        utilization)."""
+        with self._lock:
+            jobs = list(self._jobs)
+            running = len(self._running)
+            queued = len(self._queue)
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for j in jobs:
+            t = tenants.setdefault(
+                j.tenant,
+                {
+                    "jobs": 0, "completed": 0, "failed": 0,
+                    "preemptions": 0, "resumes": 0, "demotions": 0,
+                    "queue_wait_s": [],
+                },
+            )
+            t["jobs"] += 1
+            t["completed"] += int(j.state == "completed")
+            t["failed"] += int(j.state in ("failed", "refused"))
+            t["preemptions"] += j.preemptions
+            t["resumes"] += j.resumes
+            t["demotions"] += int(j.demoted)
+            t["queue_wait_s"].append(j.queue_wait_s)
+        return {
+            "tenants": tenants,
+            "running": running,
+            "queued": queued,
+            "ledger_reserved_bytes": self._ledger.reserved_bytes(),
+            "ledger_high_watermark": self._ledger.high_watermark,
+            "ledger_utilization": self._ledger.utilization(),
+        }
+
+    # ---------------------------------------------------------- shutdown --
+    def shutdown(self, wait: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop admitting. Queued (never-run) jobs fail typed; running jobs
+        finish (their threads are joined when `wait`). Idempotent."""
+        with self._lock:
+            self._closed = True
+            drained, self._queue = self._queue, []
+            threads = list(self._threads)
+        for job in drained:
+            job._fail(RuntimeError("FitScheduler shut down before the job ran"))
+        if wait:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            for t in threads:
+                left = None if deadline is None else max(0.0, deadline - time.monotonic())
+                t.join(left)
+
+    def __enter__(self) -> "FitScheduler":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.shutdown(wait=True)

@@ -6,7 +6,10 @@
 #
 #   1. ADMISSION — `memory.admit_model_load` charges the model's placement
 #      terms plus a per-bucket predict workspace term against the per-device
-#      budget MINUS what already-resident models hold. Over budget: evict the
+#      budget MINUS what the shared `scheduler.HbmLedger` already holds —
+#      resident models (each keeps a ledger reservation from admission until
+#      eviction) AND concurrently running/scheduled fits (docs/scheduling.md
+#      "The shared ledger"). Over budget: evict the
 #      least-recently-USED resident (scoring touches move entries to MRU) and
 #      retry; nothing left to evict: the typed `HbmBudgetError` propagates,
 #      and the refusal — naming its largest byte term — is stamped on
@@ -70,10 +73,6 @@ class ModelRegistry:
 
         self._lock = threading.RLock()
         self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()
-        # bytes admitted to loads still building OUTSIDE the lock (placement
-        # + prewarm): counted against later admissions so two concurrent
-        # loads cannot jointly overshoot the budget
-        self._reserved_bytes = 0
         self._prewarm_default = bool(prewarm)
         self._cap = int(max_batch_rows or config.get("serve_max_batch_rows", 8192))
         self._logger = get_logger(type(self))
@@ -128,7 +127,10 @@ class ModelRegistry:
         cache; holding the lock would stall every concurrent `get()` and
         with it all scoring). The admitted bytes are reserved while the
         build runs, so concurrent loads cannot jointly overshoot the
-        budget."""
+        budget — via the shared ledger: each admission reserves there at
+        admission time and keeps the claim through residency, so in-flight
+        builds and residents alike are visible to every other admission in
+        the process (fit-side included)."""
         from .. import memory
         from ..parallel.mesh import (
             default_local_device,
@@ -147,9 +149,12 @@ class ModelRegistry:
             devices = [default_local_device()]
             while True:  # blocking-ok: each pass either admits or evicts one LRU entry; an empty registry re-raises — no waiting
                 try:
-                    adm = memory.admit_model_load(
+                    # residents already hold shared-ledger reservations, so
+                    # resident_bytes=0 — double-charging them here would
+                    # halve the effective serving budget
+                    adm = memory.admit_model_load(  # ledger-ok: THE serve-side admission entry — reserves through the shared ledger
                         model,
-                        resident_bytes=self.resident_bytes() + self._reserved_bytes,
+                        resident_bytes=0,
                         bucket_rows_count=self._cap,
                         devices=devices,
                     )
@@ -174,8 +179,10 @@ class ModelRegistry:
                         "resident %r (%s)", name, victim, e,
                     )
                     self._evict_locked(victim, reason=f"pressure from load of {name!r}")
-            self._reserved_bytes += adm.estimate.total()
         # ---- placement + prewarm: NO registry lock held ------------------
+        # the admission's ledger reservation is already live, so concurrent
+        # loads (and fit admissions) see this build's bytes; a failed build
+        # must hand them back
         try:
             dtype = "float64" if not model._float32_inputs else "float32"
             with telemetry.span(
@@ -191,9 +198,9 @@ class ModelRegistry:
                         max_rows = int(config.get("serve_prewarm_rows", 4096))
                         if max_rows > 0:
                             rungs = program.prewarm(n_cols, max_rows=max_rows)
-        finally:
-            with self._lock:
-                self._reserved_bytes -= adm.estimate.total()
+        except BaseException:
+            memory.release_admission(adm)
+            raise
         with self._lock:
             if name in self._entries:  # a concurrent load published first
                 self._evict_locked(name, reason="reloaded")
@@ -231,6 +238,8 @@ class ModelRegistry:
                 self._evict_locked(name, reason="registry cleared")
 
     def _evict_locked(self, name: str, reason: str) -> None:
+        from .. import memory
+
         entry = self._entries.pop(name)
         # the model carries WHY it left residency, largest byte term and all
         # — mirroring a refused load's stamp
@@ -238,7 +247,9 @@ class ModelRegistry:
         stamp["verdict"] = "evicted"
         stamp["reason"] = reason
         entry.model._serve_metrics["admission"] = stamp
-        # the program (and its device state) are the only HBM pins
+        # the program (and its device state) are the only HBM pins; the
+        # shared-ledger claim returns with them (docs/scheduling.md)
+        memory.release_admission(entry.admission)
         entry.program = None
         if telemetry.enabled():
             reg = telemetry.registry()

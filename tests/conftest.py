@@ -78,6 +78,19 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip_slow)
 
 
+@pytest.fixture(autouse=True)
+def _fresh_hbm_ledger():
+    # every HBM admission (fit, serving load, scheduler job) reserves in the
+    # process-global shared ledger (docs/scheduling.md); a test that admits
+    # without releasing (direct admit_* calls, un-evicted registries) must
+    # not shrink every later test's budget
+    from spark_rapids_ml_tpu.scheduler.ledger import reset_global_ledger
+
+    reset_global_ledger()
+    yield
+    reset_global_ledger()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -32,6 +32,7 @@ from ci.analysis.rules import (  # noqa: E402
     PadRowsRule,
     PerfCounterRule,
     RawDistanceRule,
+    LedgerBypassRule,
     ServeDispatchRule,
     SleepRule,
     SpmdDivergenceRule,
@@ -946,3 +947,85 @@ def test_repo_gate_is_clean_with_empty_baseline():
     # (empty) baseline — every finding is fixed or carries a reasoned waiver
     assert cli_main(["--no-imports"]) == 0
     assert baseline_mod.load(str(ROOT / "ci" / "analysis" / "baseline.json")) == {}
+
+
+# --------------------------------------------------------------------------
+# ledger-bypass: capacity math stays behind the shared HBM ledger
+# (docs/scheduling.md "The shared ledger")
+# --------------------------------------------------------------------------
+
+
+def test_ledger_bypass_direct_admit_fit_fires():
+    src = """
+    from spark_rapids_ml_tpu import memory
+    def place(est, ex, ctx):
+        return memory.admit_fit(est, ex, ctx)
+    """
+    fs = run(src, LedgerBypassRule)
+    assert rule_ids(fs) == ["ledger-bypass"]
+    assert "admit_fit" in fs[0].message
+
+
+def test_ledger_bypass_admit_model_load_and_memstats_fire():
+    src = """
+    def load(memory, model, dev):
+        adm = memory.admit_model_load(model)
+        cap = dev.memory_stats()
+        return adm, cap
+    """
+    fs = run(src, LedgerBypassRule)
+    assert rule_ids(fs) == ["ledger-bypass"] * 2
+
+
+def test_ledger_bypass_from_import_alias_fires():
+    src = """
+    from ..memory import admit_fit as place
+    def f(est, ex, ctx):
+        return place(est, ex, ctx)
+    """
+    fs = run(src, LedgerBypassRule)
+    assert rule_ids(fs) == ["ledger-bypass"]
+
+
+def test_ledger_bypass_waiver_suppresses():
+    src = """
+    from spark_rapids_ml_tpu import memory
+    def place(est, ex, ctx):
+        return memory.admit_fit(est, ex, ctx)  # ledger-ok: the fit-entry admission — reserves through the shared ledger
+    """
+    assert run(src, LedgerBypassRule) == []
+
+
+def test_ledger_bypass_exempt_in_owner_trees():
+    src = """
+    from spark_rapids_ml_tpu import memory
+    def place(est, ex, ctx):
+        return memory.admit_fit(est, ex, ctx)
+    """
+    # memory.py owns admission; scheduler/ owns the ledger; telemetry.py is
+    # the sanctioned watermark sampler
+    assert run(src, LedgerBypassRule, relpath="spark_rapids_ml_tpu/memory.py") == []
+    assert (
+        run(src, LedgerBypassRule, relpath="spark_rapids_ml_tpu/scheduler/queue.py")
+        == []
+    )
+    assert run(src, LedgerBypassRule, relpath="spark_rapids_ml_tpu/telemetry.py") == []
+
+
+def test_ledger_bypass_fp_guards():
+    # prose/docstring mentions never fire under AST rules, and a LOCAL
+    # function that shares the name is not the budgeter's admission
+    prose = '''
+    def doc():
+        """Admissions go through memory.admit_fit and admit_model_load."""
+        s = "memory.admit_fit(est, ex, ctx); d.memory_stats()"
+        return s
+    '''
+    assert run(prose, LedgerBypassRule) == []
+    local = """
+    def admit_fit(a, b):
+        return a + b
+    def f():
+        return admit_fit(1, 2)
+    """
+    assert run(local, LedgerBypassRule) == []

@@ -27,6 +27,9 @@ SMOKE = {
                                       "--num_queries", "64", "--nlist", "16", "--nprobe", "4"],
     "oocore": ["--num_rows", "4000", "--num_cols", "16", "--chunk_rows", "1024",
                "--maxIter", "3"],
+    "scheduler": ["--num_rows", "4000", "--num_cols", "16", "--tenants", "2",
+                  "--small_rows", "400", "--maxIter", "30",
+                  "--checkpoint_every", "2"],
     "dbscan": ["--num_rows", "500", "--num_cols", "8", "--eps", "3.0"],
     "umap": ["--num_rows", "400", "--num_cols", "8", "--n_epochs", "30"],
 }

@@ -223,7 +223,11 @@ def test_admit_applies_headroom_fraction(clean_config):
     clean_config["hbm_headroom_fraction"] = 0.25
     # budget = cap * 0.75: a capacity of need/0.75 + eps admits, below demotes
     clean_config["hbm_budget_bytes"] = int(need / 0.75) + 8
-    assert memory.admit_fit(est, ex, _FakeCtx()).verdict == memory.RESIDENT
+    dec = memory.admit_fit(est, ex, _FakeCtx())
+    assert dec.verdict == memory.RESIDENT
+    # hand the first admission's shared-ledger claim back (core's fit driver
+    # does this in its finally) so the second admission sees a clean book
+    memory.release_admission(dec)
     clean_config["hbm_budget_bytes"] = int(need / 0.75) - 8
     assert memory.admit_fit(est, ex, _FakeCtx()).verdict == memory.STREAM
 
@@ -364,3 +368,89 @@ def test_estimate_vs_memory_stats_watermark(rng):
     finally:
         telemetry.disable()
         telemetry.registry().reset()
+
+
+# ------------------------------------------------- shared HBM ledger --------
+# The split-brain bugfix (docs/scheduling.md "The shared ledger"): fits and
+# serving loads used to budget independently against FULL capacity, so a
+# concurrent fit plus resident served models could jointly overshoot HBM.
+# Both admission controllers now charge against capacity minus what the
+# process-global scheduler.HbmLedger already holds.
+
+
+class _FakeServeModel:
+    """Minimal serving-hook surface for admit_model_load."""
+
+    _float32_inputs = True
+
+    def __init__(self, nbytes):
+        self._nbytes = int(nbytes)
+
+    def _serve_placement_terms(self):
+        return {"params": self._nbytes}
+
+
+def test_fit_admission_subtracts_resident_serving_bytes(clean_config):
+    # THE satellite pin: a large model resident in the serving plane, then a
+    # fit that would fit an EMPTY budget must demote to STREAM because the
+    # model's bytes are already spoken for in the shared ledger.
+    from spark_rapids_ml_tpu.scheduler.ledger import global_ledger
+
+    ex = _dense_extracted(n=1000, d=12)
+    est = LinearRegression(float32_inputs=False)
+    need = memory.resident_estimate(est, ex, 8).total()
+    # budget comfortably fits the fit alone (2x) — no model, RESIDENT
+    clean_config["hbm_budget_bytes"] = int(2 * need / 0.9)
+    dec = memory.admit_fit(est, ex, _FakeCtx())
+    assert dec.verdict == memory.RESIDENT
+    memory.release_admission(dec)
+
+    # a "large model" load takes 1.25x the fit's bytes out of the budget:
+    # what remains (~0.75x) no longer fits the fit resident, but DOES fit
+    # its streaming working set — the demotion, not a refusal
+    load = memory.admit_model_load(
+        _FakeServeModel(int(1.25 * need)), bucket_rows_count=0
+    )
+    assert load.verdict == memory.RESIDENT
+    assert global_ledger().reserved_bytes(kind="serve") >= 1.25 * need
+
+    dec2 = memory.admit_fit(est, ex, _FakeCtx())
+    assert dec2.verdict == memory.STREAM and dec2.demoted
+    assert "already reserved" in dec2.reason  # the reason NAMES the ledger
+    memory.release_admission(dec2)
+    # evicting the model (releasing its claim) restores residency
+    memory.release_admission(load)
+    dec3 = memory.admit_fit(est, ex, _FakeCtx())
+    assert dec3.verdict == memory.RESIDENT
+    memory.release_admission(dec3)
+
+
+def test_model_load_admission_subtracts_fit_reservations(clean_config):
+    # ...and vice versa: a running fit's reservation counts against a model
+    # load, which refuses typed instead of jointly overshooting
+    ex = _dense_extracted(n=1000, d=12)
+    est = LinearRegression(float32_inputs=False)
+    need = memory.resident_estimate(est, ex, 8).total()
+    clean_config["hbm_budget_bytes"] = int(2 * need / 0.9)
+    fit_dec = memory.admit_fit(est, ex, _FakeCtx())  # holds `need` bytes
+    assert fit_dec.verdict == memory.RESIDENT
+    with pytest.raises(HbmBudgetError, match="held in the shared ledger"):
+        memory.admit_model_load(_FakeServeModel(int(1.5 * need)), bucket_rows_count=0)
+    # the fit completing frees the budget; the same load then admits
+    memory.release_admission(fit_dec)
+    load = memory.admit_model_load(_FakeServeModel(int(1.5 * need)), bucket_rows_count=0)
+    assert load.verdict == memory.RESIDENT
+    memory.release_admission(load)
+
+
+def test_release_admission_is_idempotent_and_none_safe(clean_config):
+    from spark_rapids_ml_tpu.scheduler.ledger import global_ledger
+
+    ex = _dense_extracted(n=200, d=4)
+    dec = memory.admit_fit(LinearRegression(float32_inputs=False), ex, _FakeCtx())
+    assert global_ledger().reserved_bytes() > 0
+    memory.release_admission(dec)
+    assert global_ledger().reserved_bytes() == 0
+    memory.release_admission(dec)  # double release: no-op, never a credit
+    memory.release_admission(None)
+    assert global_ledger().reserved_bytes() == 0

@@ -1,0 +1,580 @@
+#
+# Multi-tenant fit scheduler tests (spark_rapids_ml_tpu/scheduler/,
+# docs/scheduling.md): the shared HBM ledger's accounting, bin-packed
+# co-admission, the cooperative preemption -> checkpoint -> resume ladder
+# (bit-identity pinned for kmeans + logistic, dense + ELL), streaming
+# demotion after repeated displacement, typed saturation refusals, and
+# dead-job reservation reclamation.
+#
+# Every estimator here runs single-device (num_workers=1): co-admitted jobs
+# genuinely overlap on worker threads, and single-device programs carry no
+# collectives to deadlock on the shared CPU mesh.
+#
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import checkpoint as ckpt
+from spark_rapids_ml_tpu import core as core_mod
+from spark_rapids_ml_tpu import memory, telemetry
+from spark_rapids_ml_tpu.errors import PreemptedError, SchedulerSaturatedError
+from spark_rapids_ml_tpu.linalg import SparseVector
+from spark_rapids_ml_tpu.models.classification import LogisticRegression
+from spark_rapids_ml_tpu.models.clustering import KMeans
+from spark_rapids_ml_tpu.parallel import chaos
+from spark_rapids_ml_tpu.scheduler import (
+    FitScheduler,
+    HbmLedger,
+    global_ledger,
+    job_scope,
+)
+from spark_rapids_ml_tpu.scheduler.queue import FitJob
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    chaos.clear_fault_plan()
+    keys = (
+        "hbm_budget_bytes", "checkpoint_every_iters", "sched_max_preemptions",
+        "sched_max_concurrent", "fit_max_retries", "fit_retry_backoff_s",
+        "stream_chunk_rows",
+    )
+    saved = {k: core_mod.config[k] for k in keys}
+    core_mod.config["fit_retry_backoff_s"] = 0.01
+    telemetry.enable()
+    telemetry.registry().reset()
+    yield
+    chaos.clear_fault_plan()
+    core_mod.config.update(saved)
+    telemetry.disable()
+
+
+def _counters():
+    return telemetry.registry().snapshot()["counters"]
+
+
+def _blob_df(rng, n=600, d=5):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    return pd.DataFrame({"features": list(x)})
+
+
+def _cls_df(rng, n=800, d=6):
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+def _mk_kmeans(**kw):
+    est = KMeans(**{"k": 4, "maxIter": 6, "seed": 3, **kw})
+    est.num_workers = 1
+    return est
+
+
+def _need_bytes(est, df):
+    ex = est._pre_process_data(df, for_fit=True, defer_validation=True)
+    return memory.resident_estimate(est, ex, 1).total()
+
+
+def _set_budget(raw_bytes):
+    """hbm_budget_bytes such that the post-headroom budget is `raw_bytes`."""
+    core_mod.config["hbm_budget_bytes"] = int(raw_bytes / 0.9) + 16
+
+
+# ---------------------------------------------------------------- ledger ----
+
+
+def test_ledger_reserve_release_and_watermark():
+    led = HbmLedger()
+    a = led.reserve("a", "fit", 100)
+    b = led.reserve("b", "serve", 50)
+    assert led.reserved_bytes() == 150
+    assert led.reserved_bytes(kind="serve") == 50
+    assert led.reserved_bytes(exclude=a) == 50
+    assert led.high_watermark == 150
+    led.release(a)
+    assert led.reserved_bytes() == 50
+    led.release(a)  # idempotent: never a double credit
+    assert led.reserved_bytes() == 50
+    led.release(None)  # None-safe for finally blocks
+    led.release(b)
+    assert led.reserved_bytes() == 0
+    assert led.high_watermark == 150  # the watermark survives the drain
+
+
+def test_ledger_try_reserve_enforces_budget_atomically():
+    led = HbmLedger()
+    r1 = led.try_reserve("a", "job", 60, budget=100)
+    assert r1 is not None
+    assert led.try_reserve("b", "job", 50, budget=100) is None  # would overshoot
+    r3 = led.try_reserve("c", "job", 40, budget=100)  # exact fit admits
+    assert r3 is not None and led.reserved_bytes() == 100
+    # exclusion: re-truing one's own claim must not double-count itself
+    led.release(r3)
+    assert led.try_reserve("d", "job", 90, budget=100, exclude=r1) is not None
+    # a None budget is bookkeeping-only (no capacity info = no budgeting)
+    assert led.try_reserve("e", "job", 10**12, budget=None) is not None
+
+
+def test_ledger_resize_and_utilization():
+    led = HbmLedger()
+    r = led.reserve("job:1", "job", 100)
+    led.resize(r, 400)
+    assert led.reserved_bytes() == 400
+    assert led.high_watermark == 400
+    led.note_admission(800)
+    assert led.utilization() == 0.5
+    seen = []
+    led.admission_hooks.append(lambda reserved, budget: seen.append((reserved, budget)))
+    led.note_admission(800)
+    assert seen == [(400, 800)]
+
+
+# ------------------------------------------------------------ basic queue ---
+
+
+def test_single_job_completes_and_drains_ledger(rng):
+    df = _blob_df(rng)
+    sched = FitScheduler()
+    try:
+        job = sched.submit(_mk_kmeans(), df, tenant="a", priority=1)
+        model = job.result(timeout=120)
+        assert job.state == "completed" and job.done()
+        # per-tenant scheduler telemetry rides the job result
+        st = model._fit_metrics["scheduler"]
+        assert st["tenant"] == "a" and st["priority"] == 1
+        assert st["preemptions"] == 0 and st["queue_wait_s"] >= 0.0
+        snap = _counters()
+        assert snap["scheduler.jobs_submitted"] == 1
+        assert snap["scheduler.jobs_admitted"] == 1
+        assert snap["scheduler.jobs_completed"] == 1
+    finally:
+        sched.shutdown()
+    assert global_ledger().reserved_bytes() == 0
+
+
+def test_co_admission_bin_packs_within_budget(rng):
+    df = _blob_df(rng)
+    need = _need_bytes(_mk_kmeans(), df)
+    _set_budget(int(2.2 * need))  # two jobs co-admit, the third queues
+    violations = []
+    global_ledger().admission_hooks.append(
+        lambda reserved, budget: violations.append(reserved)
+        if budget is not None and reserved > budget
+        else None
+    )
+    sched = FitScheduler()
+    try:
+        jobs = [
+            sched.submit(_mk_kmeans(maxIter=12, tol=0.0), df, tenant=f"t{i}")
+            for i in range(3)
+        ]
+        for j in jobs:
+            j.result(timeout=120)
+    finally:
+        sched.shutdown()
+    snap = _counters()
+    assert snap["scheduler.jobs_admitted"] == 3
+    assert snap["scheduler.jobs_completed"] == 3
+    assert snap.get("scheduler.jobs_queued", 0) >= 1  # the third deferred
+    assert violations == []  # never over budget, at ANY admission
+    hwm = global_ledger().high_watermark
+    assert need <= hwm <= int(2.2 * need) + 16
+
+
+def test_respects_max_concurrent_cap(rng):
+    df = _blob_df(rng)
+    core_mod.config["sched_max_concurrent"] = 1
+    peak = [0]
+    sched = FitScheduler()
+    try:
+        jobs = [sched.submit(_mk_kmeans(), df, tenant=f"t{i}") for i in range(3)]
+        while not all(j.done() for j in jobs):
+            with sched._lock:
+                peak[0] = max(peak[0], len(sched._running))
+            time.sleep(0.005)
+        for j in jobs:
+            j.result(timeout=120)
+    finally:
+        sched.shutdown()
+    assert peak[0] <= 1
+
+
+def test_shutdown_fails_queued_jobs(rng):
+    df = _blob_df(rng)
+    need = _need_bytes(_mk_kmeans(), df)
+    _set_budget(int(1.2 * need))  # one at a time: later submissions queue
+    sched = FitScheduler()
+    jobs = [
+        sched.submit(_mk_kmeans(maxIter=30, tol=0.0), df, tenant=f"t{i}")
+        for i in range(4)
+    ]
+    sched.shutdown(wait=True, timeout=120)
+    states = {j.state for j in jobs}
+    assert "failed" in states  # drained queue entries fail typed
+    for j in jobs:
+        if j.state == "failed":
+            with pytest.raises(RuntimeError, match="shut down"):
+                j.result(timeout=1)
+    with pytest.raises(RuntimeError, match="shut down"):
+        sched.submit(_mk_kmeans(), df)
+    assert global_ledger().reserved_bytes() == 0
+
+
+# ------------------------------------------------- preemption bit-identity --
+# Deterministic unit-level preemption: the job's preempt flag is armed BEFORE
+# the fit, so the solver yields at its FIRST checkpoint boundary; the resume
+# re-enters with the same job-owned store. No scheduler timing involved.
+
+
+def _preempt_then_resume(make_est, df):
+    job = FitJob(99, make_est(), df, "t", 0)
+    job.request_preempt("test preemption")
+    with job_scope(job), ckpt.checkpoint_scope(store=job.store):
+        with pytest.raises(PreemptedError) as ei:
+            job.estimator.fit(df)
+    assert ei.value.job_id == 99 and ei.value.iteration >= 1
+    assert len(job.store) >= 1  # the boundary checkpoint survived the unwind
+    job._preempt.clear()
+    with job_scope(job), ckpt.checkpoint_scope(store=job.store):
+        resumed = make_est().fit(df)
+    return resumed
+
+
+def test_preempted_kmeans_resumes_bit_identical(rng):
+    df = _blob_df(rng)
+    core_mod.config["checkpoint_every_iters"] = 3
+
+    def make():
+        return _mk_kmeans(k=8, maxIter=10, tol=0.0, seed=7)
+
+    clean = make().fit(df)  # uninterrupted checkpointed fit
+    telemetry.registry().reset()
+    resumed = _preempt_then_resume(make, df)
+    np.testing.assert_array_equal(resumed.cluster_centers_, clean.cluster_centers_)
+    assert resumed.n_iter_ == clean.n_iter_
+    assert _counters()["checkpoint.restores"] >= 1  # resumed, not restarted
+
+
+def test_preempted_logistic_resumes_bit_identical(rng):
+    df = _cls_df(rng)
+    core_mod.config["checkpoint_every_iters"] = 4
+
+    def make():
+        est = LogisticRegression(maxIter=20)
+        est.num_workers = 1
+        return est
+
+    clean = make().fit(df)
+    telemetry.registry().reset()
+    resumed = _preempt_then_resume(make, df)
+    np.testing.assert_array_equal(resumed.coef_, clean.coef_)
+    np.testing.assert_array_equal(resumed.intercept_, clean.intercept_)
+    assert resumed.n_iter_ == clean.n_iter_
+    assert _counters()["checkpoint.restores"] >= 1
+
+
+def test_preempted_logistic_ell_resumes_bit_identical(rng):
+    # the sparse (padded-ELL) solver path yields at the same segmented
+    # boundary — preemption is layout-independent
+    d = 20
+    x = rng.normal(size=(1200, d))
+    x = np.where(np.abs(x) > 1.0, x, 0.0)
+    rows = [
+        SparseVector(d, np.nonzero(r)[0].astype(np.int32), r[np.nonzero(r)[0]])
+        for r in x
+    ]
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    df = pd.DataFrame({"features": rows, "label": y})
+    core_mod.config["checkpoint_every_iters"] = 4
+
+    def make():
+        est = LogisticRegression(
+            maxIter=20, regParam=0.01, enable_sparse_data_optim=True,
+            float32_inputs=False,
+        )
+        est.num_workers = 1
+        return est
+
+    clean = make().fit(df)
+    telemetry.registry().reset()
+    resumed = _preempt_then_resume(make, df)
+    np.testing.assert_array_equal(
+        np.asarray(resumed.coef_), np.asarray(clean.coef_)
+    )
+    assert _counters()["checkpoint.restores"] >= 1
+
+
+# ------------------------------------------------------ 3-tenant scenario ---
+
+
+def test_three_tenants_preempt_resume_acceptance(rng):
+    # THE acceptance scenario (ISSUE 12): a low-priority big fit running; a
+    # high-priority small fit preempts it; a third tenant queues in between;
+    # all complete. Pins: the preempted fit's final model is BIT-identical
+    # to an uninterrupted checkpointed run, the ledger never exceeds the
+    # budget AT ANY admission, and per-tenant scheduler.* telemetry rides
+    # every job result.
+    xb = rng.normal(size=(20_000, 32)).astype(np.float32)
+    df_big = pd.DataFrame({"features": list(xb)})
+    df_small = _blob_df(rng, n=500, d=32)
+    core_mod.config["checkpoint_every_iters"] = 2
+
+    def mk_big():
+        return _mk_kmeans(k=16, maxIter=200, tol=0.0, seed=7)
+
+    def mk_small():
+        return _mk_kmeans(k=4, maxIter=5, seed=3)
+
+    need_b = _need_bytes(mk_big(), df_big)
+    need_s = _need_bytes(mk_small(), df_small)
+    # the big fit fits ALONE; big + small does NOT — the high-priority small
+    # job can only run by preempting
+    _set_budget(int(need_b + 0.5 * need_s))
+
+    ref = mk_big().fit(df_big)  # uninterrupted checkpointed reference
+
+    violations = []
+    budgets = []
+    global_ledger().admission_hooks.append(
+        lambda reserved, budget: (
+            budgets.append(budget),
+            violations.append(reserved) if budget is not None and reserved > budget else None,
+        )
+    )
+    telemetry.registry().reset()
+    sched = FitScheduler()
+    try:
+        mark = telemetry.registry().mark()
+        job_big = sched.submit(mk_big(), df_big, tenant="batch", priority=0)
+        # wait until the big fit is genuinely mid-solve (its OWN checkpoints)
+        deadline = time.monotonic() + 120
+        while (
+            telemetry.registry().delta(mark)["counters"].get("checkpoint.saves", 0) < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        job_hi = sched.submit(mk_small(), df_small, tenant="interactive", priority=10)
+        job_mid = sched.submit(mk_small(), df_small, tenant="reporting", priority=5)
+        m_hi = job_hi.result(timeout=180)
+        m_mid = job_mid.result(timeout=180)
+        m_big = job_big.result(timeout=300)
+    finally:
+        sched.shutdown()
+
+    # every tenant completed; the big fit was preempted and resumed
+    snap = _counters()
+    assert snap["scheduler.jobs_preempted"] >= 1
+    assert snap["scheduler.jobs_resumed"] >= 1
+    assert snap["checkpoint.restores"] >= 1
+    assert job_big.preemptions >= 1 and job_big.state == "completed"
+    # bit-identical to the uninterrupted checkpointed fit — zero lost work
+    np.testing.assert_array_equal(
+        np.asarray(m_big.cluster_centers_), np.asarray(ref.cluster_centers_)
+    )
+    assert m_big.n_iter_ == ref.n_iter_
+    # the ledger never exceeded the budget, checked at EVERY admission
+    assert violations == [] and len(budgets) >= 3
+    assert global_ledger().reserved_bytes() == 0
+    # per-tenant scheduler telemetry present in each job result
+    for model, tenant in ((m_big, "batch"), (m_hi, "interactive"), (m_mid, "reporting")):
+        st = model._fit_metrics["scheduler"]
+        assert st["tenant"] == tenant
+        assert st["queue_wait_s"] >= 0.0 and "hbm_share" in st
+    assert m_big._fit_metrics["scheduler"]["preemptions"] >= 1
+    # the high-priority tenant never waited for the whole big fit
+    assert m_hi._fit_metrics["scheduler"]["queue_wait_s"] < job_big.run_s + 60
+
+
+# ------------------------------------------------------------- demotion -----
+
+
+def test_preempted_too_often_job_demotes_to_streaming(rng):
+    # sched_max_preemptions=1: the FIRST preemption demotes the job — its
+    # re-admission runs the out-of-core streaming path (floor footprint,
+    # always packable) and the model carries the stream verdict. The
+    # preemption is requested directly on the job handle so the test is
+    # deterministic regardless of solver speed (the scheduler-initiated
+    # request path is pinned by the 3-tenant acceptance test above).
+    x = rng.normal(size=(60_000, 32))
+    y = (x @ rng.normal(size=32) > 0).astype(np.float64)
+    df_big = pd.DataFrame({"features": list(x), "label": y})
+    core_mod.config["checkpoint_every_iters"] = 2
+    core_mod.config["sched_max_preemptions"] = 1
+
+    def mk_big():
+        est = LogisticRegression(maxIter=40, tol=0.0, regParam=1e-4)
+        est.num_workers = 1
+        return est
+
+    _set_budget(int(1.5 * _need_bytes(mk_big(), df_big)))
+
+    sched = FitScheduler()
+    try:
+        mark = telemetry.registry().mark()
+        job_big = sched.submit(mk_big(), df_big, tenant="batch", priority=0)
+        deadline = time.monotonic() + 120
+        while (
+            telemetry.registry().delta(mark)["counters"].get("checkpoint.saves", 0) < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        job_big.request_preempt("higher-priority tenant needs the reservation")
+        m_big = job_big.result(timeout=600)
+    finally:
+        sched.shutdown()
+    snap = _counters()
+    assert snap["scheduler.jobs_preempted"] >= 1
+    assert snap["scheduler.jobs_demoted"] == 1
+    assert job_big.demoted and job_big.state == "completed"
+    st = m_big._fit_metrics["scheduler"]
+    assert st["demoted"] is True
+    # the demoted re-admission really streamed (degraded-mode service)
+    adm = m_big._fit_metrics["admission"]
+    assert adm["verdict"] == "stream"
+    assert "sched_max_preemptions" in adm["reason"]
+    assert global_ledger().reserved_bytes() == 0
+
+
+# ------------------------------------------------------------- refusals -----
+
+
+def test_submit_refuses_never_fitting_job_typed(rng):
+    df = _blob_df(rng, n=2000, d=16)
+    core_mod.config["hbm_budget_bytes"] = 2000  # smaller than any floor
+    sched = FitScheduler()
+    try:
+        with pytest.raises(SchedulerSaturatedError) as ei:
+            sched.submit(_mk_kmeans(), df, tenant="hopeless")
+        e = ei.value
+        assert e.tenant == "hopeless"
+        assert e.estimate_bytes and e.budget_bytes and e.largest_term
+        assert e.largest_term in str(e)
+        assert isinstance(e, MemoryError)  # mirrors HbmBudgetError's IS-A
+        assert _counters()["scheduler.jobs_refused"] == 1
+    finally:
+        sched.shutdown()
+    assert global_ledger().reserved_bytes() == 0
+
+
+# -------------------------------------------------------- dead-job chaos ----
+
+
+def test_dead_tenant_job_reclaims_reservation_and_queue_drains(rng):
+    # chaos-killed tenant (the chaos_worker pattern: an injected stage fault
+    # with the retry budget at zero = the fit dies abruptly): the scheduler
+    # must reclaim the dead job's reservation and keep scheduling — a dead
+    # tenant cannot wedge the queue
+    df = _blob_df(rng)
+    need = _need_bytes(_mk_kmeans(), df)
+    _set_budget(int(1.2 * need))  # one job at a time: the second queues
+    core_mod.config["fit_max_retries"] = 0
+    chaos.set_fault_plan("fail:stage=fit:times=1")
+    sched = FitScheduler()
+    try:
+        doomed = sched.submit(_mk_kmeans(), df, tenant="dead")
+        survivor = sched.submit(_mk_kmeans(), df, tenant="alive")
+        model = survivor.result(timeout=120)
+        assert model is not None and survivor.state == "completed"
+        with pytest.raises(Exception):
+            doomed.result(timeout=60)
+        assert doomed.state == "failed"
+    finally:
+        sched.shutdown()
+    snap = _counters()
+    assert snap["scheduler.jobs_failed"] == 1
+    assert snap["scheduler.jobs_completed"] == 1
+    assert global_ledger().reserved_bytes() == 0  # the dead job's claim reclaimed
+
+
+# ------------------------------------------------------------- telemetry ----
+
+
+def test_ledger_gauges_flow_through_registry(rng):
+    df = _blob_df(rng)
+    _set_budget(int(3 * _need_bytes(_mk_kmeans(), df)))
+    sched = FitScheduler()
+    try:
+        sched.submit(_mk_kmeans(), df, tenant="a").result(timeout=120)
+    finally:
+        sched.shutdown()
+    snap = telemetry.registry().snapshot()
+    assert "scheduler.ledger_reserved_bytes" in snap["gauges"]
+    assert "scheduler.ledger_utilization" in snap["gauges"]
+    assert snap["histograms"].get("scheduler.queue_wait_s", {}).get("count", 0) >= 1
+    assert snap["histograms"].get("scheduler.hbm_share", {}).get("count", 0) >= 1
+    stats = sched.stats()
+    assert stats["tenants"]["a"]["completed"] == 1
+    assert stats["ledger_reserved_bytes"] == 0
+
+
+# --------------------------------------------------- review regressions -----
+
+
+def test_transient_retry_readmits_without_double_count(rng):
+    # a retry re-enters admission while the failed attempt's reservation is
+    # still held; the re-admission must hand that claim back first — a
+    # resident fit at ~0.9x budget must NOT spuriously demote on retry (and
+    # the retried model stays bit-identical, the PR-3 contract)
+    df = _blob_df(rng)
+    est_probe = _mk_kmeans(k=8, maxIter=10, tol=0.0, seed=7)
+    need = _need_bytes(est_probe, df)
+    _set_budget(int(1.1 * need))  # resident fits, but not twice over
+    core_mod.config["checkpoint_every_iters"] = 3
+
+    clean = _mk_kmeans(k=8, maxIter=10, tol=0.0, seed=7).fit(df)
+    assert clean._fit_metrics["admission"]["verdict"] == "resident"
+
+    chaos.set_fault_plan("fail:stage=solve:times=1")
+    telemetry.registry().reset()
+    retried = _mk_kmeans(k=8, maxIter=10, tol=0.0, seed=7).fit(df)
+    snap = _counters()
+    assert snap["fit.retries"] == 1
+    assert snap.get("fit.demotions", 0) == 0  # NOT demoted by its own ghost
+    assert retried._fit_metrics["admission"]["verdict"] == "resident"
+    np.testing.assert_array_equal(retried.cluster_centers_, clean.cluster_centers_)
+    assert global_ledger().reserved_bytes() == 0
+
+
+def test_no_preemption_request_without_checkpoint_cadence(rng):
+    # cadence 0: solvers never reach a yield point, so requesting preemption
+    # would only freeze backfill — the blocked high-priority job waits for
+    # completion instead, and the victim's flag is never set
+    df = _blob_df(rng)
+    need = _need_bytes(_mk_kmeans(), df)
+    _set_budget(int(1.2 * need))
+    core_mod.config["checkpoint_every_iters"] = 0
+    sched = FitScheduler()
+    try:
+        low = sched.submit(_mk_kmeans(maxIter=30, tol=0.0), df, tenant="low", priority=0)
+        hi = sched.submit(_mk_kmeans(), df, tenant="hi", priority=10)
+        hi.result(timeout=120)
+        low.result(timeout=120)
+    finally:
+        sched.shutdown()
+    assert low.preemptions == 0 and not low.preempt_requested()
+    assert _counters().get("scheduler.jobs_preempted", 0) == 0
+
+
+def test_refused_jobs_appear_in_stats(rng):
+    df = _blob_df(rng, n=2000, d=16)
+    core_mod.config["hbm_budget_bytes"] = 2000
+    sched = FitScheduler()
+    try:
+        with pytest.raises(SchedulerSaturatedError):
+            sched.submit(_mk_kmeans(), df, tenant="hopeless")
+        t = sched.stats()["tenants"]["hopeless"]
+        assert t["jobs"] == 1 and t["failed"] == 1
+    finally:
+        sched.shutdown()
+
+
+def test_package_level_fitscheduler_is_the_real_class():
+    import spark_rapids_ml_tpu as pkg
+    from spark_rapids_ml_tpu.scheduler import FitScheduler as real
+
+    assert pkg.FitScheduler is real  # PEP 562 lazy export, not a wrapper
+    sched = pkg.FitScheduler(ledger=HbmLedger())  # kwargs AND the class API
+    assert isinstance(sched, pkg.FitScheduler)
+    sched.shutdown()
