@@ -394,7 +394,12 @@ def bench_scheduler_lane() -> float:
             "sched_contention lane: ledger exceeded the budget at "
             f"{int(out['ledger_over_budget_admissions'])} admission(s)"
         )
-    return out["rows_per_sec"]
+    # report-only ops embed (SLO verdict + per-tenant byte-seconds): rides
+    # the BENCH record's "ops" key, never the gated geomean
+    return out["rows_per_sec"], None, {
+        "slo": out.get("slo", {}),
+        "tenant_byte_seconds": out.get("tenant_byte_seconds", {}),
+    }
 
 
 def bench_serving_lane() -> tuple:
@@ -434,6 +439,10 @@ def bench_serving_lane() -> tuple:
     return out["rows_per_sec"], {
         "serving_p50_ms": round(out["p50_ms"], 3),
         "serving_p99_ms": round(out["p99_ms"], 3),
+    }, {
+        # report-only ops embed, same contract as the scheduler lane's
+        "slo": out.get("slo", {}),
+        "tenant_byte_seconds": out.get("tenant_byte_seconds", {}),
     }
 
 
@@ -514,15 +523,19 @@ def run_child() -> int:
         _phase(f"lane:{name}:start")
         try:
             out = runners[name]()
-            # a lane may return (value, latency_dict): the latency values ride
-            # the @RESULT line into the BENCH record's `latency_lanes` embed
-            latency = None
+            # a lane may return (value, latency_dict[, ops_dict]): latency
+            # values ride the @RESULT line into the BENCH record's
+            # `latency_lanes` embed; the ops dict (SLO verdict + per-tenant
+            # byte-seconds) rides report-only under `ops`
+            latency = ops = None
             if isinstance(out, tuple):
-                out, latency = out
+                out, latency, ops = (out + (None,))[:3]
             v = out if name in SINGLE_DEVICE_LANES else out / n_chips
             rec = {"algo": name, "rows_per_sec_chip": v}
             if latency:
                 rec["latency"] = latency
+            if ops:
+                rec["ops"] = ops
             print("@RESULT " + json.dumps(rec), flush=True)
             _phase(f"lane:{name}:end")
         except Exception as e:  # fail-soft: one dead section keeps the rest
@@ -619,6 +632,7 @@ def emit(
     telemetry_snap: Optional[dict] = None,
     attempts: Optional[list] = None,
     latency_lanes: Optional[dict] = None,
+    ops_lanes: Optional[dict] = None,
 ) -> None:
     """The one stdout JSON line. Degrades to value 0.0 when nothing ran.
     The five headline BASELINES algos (pca/logreg/kmeans/kmeans_scale/knn)
@@ -678,6 +692,10 @@ def emit(
         # LOWER-IS-BETTER lane against its own trajectory, so a p99 blowup
         # fails even when the throughput lanes look fine
         record["latency_lanes"] = {k: float(v) for k, v in latency_lanes.items()}
+    if ops_lanes:
+        # per-lane ops embeds (end-of-run SLO verdict + per-tenant
+        # byte-seconds): REPORT-ONLY — the regression gate never reads them
+        record["ops"] = ops_lanes
     if telemetry_snap:
         record["telemetry"] = telemetry_snap
     if attempts:
@@ -690,11 +708,12 @@ def main() -> None:
     telemetry_snap: dict = {}
     attempts: list = []
     latency_lanes: dict = {}
+    ops_lanes: dict = {}
     try:
-        _attempt_loop(results, telemetry_snap, attempts, latency_lanes)
+        _attempt_loop(results, telemetry_snap, attempts, latency_lanes, ops_lanes)
     except Exception as e:  # the JSON line is a CONTRACT: never die before emit
         _log(f"bench driver error: {type(e).__name__}: {e}")
-    emit(results, telemetry_snap, attempts, latency_lanes)
+    emit(results, telemetry_snap, attempts, latency_lanes, ops_lanes)
 
 
 def _attempt_loop(
@@ -702,6 +721,7 @@ def _attempt_loop(
     telemetry_snap: Optional[dict] = None,
     attempts: Optional[list] = None,
     latency_lanes: Optional[dict] = None,
+    ops_lanes: Optional[dict] = None,
 ) -> None:
     # total budget DEFAULTS BELOW any plausible driver timeout: if the caller
     # kills this process before emit(), the JSON contract is lost — 45 min
@@ -735,6 +755,8 @@ def _attempt_loop(
                         latency_lanes.update(
                             {k: float(v) for k, v in rec["latency"].items()}
                         )
+                    if ops_lanes is not None and isinstance(rec.get("ops"), dict):
+                        ops_lanes[rec["algo"]] = rec["ops"]
                 except (ValueError, KeyError, TypeError):
                     pass
             elif line.startswith("@TELEMETRY ") and telemetry_snap is not None:

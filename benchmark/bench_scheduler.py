@@ -31,11 +31,12 @@ from .base import BenchmarkBase
 
 
 def _quantile(values: List[float], q: float) -> float:
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
-    return float(ordered[idx])
+    """telemetry.quantile_of with the lane's 0.0-on-empty convention — the
+    one shared nearest-rank extraction (docs/observability.md)."""
+    from spark_rapids_ml_tpu.telemetry import quantile_of
+
+    v = quantile_of(values, q)
+    return 0.0 if v is None else v
 
 
 def run_scheduler_bench(
@@ -58,6 +59,7 @@ def run_scheduler_bench(
     BENCH_SCHED lane."""
     from spark_rapids_ml_tpu import core, memory, telemetry
     from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.ops_plane import slo as ops_slo
     from spark_rapids_ml_tpu.scheduler import FitScheduler, reset_global_ledger
 
     telemetry.enable()
@@ -87,11 +89,22 @@ def run_scheduler_bench(
     need_s = memory.resident_estimate(mk_small(), ext_s, 1).total()
     saved = {
         k: core.config[k]
-        for k in ("hbm_budget_bytes", "checkpoint_every_iters", "sched_max_preemptions")
+        for k in ("hbm_budget_bytes", "checkpoint_every_iters",
+                  "sched_max_preemptions", "slo")
     }
     core.config["hbm_budget_bytes"] = int((need_b + 0.5 * need_s) / 0.9)
     core.config["checkpoint_every_iters"] = int(checkpoint_every)
     core.config["sched_max_preemptions"] = 2
+    if not saved["slo"]:
+        # report-only SLO verdict embedded in the BENCH record (outside the
+        # gated geomean): queue-wait latency + ledger-utilization ceiling
+        core.config["slo"] = [
+            {"name": "queue_wait_p99", "kind": "latency",
+             "histogram": "scheduler.queue_wait_s", "threshold_s": 60.0,
+             "objective": 0.95},
+            {"name": "ledger_util", "kind": "gauge_ceiling",
+             "gauge": "scheduler.ledger_utilization", "ceiling": 1.0},
+        ]
 
     ledger = reset_global_ledger()
     # budget-conformance samples: (reserved, budget) at EVERY admission
@@ -144,6 +157,11 @@ def run_scheduler_bench(
             for name, t_stats in stats["tenants"].items()
         }
         counters = telemetry.registry().snapshot()["counters"]
+        # end-of-run ops verdicts (report-only BENCH embeds): the SLO health
+        # over THIS run's queue waits, and the ledger's per-tenant
+        # byte-second integration
+        slo_health = ops_slo.health(fresh=True)
+        tenant_usage = ledger.tenant_usage()
         total_rows = float(sum(rows for _, rows in jobs))
         out: Dict[str, float] = {
             "fit": wall,
@@ -161,11 +179,21 @@ def run_scheduler_bench(
             "demotions": float(counters.get("scheduler.jobs_demoted", 0.0)),
         }
         out["per_tenant"] = per_tenant  # type: ignore[assignment]
+        out["slo"] = {  # type: ignore[assignment]
+            "healthy": slo_health["healthy"],
+            "failing": slo_health["failing"],
+            "verdicts": slo_health["verdicts"],
+        }
+        out["tenant_byte_seconds"] = {  # type: ignore[assignment]
+            t: round(u.get("byte_seconds", 0.0), 3)
+            for t, u in tenant_usage.items()
+        }
         return out
     finally:
         sched.shutdown(wait=True, timeout=60)
         ledger.admission_hooks.remove(_check)
         core.config.update(saved)
+        ops_slo.reset()
 
 
 class BenchmarkScheduler(BenchmarkBase):
@@ -190,9 +218,14 @@ class BenchmarkScheduler(BenchmarkBase):
             checkpoint_every=args.checkpoint_every, seed=args.seed,
         )
         data["counters"] = {
-            k: v for k, v in out.items() if k not in ("fit", "per_tenant")
+            k: v for k, v in out.items()
+            if k not in ("fit", "per_tenant", "slo", "tenant_byte_seconds")
         }
         data["per_tenant"] = out.get("per_tenant", {})
+        data["ops"] = {
+            "slo": out.get("slo", {}),
+            "tenant_byte_seconds": out.get("tenant_byte_seconds", {}),
+        }
         return {"fit": out["fit"]}
 
     def quality(self, args, data) -> Dict[str, float]:

@@ -46,6 +46,8 @@ def run_serving_bench(
 
     from spark_rapids_ml_tpu import core, telemetry
     from spark_rapids_ml_tpu.models.clustering import KMeansModel
+    from spark_rapids_ml_tpu.ops_plane import slo as ops_slo
+    from spark_rapids_ml_tpu.scheduler.ledger import global_ledger
     from spark_rapids_ml_tpu.serving import ModelRegistry, ScoringEngine
 
     rng = np.random.default_rng(seed)
@@ -54,7 +56,20 @@ def run_serving_bench(
 
     telemetry.enable()
     saved = core.config["serve_coalesce_window_ms"]
+    saved_slo = core.config["slo"]
     core.config["serve_coalesce_window_ms"] = float(coalesce_window_ms)
+    if not saved_slo:
+        # report-only SLO verdict embedded in the BENCH record (outside the
+        # gated geomean): lenient lab objectives, the point is that the
+        # burn-rate machinery ran over THIS run's traffic
+        core.config["slo"] = [
+            {"name": "serve_e2e_p99", "kind": "latency",
+             "histogram": "serve.e2e_s", "threshold_s": 0.5,
+             "objective": 0.99},
+            {"name": "serve_errors", "kind": "error_rate",
+             "errors": "serve.errors", "total": "serve.requests",
+             "threshold": 0.01},
+        ]
     mark = telemetry.registry().mark()
     try:
         registry = ModelRegistry()
@@ -94,8 +109,13 @@ def run_serving_bench(
             float(np.max(np.abs(np.asarray(r) - s))) if s.size else 0.0
             for r, s in zip(responses, solo)
         )
+        # end-of-run ops verdicts, evaluated while the SLO config is live
+        slo_health = ops_slo.health(fresh=True)
+        tenant_usage = global_ledger().tenant_usage()
     finally:
         core.config["serve_coalesce_window_ms"] = saved
+        core.config["slo"] = saved_slo
+        ops_slo.reset()
 
     delta = telemetry.registry().delta(mark)
     counters = delta.get("counters", {})
@@ -114,6 +134,17 @@ def run_serving_bench(
         "batches": float(counters.get("serve.batches", 0.0)),
         "bucket_hits": float(counters.get("serve.bucket_hits", 0.0)),
         "prewarmed_programs": float(entry.prewarmed_rungs),
+        # report-only ops embeds (non-scalar; ride the BENCH record under
+        # "ops", never the gated geomean)
+        "slo": {
+            "healthy": slo_health["healthy"],
+            "failing": slo_health["failing"],
+            "verdicts": slo_health["verdicts"],
+        },
+        "tenant_byte_seconds": {
+            t: round(u.get("byte_seconds", 0.0), 3)
+            for t, u in tenant_usage.items()
+        },
     }
 
 
@@ -142,7 +173,13 @@ class BenchmarkServing(BenchmarkBase):
             serve_dtype=args.serve_dtype,
             seed=args.seed,
         )
-        data["counters"] = {key: v for key, v in out.items() if key != "fit"}
+        data["counters"] = {
+            key: v for key, v in out.items()
+            if key not in ("fit", "slo", "tenant_byte_seconds")
+        }
+        data["ops"] = {
+            "slo": out["slo"], "tenant_byte_seconds": out["tenant_byte_seconds"]
+        }
         return {"fit": out["fit"]}
 
     def quality(self, args, data) -> Dict[str, float]:

@@ -1,0 +1,162 @@
+#
+# opsreport: render an ops-plane report — live from this process, or from a
+# snapshot file written by `ops_plane.export.write_snapshot()` (the rotating
+# `ops_snapshot.json` a headless run leaves behind, or the per-rank
+# `ops_snapshot_rank_<r>.json` a flight-recorder dump rides with).
+#
+#   python -m benchmark.opsreport /path/ops_snapshot.json
+#   python -m benchmark.opsreport snap.json --tenant tenant3
+#   python -m benchmark.opsreport snap.json --trace-id ab12... --json
+#   python -m benchmark.opsreport --write /tmp/ops_snapshot.json  # archive
+#
+# The human rendering answers the on-call question directly: which SLO is
+# violated (burn rates and windows), which tenants are holding/holding-up
+# HBM (byte-seconds, chip-seconds), and the decision-log entries — tenant,
+# verdict, reason — for the filtered tenant/trace
+# (docs/observability.md "Ops plane").
+#
+# Exit codes: 0 = healthy (or no SLOs configured), 1 = at least one SLO
+# failing, 2 = snapshot unreadable.
+#
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _fmt_burn(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.2f}"
+
+
+def _fmt_bytes(v: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0:
+            return f"{v:,.1f}{unit}"
+        v /= 1024.0
+    return f"{v:,.1f}TiB"
+
+
+def render(
+    report: Dict[str, Any],
+    *,
+    tenant: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    decision_limit: int = 20,
+) -> str:
+    lines: List[str] = []
+    health = report.get("health") or {}
+    verdicts = report.get("slo") or []
+    ok = bool(health.get("healthy", True))
+    lines.append(
+        f"health: {'OK' if ok else 'FAILING'} "
+        f"({health.get('specs', 0)} SLO spec(s))"
+    )
+    for v in verdicts:
+        mark = "FAIL" if v.get("failing") else "ok"
+        extra = ""
+        if v.get("kind") == "latency":
+            extra = f" threshold={v.get('threshold_s')}s objective={v.get('objective')}"
+        elif v.get("kind") == "error_rate":
+            extra = f" threshold={v.get('threshold')}"
+        elif v.get("kind") == "gauge_ceiling":
+            extra = f" value={v.get('value')} ceiling={v.get('ceiling')}"
+        lines.append(
+            f"  [{mark:>4}] {v.get('name')} ({v.get('kind')}): "
+            f"burn fast={_fmt_burn(v.get('fast_burn'))}"
+            f"/{v.get('fast_burn_threshold')} "
+            f"({v.get('fast_window_s'):g}s), "
+            f"slow={_fmt_burn(v.get('slow_burn'))}"
+            f"/{v.get('slow_burn_threshold')} "
+            f"({v.get('slow_window_s'):g}s){extra}"
+        )
+    tenants = report.get("tenants") or {}
+    if tenants:
+        lines.append("tenant HBM accounting:")
+        for name in sorted(tenants):
+            if tenant is not None and name != tenant:
+                continue
+            u = tenants[name]
+            live = (
+                f", live {_fmt_bytes(u['live_bytes'])} "
+                f"across {int(u.get('live_reservations', 0))} claim(s)"
+                if u.get("live_bytes")
+                else ""
+            )
+            lines.append(
+                f"  {name}: {_fmt_bytes(u.get('byte_seconds', 0.0))}·s, "
+                f"{u.get('chip_seconds', 0.0):.3f} chip·s over "
+                f"{int(u.get('reservations', 0))} reservation(s){live}"
+            )
+    decisions = report.get("decisions") or []
+    if tenant is not None:
+        decisions = [d for d in decisions if d.get("tenant") == tenant]
+    if trace_id is not None:
+        decisions = [d for d in decisions if d.get("trace_id") == trace_id]
+    scope = ""
+    if tenant is not None:
+        scope += f" tenant={tenant}"
+    if trace_id is not None:
+        scope += f" trace={trace_id}"
+    lines.append(f"decision log{scope}: {len(decisions)} entr(ies)")
+    for d in decisions[-max(0, decision_limit):]:
+        reason = f" — {d['reason']}" if d.get("reason") else ""
+        tid = f" trace={d['trace_id']}" if d.get("trace_id") else ""
+        lines.append(
+            f"  [{d.get('kind')}/{d.get('subsystem')}] "
+            f"tenant={d.get('tenant')} {d.get('subject')}: "
+            f"{d.get('verdict')}{reason}{tid}"
+        )
+    drift = report.get("drift")
+    if drift:
+        psi = (
+            f", psi_max={drift['psi_max']:.4f}" if "psi_max" in drift else ""
+        )
+        lines.append(
+            f"ingest drift: {drift.get('rows', 0)} row(s) over "
+            f"{len(drift.get('columns', []))} column(s){psi}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="opsreport",
+        description="render an ops-plane report (live, or from a snapshot file)",
+    )
+    p.add_argument("snapshot", nargs="?", default=None,
+                   help="ops_snapshot.json path (omitted = this process's live state)")
+    p.add_argument("--tenant", default=None, help="filter decisions/accounting to one tenant")
+    p.add_argument("--trace-id", default=None, help="filter decisions to one trace")
+    p.add_argument("--json", action="store_true", help="emit the raw report dict")
+    p.add_argument("--decisions", type=int, default=20, help="decision-log entries rendered")
+    p.add_argument("--write", default=None, metavar="PATH",
+                   help="also archive the report as a rotating snapshot at PATH")
+    args = p.parse_args(argv)
+
+    if args.snapshot is not None:
+        try:
+            with open(args.snapshot) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"opsreport: cannot read {args.snapshot}: {e}", file=sys.stderr)
+            return 2
+    else:
+        from spark_rapids_ml_tpu import ops_plane
+
+        report = ops_plane.report(tenant=args.tenant, trace_id=args.trace_id)
+        if args.write:
+            from spark_rapids_ml_tpu.ops_plane import export
+
+            export.write_snapshot(args.write)
+    if args.json:
+        print(json.dumps(report, default=str))
+    else:
+        print(render(report, tenant=args.tenant, trace_id=args.trace_id,
+                     decision_limit=args.decisions))
+    return 0 if (report.get("health") or {}).get("healthy", True) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

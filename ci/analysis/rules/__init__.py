@@ -11,6 +11,7 @@ from typing import List
 from ..engine import RuleBase
 from .blocking import BlockingRule
 from .distance import RawDistanceRule
+from .exporter import ExporterScopeRule
 from .hostsync import HostSyncRule
 from .hygiene import KNOWN_WAIVER_TAGS, HygieneRule
 from .jsonl import JsonlRule
@@ -42,6 +43,7 @@ def default_rules() -> List[RuleBase]:
         RawDistanceRule(),
         ServeDispatchRule(),
         LedgerBypassRule(),
+        ExporterScopeRule(),
         ConfigKeyRule(),
         MetricNameRule(),
     ]
@@ -67,6 +69,7 @@ __all__ = [
     "RawDistanceRule",
     "ServeDispatchRule",
     "LedgerBypassRule",
+    "ExporterScopeRule",
     "ConfigKeyRule",
     "MetricNameRule",
 ]

@@ -1,0 +1,95 @@
+#
+# exporter-scope: the ops plane's export surface, CI-enforced
+# (docs/observability.md "Ops plane"). `spark_rapids_ml_tpu/ops_plane/` is
+# the ONE owner of scrape-surface machinery: raw `http.server` /
+# `socketserver` use, raw `socket.socket()`/`socket.create_server()`
+# construction, and Prometheus text-format assembly (string literals
+# carrying the `# TYPE ` / `# HELP ` exposition markers) anywhere else in
+# the framework or benchmark trees are findings. A second ad-hoc HTTP
+# endpoint would ship metrics with none of the rank labels, SLO verdicts,
+# or health semantics the one exporter guarantees — and a hand-assembled
+# Prometheus line is exactly the kind of stringly-typed drift the metric
+# registry rules exist to kill. Genuinely non-exporter socket use (the
+# distributed coordinator's free-port probe) carries
+# `# exporter-ok: <reason>`; the baseline stays EMPTY.
+#
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, RuleBase, dotted
+
+_SERVER_MODULES = ("http.server", "socketserver")
+_SOCKET_CALLS = {"socket.socket", "socket.create_server", "socket.create_connection"}
+_PROM_MARKERS = ("# TYPE ", "# HELP ")
+
+
+class ExporterScopeRule(RuleBase):
+    id = "exporter-scope"
+    waiver = "exporter"
+    tree_scope = ("spark_rapids_ml_tpu", "benchmark")
+    description = (
+        "raw http.server/socket use or Prometheus text assembly outside "
+        "ops_plane/"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        if not super().applies(ctx):
+            return False
+        return not ctx.relpath.startswith("spark_rapids_ml_tpu/ops_plane/")
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name in _SERVER_MODULES or alias.name.startswith(
+                        tuple(m + "." for m in _SERVER_MODULES)
+                    ):
+                        self._emit_server(node, alias.name, ctx)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod in _SERVER_MODULES or mod.startswith(
+                    tuple(m + "." for m in _SERVER_MODULES)
+                ):
+                    self._emit_server(node, mod, ctx)
+            elif isinstance(node, ast.Call):
+                name = dotted(node.func, ctx.imports)
+                if name in _SOCKET_CALLS or (
+                    name
+                    and name.startswith(tuple(m + "." for m in _SERVER_MODULES))
+                ):
+                    if not ctx.waived(self.waiver, node):
+                        ctx.emit(
+                            self,
+                            node,
+                            f"raw `{name}` outside ops_plane/ — the scrape "
+                            "surface lives in ops_plane/export.py (rank "
+                            "labels, SLO health, one port); mark a genuinely "
+                            "non-exporter socket `# exporter-ok: <reason>` "
+                            "(docs/observability.md)",
+                        )
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if any(m in node.value for m in _PROM_MARKERS):
+                    if not ctx.waived(self.waiver, node):
+                        ctx.emit(
+                            self,
+                            node,
+                            "Prometheus exposition-format assembly (`# TYPE `/"
+                            "`# HELP ` marker) outside ops_plane/ — metrics "
+                            "export flows through ops_plane/export.py's one "
+                            "renderer, or names/labels drift "
+                            "(`# exporter-ok: <reason>` to waive; "
+                            "docs/observability.md)",
+                        )
+
+    def _emit_server(self, node: ast.AST, mod: str, ctx: FileContext) -> None:
+        if ctx.waived(self.waiver, node):
+            return
+        ctx.emit(
+            self,
+            node,
+            f"`{mod}` import outside ops_plane/ — HTTP metric/health "
+            "endpoints live in ops_plane/export.py so every surface carries "
+            "the same rank labels and SLO verdict "
+            "(`# exporter-ok: <reason>` to waive; docs/observability.md)",
+        )

@@ -28,6 +28,7 @@ KNOWN_WAIVER_TAGS = {
     "distance",
     "serve",
     "ledger",
+    "exporter",
 }
 
 

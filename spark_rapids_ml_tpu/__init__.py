@@ -32,15 +32,23 @@ def device_dataset_scope():
 
 
 def __getattr__(name):
-    """Lazy re-export (PEP 562) of `scheduler.FitScheduler` — the
+    """Lazy re-exports (PEP 562): `scheduler.FitScheduler` — the
     multi-tenant fit queue (priority submit, bin-packed co-admission,
-    checkpoint preemption over the shared HBM ledger; docs/scheduling.md).
-    The REAL class is returned, so isinstance/subclass/positional
-    construction behave identically to `scheduler.FitScheduler`."""
+    checkpoint preemption over the shared HBM ledger; docs/scheduling.md) —
+    and the `ops_plane` package (rolling-window exporters, SLO monitors,
+    decision audit trail; docs/observability.md "Ops plane"). The REAL
+    objects are returned, so isinstance/subclass/positional construction
+    behave identically to the deep imports."""
     if name == "FitScheduler":
         from .scheduler import FitScheduler
 
         return FitScheduler
+    if name == "ops_plane":
+        # importlib, not `from . import`: the from-import falls back to THIS
+        # __getattr__ while the submodule is still unset — infinite recursion
+        import importlib
+
+        return importlib.import_module(".ops_plane", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -58,6 +66,7 @@ __all__ = [
     "SchedulerSaturatedError",
     "device_dataset_scope",
     "FitScheduler",
+    "ops_plane",
     "__version__",
 ]
 

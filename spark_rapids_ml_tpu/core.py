@@ -155,6 +155,24 @@ config: Dict[str, Any] = {
     # SrmlError / abort publication; seeded from SRML_FLIGHTREC_DIR. None ->
     # exception tails still attach, but no dump files are written.
     "flightrec_dir": os.environ.get("SRML_FLIGHTREC_DIR") or None,
+    # --- live ops plane (docs/observability.md "Ops plane") ---------------
+    # rolling-window ring geometry for the telemetry registry: every counter
+    # gets rate() and every histogram gets window_quantile() over the most
+    # recent bucket_seconds x bucket_count horizon (default 10s x 18 = 3min).
+    # Resolved when a ring is first written — change before recording, or
+    # call telemetry.registry().reset() to apply.
+    "metrics_bucket_seconds": 10.0,
+    "metrics_bucket_count": 18,
+    # declarative SLO specs evaluated by multi-window burn rate
+    # (ops_plane.slo; grammar in docs/observability.md "SLO specs"): a list
+    # of dicts naming a latency histogram / error-rate counter pair / gauge
+    # ceiling plus thresholds. None or [] disables the monitors entirely.
+    "slo": None,
+    # directory for rotating ops-plane snapshots (`ops_snapshot.json` +
+    # bounded .1/.2/... generations, ops_plane.export.write_snapshot) — the
+    # headless-run analog of the SRML_METRICS_PORT scrape surface; seeded
+    # from SRML_OPS_SNAPSHOT_DIR. None -> no files.
+    "ops_snapshot_dir": os.environ.get("SRML_OPS_SNAPSHOT_DIR") or None,
 }
 
 def evaluator_label_column(params_obj: Any, evaluator: Any) -> str:

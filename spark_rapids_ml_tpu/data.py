@@ -174,10 +174,20 @@ def validate_extracted(
                 row = int(np.searchsorted(indptr, elem, side="right") - 1)
                 raise IngestValidationError(extracted.feature_names[0], row)
     else:
+        # drift seedling (ops_plane.drift, docs/observability.md "Ops
+        # plane"): per-column moments + PSI bins accumulate off this SAME
+        # pass — zero extra data reads; stats for a failing chunk are taken
+        # BEFORE the raise (partial stats are never published). None (and
+        # zero cost) while telemetry is off or the block is sparse.
+        from .ops_plane import drift as _drift
+
+        acc = _drift.accumulator_for(extracted)
         row_bytes = feats.shape[1] * feats.itemsize if feats.ndim > 1 else feats.itemsize
         step = ingest_chunk_rows(row_bytes)
         for clo in range(lo, hi, step):
             chunk = np.asarray(feats[clo : min(clo + step, hi)])
+            if acc is not None:
+                acc.update(chunk)
             if np.isfinite(chunk).all():
                 continue
             if extracted.feature_kind == "multi_cols" and chunk.ndim > 1:
@@ -189,6 +199,11 @@ def validate_extracted(
             raise IngestValidationError(
                 extracted.feature_names[0], _first_nonfinite_row(chunk, clo)
             )
+        if acc is not None and acc.rows >= n:
+            # the whole dataset has been scanned (eagerly, or as the last of
+            # the streaming path's per-row-block calls): publish the
+            # ingest.feature.* gauges (+ PSI when a baseline is registered)
+            acc.publish()
     for name, arr in ((label_col, extracted.label), (weight_col, extracted.weight)):
         if arr is None:
             continue

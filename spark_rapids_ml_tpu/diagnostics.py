@@ -379,7 +379,23 @@ def on_srml_error(exc: BaseException) -> None:
     except ValueError:
         k = _DEFAULT_TAIL
     exc.flightrec_tail = _RECORDER.tail(k)
-    _RECORDER.dump(reason=f"{type(exc).__name__}: {str(exc)[:200]}")
+    dumped = _RECORDER.dump(reason=f"{type(exc).__name__}: {str(exc)[:200]}")
+    if dumped is not None:
+        # ride an ops-plane snapshot (SLO verdicts, decision log, tenant
+        # accounting) next to the flight-recorder dump, so a post-mortem
+        # carries the VERDICT context too. sys.modules probe, same argument
+        # as flightrec_dir: error construction must never pay an import
+        # chain, and a process that never loaded the ops plane has no ops
+        # state to snapshot.
+        ops = sys.modules.get(__package__ + ".ops_plane")
+        if ops is not None:
+            try:
+                ops.export.write_snapshot(
+                    os.path.join(os.path.dirname(dumped),
+                                 f"ops_snapshot_rank_{_rank()}.json")
+                )
+            except Exception:  # pragma: no cover - snapshot is best-effort
+                pass
 
 
 # ------------------------------------------------------------- post-mortem --

@@ -100,6 +100,10 @@ class AdmissionDecision:
     chunk_rows: int = 0
     reason: str = ""
     demoted: bool = False
+    # devices the admitted working set spans — the chip-seconds multiplier
+    # for the ledger's per-tenant accounting (a cache-hit re-reserve must
+    # charge the same chips the original admission did)
+    chips: int = 1
     # the shared-ledger claim backing this admission (scheduler.HbmReservation),
     # or None when a scheduler job owns the claim (the job's reservation was
     # RESIZED instead — the scheduler releases it at job end). Fit-side claims
@@ -323,6 +327,7 @@ def admit_fit(
     (capacity may be unknown — a real allocation failure is evidence
     enough)."""
     from . import telemetry
+    from .ops_plane import audit as _audit
     from .scheduler import context as _sched_ctx
     from .scheduler.ledger import global_ledger
 
@@ -360,9 +365,18 @@ def admit_fit(
                 reservation = None  # the scheduler releases the job's claim
             else:
                 reservation = led.reserve(
-                    f"fit:{type(estimator).__name__}", "fit", est_obj.total()
+                    f"fit:{type(estimator).__name__}", "fit", est_obj.total(),
+                    chips=n_devices,
                 )
             led.note_admission(budget)
+            # one audit-trail record per admission verdict — the queryable
+            # side of the _fit_metrics["admission"] stamp (ops_plane.audit)
+            _audit.record_decision(
+                "demotion" if demoted else "admission", "fit", verdict,
+                subject=type(estimator).__name__, reason=reason,
+                estimate_bytes=est_obj.total(), budget_bytes=budget,
+                chunk_rows=int(chunk_rows),
+            )
             return AdmissionDecision(
                 verdict=verdict,
                 estimate=est_obj,
@@ -371,11 +385,18 @@ def admit_fit(
                 chunk_rows=int(chunk_rows),
                 reason=reason,
                 demoted=demoted,
+                chips=n_devices,
                 reservation=reservation,
             )
 
         def _refuse(exc):
             led.note_admission(budget)  # refusals fire the admission hooks too
+            _audit.record_decision(
+                "admission", "fit", "refused",
+                subject=type(estimator).__name__, reason=str(exc),
+                estimate_bytes=getattr(exc, "estimate_bytes", None),
+                budget_bytes=budget,
+            )
             raise exc
 
         res = resident_estimate(estimator, extracted, n_devices)
@@ -527,6 +548,7 @@ def admit_model_load(
     ledger reservations)."""
     from . import telemetry
     from .core import config
+    from .ops_plane import audit as _audit
     from .scheduler.ledger import global_ledger
 
     if bucket_rows_count is None:
@@ -542,10 +564,18 @@ def admit_model_load(
         if telemetry.enabled():
             telemetry.registry().gauge("memory.serve_estimate_bytes", est.total())
         if budget is None or est.total() + int(resident_bytes) + held <= budget:
+            # serving residents are shared infrastructure, accounted to the
+            # "serving" tenant (not whichever tenant's thread loaded them)
             reservation = led.reserve(
-                f"serve:{type(model).__name__}", "serve", est.total()
+                f"serve:{type(model).__name__}", "serve", est.total(),
+                tenant="serving",
             )
             led.note_admission(budget)
+            _audit.record_decision(
+                "admission", "serving", RESIDENT,
+                subject=type(model).__name__, tenant="serving",
+                estimate_bytes=est.total(), budget_bytes=budget,
+            )
             return AdmissionDecision(
                 verdict=RESIDENT,
                 estimate=est,
@@ -556,6 +586,12 @@ def admit_model_load(
             )
         led.note_admission(budget)
         name, nbytes = est.largest()
+        _audit.record_decision(
+            "admission", "serving", "refused",
+            subject=type(model).__name__, tenant="serving",
+            reason="over budget", estimate_bytes=est.total(),
+            budget_bytes=budget, largest_term=name,
+        )
         raise HbmBudgetError(
             f"{type(model).__name__} load does not fit the serving budget "
             f"({int(resident_bytes)} bytes already resident, {held} "
@@ -596,7 +632,9 @@ def rereserve_admission(adm: AdmissionDecision, owner: str = "fit:cache-hit"):
     if job_res is not None:
         led.resize(job_res, adm.estimate.total())
         return None
-    return led.reserve(owner, "fit", adm.estimate.total())
+    return led.reserve(
+        owner, "fit", adm.estimate.total(), chips=getattr(adm, "chips", 1)
+    )
 
 
 # ------------------------------------------------------------------ OOM -----

@@ -1,0 +1,77 @@
+#
+# Ops plane: the live operability layer over the telemetry registry
+# (docs/observability.md "Ops plane").
+#
+# PRs 11-12 made the library a resident service (serving plane, fit
+# scheduler); the PR-2/PR-5 telemetry stack was still batch-shaped —
+# cumulative counters, sinks read after the run. This package is the
+# other half: answers WHILE the process is up.
+#
+#   * rolling windows  — telemetry.MetricsRegistry's time-bucketed rings
+#                        (rate()/window_quantile(); configured by
+#                        `config["metrics_bucket_seconds"]` x
+#                        `config["metrics_bucket_count"]`);
+#   * export           — Prometheus/JSON scrape surface + /healthz on an
+#                        opt-in `SRML_METRICS_PORT` http thread, and
+#                        rotating on-disk snapshots for headless runs;
+#   * slo              — declarative `config["slo"]` specs evaluated by
+#                        multi-window burn rate, feeding /healthz and the
+#                        flight recorder;
+#   * audit            — the bounded per-tenant decision log (every
+#                        admission/demotion/preemption/eviction verdict);
+#   * drift            — per-column ingest feature stats + PSI-vs-baseline
+#                        (ROADMAP item 5's observability half).
+#
+# `report()` is the one-call roll-up — live (`ops_plane.report()`), scraped
+# (`GET /snapshot`), or archived (`export.write_snapshot()` ->
+# `python -m benchmark.opsreport <file>`).
+#
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from . import audit, drift, export, slo
+from .export import ensure_server, start_server, stop_server, write_snapshot
+
+__all__ = [
+    "audit",
+    "drift",
+    "export",
+    "slo",
+    "report",
+    "ensure_server",
+    "start_server",
+    "stop_server",
+    "write_snapshot",
+]
+
+
+def report(
+    *,
+    tenant: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    decision_limit: int = 256,
+) -> Dict[str, Any]:
+    """The full ops-plane state as one JSON-able dict: health + SLO verdicts
+    (evaluated fresh), rolling-window rates/quantiles, the decision log
+    (optionally filtered to one tenant / trace), per-tenant HBM accounting
+    from the shared ledger, drift stats, and the registry snapshot."""
+    from .. import telemetry
+    from ..scheduler.ledger import global_ledger
+
+    reg = telemetry.registry()
+    health = slo.health(fresh=True)
+    return {
+        "t": time.time(),
+        "health": {k: health[k] for k in ("healthy", "failing", "specs")},
+        "slo": health["verdicts"],
+        "windows": reg.windows_snapshot(),
+        "decisions": audit.decisions(
+            tenant=tenant, trace_id=trace_id, limit=decision_limit
+        ),
+        "decision_log": audit.stats(),
+        "tenants": global_ledger().tenant_usage(),
+        "drift": drift.last_stats(),
+        "telemetry": reg.snapshot(),
+    }

@@ -1,0 +1,150 @@
+#
+# Decision audit trail: one bounded per-process log of every control-plane
+# VERDICT, keyed by tenant + trace id (docs/observability.md "Ops plane").
+#
+# Before this log, "why was tenant X's job demoted at 14:02" meant replaying
+# a flight-recorder dump: the verdicts existed, but scattered — fit admission
+# on `model._fit_metrics["admission"]`, serving loads/evictions on
+# `_serve_metrics`, scheduler preemptions on `_fit_metrics["scheduler"]` —
+# each reachable only through the model object that happened to carry it.
+# Every admission / demotion / preemption / eviction now ALSO appends one
+# structured record here, so the question is one indexed query
+# (`decisions(tenant=..., trace_id=...)`, `ops_plane.report()`, or the
+# `benchmark/opsreport.py` CLI) against a live process or its snapshot.
+#
+# Contracts (mirroring the flight recorder, diagnostics.py):
+#   * ALWAYS-ON and bounded: recording is one dict + one lock'd deque append;
+#     capacity is `SRML_AUDIT_EVENTS` (default 4096) and overwrites are
+#     counted (`ops.decisions_dropped`), never silent. Decisions are
+#     robustness state, not metrics — they record regardless of the
+#     telemetry flag, exactly like the admission stamps they mirror.
+#   * every record carries tenant (explicit > enclosing scheduler job >
+#     "default"), the active trace tags, and the rank — so the per-tenant
+#     query works across fits, serving loads, and scheduler jobs alike.
+#   * each decision is mirrored into the flight recorder (`decision` events)
+#     so post-mortem timelines interleave verdicts with the failure record.
+#
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["record_decision", "decisions", "stats", "clear"]
+
+_DEFAULT_CAPACITY = 4096
+
+
+def _capacity() -> int:
+    try:
+        return max(1, int(os.environ.get("SRML_AUDIT_EVENTS", _DEFAULT_CAPACITY)))
+    except ValueError:  # a typo'd knob must not crash module import
+        return _DEFAULT_CAPACITY
+
+
+_LOCK = threading.Lock()
+_LOG: "deque[Dict[str, Any]]" = deque(maxlen=_capacity())
+_TOTAL = 0  # decisions ever recorded (dropped = total - retained)
+
+
+def record_decision(
+    kind: str,
+    subsystem: str,
+    verdict: str,
+    *,
+    subject: str = "",
+    tenant: Optional[str] = None,
+    reason: str = "",
+    **detail: Any,
+) -> Dict[str, Any]:
+    """Append one decision record.
+
+    `kind` is the verdict family (``admission`` | ``demotion`` |
+    ``preemption`` | ``eviction``), `subsystem` the plane that decided
+    (``fit`` | ``serving`` | ``scheduler``), `subject` what was decided about
+    (estimator/model/job name), and `detail` any JSON-able specifics (byte
+    terms, priorities, the violated knob). Returns the record."""
+    global _TOTAL
+    from .. import diagnostics, telemetry
+
+    if tenant is None:
+        try:
+            from ..scheduler import context as _sched_ctx
+
+            job = _sched_ctx.current_job()
+            tenant = str(job.tenant) if job is not None else "default"
+        except Exception:  # pragma: no cover - teardown ordering
+            tenant = "default"
+    rec: Dict[str, Any] = {
+        "t": time.time(),
+        "kind": str(kind),
+        "subsystem": str(subsystem),
+        "subject": str(subject),
+        "tenant": tenant,
+        "verdict": str(verdict),
+        "reason": str(reason),
+        "rank": diagnostics._rank(),
+        **diagnostics.trace_tags(),
+    }
+    if detail:
+        rec["detail"] = detail
+    with _LOCK:
+        dropped = len(_LOG) == _LOG.maxlen
+        _LOG.append(rec)
+        _TOTAL += 1
+    if telemetry.enabled():
+        reg = telemetry.registry()
+        reg.inc("ops.decisions_recorded")
+        if dropped:
+            reg.inc("ops.decisions_dropped")
+    # the flight recorder interleaves verdicts with failures in post-mortems
+    diagnostics.record_event(
+        "decision", decision_kind=rec["kind"], subsystem=rec["subsystem"],
+        subject=rec["subject"], tenant=tenant, verdict=rec["verdict"],
+    )
+    return rec
+
+
+def decisions(
+    *,
+    tenant: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    kind: Optional[str] = None,
+    subsystem: Optional[str] = None,
+    limit: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Retained decisions, oldest first, filtered by any combination of
+    tenant / trace id / kind / subsystem; `limit` keeps the newest N."""
+    with _LOCK:
+        out = [dict(r) for r in _LOG]
+    if tenant is not None:
+        out = [r for r in out if r.get("tenant") == tenant]
+    if trace_id is not None:
+        out = [r for r in out if r.get("trace_id") == trace_id]
+    if kind is not None:
+        out = [r for r in out if r.get("kind") == kind]
+    if subsystem is not None:
+        out = [r for r in out if r.get("subsystem") == subsystem]
+    if limit is not None and limit >= 0:
+        out = out[-limit:] if limit else []
+    return out
+
+
+def stats() -> Dict[str, Any]:
+    with _LOCK:
+        return {
+            "capacity": _LOG.maxlen,
+            "recorded": _TOTAL,
+            "retained": len(_LOG),
+            "dropped": _TOTAL - len(_LOG),
+        }
+
+
+def clear() -> None:
+    """Drop every retained decision (test isolation)."""
+    global _TOTAL
+    with _LOCK:
+        _LOG.clear()
+        _TOTAL = 0

@@ -64,6 +64,7 @@ __all__ = [
     "clear_fault_plan",
     "active_plan",
     "maybe_fail_stage",
+    "maybe_delay_stage",
     "maybe_fail_oom",
     "injected_hbm_budget",
     "ChaosRendezvous",
@@ -142,6 +143,12 @@ def parse_fault_plan(spec: str) -> List[Fault]:
                 raise ValueError(
                     f"oom fault needs budget=<bytes> or stage=<name>: {entry!r}"
                 )
+        elif fault.kind == "delay" and fault.stage is not None:
+            # stage-scoped latency injection (`delay:stage=serve:seconds=`):
+            # consulted by maybe_delay_stage at instrumented stages (the
+            # serving dispatch) — no rendezvous round involved
+            if fault.seconds <= 0:
+                raise ValueError(f"delay:stage= fault needs seconds=<s>: {entry!r}")
         elif fault.rank is None or fault.round is None:
             raise ValueError(f"{fault.kind} fault needs rank= and round=: {entry!r}")
         faults.append(fault)
@@ -233,6 +240,28 @@ def maybe_fail_oom(stage: str, index: int = 0) -> None:
             f"RESOURCE_EXHAUSTED: chaos injected allocation failure at stage "
             f"{stage!r} (index {index})"
         )
+
+
+def maybe_delay_stage(stage: str) -> None:
+    """Stage-scoped latency injection: an un-spent `delay:stage=<s>` fault
+    sleeps `seconds` before the stage runs, consuming one firing — the
+    chaos-driven latency spike the ops plane's SLO burn-rate acceptance test
+    injects into the serving dispatch (docs/observability.md "Ops plane")."""
+    from .. import diagnostics
+
+    for f in active_plan():
+        if (
+            f.kind != "delay"
+            or f.stage != stage
+            or f.spent()
+            or not _rank_matches(f)
+        ):
+            continue
+        f.fired += 1
+        diagnostics.record_event(
+            "chaos_injection", fault="delay", stage=stage, seconds=f.seconds
+        )
+        time.sleep(f.seconds)  # sleep-ok: plan-bounded injected stage delay
 
 
 def maybe_fail_stage(stage: str, attempt: int) -> None:

@@ -1022,7 +1022,7 @@ class FileRendezvous(Rendezvous):
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
+    with socket.socket() as s:  # exporter-ok: jax.distributed coordinator port probe, not a metrics endpoint
         s.bind(("", 0))
         return s.getsockname()[1]
 

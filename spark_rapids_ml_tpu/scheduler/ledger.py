@@ -34,10 +34,29 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["HbmReservation", "HbmLedger", "global_ledger", "reset_global_ledger"]
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+def _fresh_usage() -> Dict[str, float]:
+    return {"byte_seconds": 0.0, "chip_seconds": 0.0, "reservations": 0.0}
+
+
+def _current_tenant() -> str:
+    """The enclosing scheduler job's tenant, or "default" — so every HBM
+    claim (standalone fits and serving loads included) lands in the
+    per-tenant accounting without callers having to thread a tenant."""
+    from . import context as _ctx
+
+    job = _ctx.current_job()
+    return str(job.tenant) if job is not None else "default"
 
 
 @dataclass
@@ -45,13 +64,22 @@ class HbmReservation:
     """One admitted per-device byte claim. `nbytes` is mutable via
     `HbmLedger.resize` (a scheduler job's queue-time estimate is trued up by
     the fit's own admission); `active` flips False exactly once on release —
-    double-release is a harmless no-op, never a double-credit."""
+    double-release is a harmless no-op, never a double-credit.
+
+    `tenant` and `chips` feed the per-tenant accounting (docs/observability.md
+    "Ops plane"): the ledger integrates ``nbytes x seconds-held`` (HBM
+    byte-seconds) and ``chips x seconds-held`` (chip-seconds) per tenant —
+    `t0`/`mark` are the integration anchors (monotonic clock)."""
 
     owner: str
     kind: str  # "fit" | "serve" | "job"
     nbytes: int
     rid: int = 0
     active: bool = True
+    tenant: str = "default"
+    chips: int = 1
+    t0: float = 0.0
+    mark: float = 0.0  # last byte-seconds integration point
 
 
 class HbmLedger:
@@ -70,6 +98,9 @@ class HbmLedger:
         self.high_watermark: int = 0
         self.last_budget: Optional[int] = None
         self.admission_hooks: List[Callable[[int, Optional[int]], None]] = []
+        # per-tenant integrated usage (byte-seconds / chip-seconds across
+        # released AND resized claims; tenant_usage() adds the live ones)
+        self._tenant_usage: Dict[str, Dict[str, float]] = {}
 
     # ------------------------------------------------------------ locking --
     def admission(self):
@@ -107,15 +138,67 @@ class HbmLedger:
                 return None
             return self.reserved_bytes() / float(self.last_budget)
 
+    def tenant_usage(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant integrated HBM accounting: `byte_seconds` (reserved
+        bytes x wall seconds held) and `chip_seconds` (claimed chips x wall
+        seconds), plus live claim state — the tenant cost view
+        `ops_plane.report()` and `benchmark/opsreport.py` serve. Live
+        reservations are integrated up to now."""
+        now = _now()
+        with self._lock:
+            for r in self._by_id.values():
+                if r.active:
+                    self._accrue_locked(r, now)
+            out: Dict[str, Dict[str, float]] = {}
+            for tenant, u in self._tenant_usage.items():
+                out[tenant] = dict(u)
+            for r in self._by_id.values():
+                if not r.active:
+                    continue
+                u = out.setdefault(r.tenant, _fresh_usage())
+                u["live_bytes"] = u.get("live_bytes", 0.0) + r.nbytes
+                u["live_reservations"] = u.get("live_reservations", 0.0) + 1
+            return out
+
     # ------------------------------------------------------------ writes ---
-    def reserve(self, owner: str, kind: str, nbytes: int) -> HbmReservation:
+    def _accrue_locked(self, r: HbmReservation, now: float) -> None:
+        """Integrate `r`'s byte/chip-seconds since its last mark (caller
+        holds the lock; called at every nbytes change point and release, so
+        each interval is charged at the bytes actually held through it)."""
+        dt = max(0.0, now - r.mark)
+        r.mark = now
+        if dt == 0.0:
+            return
+        u = self._tenant_usage.setdefault(r.tenant, _fresh_usage())
+        u["byte_seconds"] += r.nbytes * dt
+        u["chip_seconds"] += r.chips * dt
+
+    def reserve(
+        self,
+        owner: str,
+        kind: str,
+        nbytes: int,
+        *,
+        tenant: Optional[str] = None,
+        chips: int = 1,
+    ) -> HbmReservation:
         """Unconditional bookkeeping reserve — admission logic (memory.py)
         decides WHETHER; this records THAT. Updates the high watermark and
-        the `scheduler.ledger_reserved_bytes` gauge."""
-        r = HbmReservation(owner=owner, kind=kind, nbytes=max(0, int(nbytes)))
+        the `scheduler.ledger_reserved_bytes` gauge. `tenant` defaults to
+        the enclosing scheduler job's tenant (or "default") so standalone
+        fits are accounted too."""
+        if tenant is None:
+            tenant = _current_tenant()
+        now = _now()
+        r = HbmReservation(
+            owner=owner, kind=kind, nbytes=max(0, int(nbytes)),
+            tenant=str(tenant), chips=max(1, int(chips)), t0=now, mark=now,
+        )
         with self._lock:
             r.rid = next(self._ids)
             self._by_id[r.rid] = r
+            u = self._tenant_usage.setdefault(r.tenant, _fresh_usage())
+            u["reservations"] += 1
             self._note_locked()
         return r
 
@@ -127,6 +210,8 @@ class HbmLedger:
         *,
         budget: Optional[int] = None,
         exclude: Optional[HbmReservation] = None,
+        tenant: Optional[str] = None,
+        chips: int = 1,
     ) -> Optional[HbmReservation]:
         """Atomic check-then-reserve: None when ``held + nbytes`` would
         exceed `budget` (a None budget always admits — no capacity
@@ -136,14 +221,16 @@ class HbmLedger:
                 held = self.reserved_bytes(exclude=exclude)
                 if held + max(0, int(nbytes)) > budget:
                     return None
-            return self.reserve(owner, kind, nbytes)
+            return self.reserve(owner, kind, nbytes, tenant=tenant, chips=chips)
 
     def resize(self, r: HbmReservation, nbytes: int) -> None:
         """True an existing claim up (or down) to `nbytes` — the scheduler
         job's queue-time estimate replaced by the fit admission's exact
         working set. The caller validated the new size against the budget
-        (under `admission()`); resize itself is bookkeeping."""
+        (under `admission()`); resize itself is bookkeeping. The interval up
+        to now is accounted at the OLD size (those were the bytes held)."""
         with self._lock:
+            self._accrue_locked(r, _now())
             r.nbytes = max(0, int(nbytes))
             self._note_locked()
 
@@ -156,6 +243,7 @@ class HbmLedger:
         with self._lock:
             if not r.active:
                 return
+            self._accrue_locked(r, _now())
             r.active = False
             self._by_id.pop(r.rid, None)
             self._note_locked()

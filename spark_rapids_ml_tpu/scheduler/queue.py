@@ -44,6 +44,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..errors import PreemptedError, SchedulerSaturatedError
+from ..ops_plane import audit as _audit
+from ..ops_plane import slo as _slo
 from ..utils import get_logger
 from .context import job_scope
 from .ledger import HbmLedger, global_ledger
@@ -95,9 +97,12 @@ class FitJob:
         self.run_s = 0.0
         self._wait_since = time.monotonic()
         self._run_since: Optional[float] = None
-        # byte estimates (filled by the scheduler's preflight)
+        # byte estimates (filled by the scheduler's preflight), and the
+        # device count they span — the chip-seconds multiplier for the
+        # ledger's per-tenant accounting
         self.resident_estimate: Any = None
         self.stream_floor_estimate: Any = None
+        self.chips = 1
         self._preempt = threading.Event()
         self._preempt_reason = ""
         self._done = threading.Event()
@@ -208,6 +213,11 @@ class FitScheduler:
         self._next_id = 1
         self._closed = False
         self._logger = get_logger(type(self))
+        # opt-in live scrape surface (SRML_METRICS_PORT): a long-lived
+        # scheduler is exactly the process an operator wants /metrics on
+        from ..ops_plane import ensure_server
+
+        ensure_server()
 
     # ------------------------------------------------------------ submit --
     def submit(
@@ -262,6 +272,7 @@ class FitScheduler:
         est = job.estimator
         extracted = est._pre_process_data(job.dataset, for_fit=True, defer_validation=True)
         n_dev = max(1, min(int(est.num_workers), len(default_devices())))
+        job.chips = n_dev
         job.resident_estimate = memory.resident_estimate(est, extracted, n_dev)
         if getattr(est, "_supports_streaming_fit", False):
             floor = min(memory.MIN_STREAM_CHUNK_ROWS, max(1, int(extracted.n_rows)))
@@ -288,6 +299,12 @@ class FitScheduler:
                 terms=minimal.terms,
             )
             telemetry.registry().inc("scheduler.jobs_refused")
+            _audit.record_decision(
+                "admission", "scheduler", "refused",
+                subject=f"job:{job.job_id}", tenant=job.tenant,
+                reason=str(exc), estimate_bytes=minimal.total(),
+                budget_bytes=budget,
+            )
             job._fail(exc)
             raise exc
 
@@ -334,7 +351,8 @@ class FitScheduler:
                     break
                 need = self._need_bytes(job, budget)
                 r = self._ledger.try_reserve(
-                    f"job:{job.job_id}:{job.tenant}", "job", need, budget=budget
+                    f"job:{job.job_id}:{job.tenant}", "job", need,
+                    budget=budget, tenant=job.tenant, chips=job.chips,
                 )
                 self._ledger.note_admission(budget)
                 if r is not None:
@@ -364,6 +382,13 @@ class FitScheduler:
             reg.inc("scheduler.jobs_admitted")
             reg.observe("scheduler.queue_wait_s", wait)
             reg.observe("scheduler.hbm_share", job.hbm_share)
+            _audit.record_decision(
+                "admission", "scheduler",
+                "resumed" if job.state == "preempted" else "admitted",
+                subject=f"job:{job.job_id}", tenant=job.tenant,
+                priority=job.priority, admitted_bytes=job.admitted_bytes,
+                queue_wait_s=round(wait, 6),
+            )
             if job.state == "preempted":
                 job.resumes += 1
                 reg.inc("scheduler.jobs_resumed")
@@ -376,6 +401,10 @@ class FitScheduler:
             )
             self._threads.append(t)
             t.start()
+        if to_start:
+            # queue-wait histograms were just recorded: the SLO monitors'
+            # inline evaluation point (throttled; no-op without specs)
+            _slo.maybe_evaluate()
 
     def _maybe_preempt_locked(
         self, job: FitJob, need: int, budget: Optional[int]
@@ -420,6 +449,13 @@ class FitScheduler:
         victim.request_preempt(
             f"higher-priority job {job.job_id} (tenant {job.tenant!r}) "
             "needs the reservation"
+        )
+        _audit.record_decision(
+            "preemption", "scheduler", "requested",
+            subject=f"job:{victim.job_id}", tenant=victim.tenant,
+            reason=victim._preempt_reason, victim_priority=victim.priority,
+            for_job=job.job_id, for_tenant=job.tenant,
+            for_priority=job.priority,
         )
         return True
 
@@ -477,6 +513,11 @@ class FitScheduler:
                     job.state = "preempted"
                     job._wait_since = time.monotonic()
                     reg.inc("scheduler.jobs_preempted")
+                    _audit.record_decision(
+                        "preemption", "scheduler", "preempted",
+                        subject=f"job:{job.job_id}", tenant=job.tenant,
+                        preemptions=job.preemptions, priority=job.priority,
+                    )
                     if (
                         job.preemptions >= self._max_preemptions
                         and job.stream_floor_estimate is not None
@@ -488,6 +529,14 @@ class FitScheduler:
                         job.demote_to_stream = True
                         job.demoted = True
                         reg.inc("scheduler.jobs_demoted")
+                        _audit.record_decision(
+                            "demotion", "scheduler", "stream",
+                            subject=f"job:{job.job_id}", tenant=job.tenant,
+                            reason=(
+                                f"preempted {job.preemptions} time(s) "
+                                "(config['sched_max_preemptions'])"
+                            ),
+                        )
                         self._logger.warning(
                             "job %d (tenant %r) preempted %d time(s) — "
                             "demoting to the streaming path",
@@ -501,9 +550,14 @@ class FitScheduler:
     # ------------------------------------------------------------- stats --
     def stats(self) -> Dict[str, Any]:
         """Per-tenant roll-up of every job this scheduler has seen — queue
-        waits (mean/max), preemptions, resumes, demotions, completion
-        counts — plus the ledger view (reserved bytes, high watermark,
-        utilization)."""
+        waits (list + p50/p99 via the one shared quantile helper),
+        preemptions, resumes, demotions, completion counts — plus the ledger
+        view (reserved bytes, high watermark, utilization, per-tenant
+        byte/chip-seconds) and the process-wide queue-wait percentiles
+        (`telemetry.summarize_histogram` — the same extraction
+        `ScoringEngine.stats` uses, so the two cannot drift)."""
+        from .. import telemetry
+
         with self._lock:
             jobs = list(self._jobs)
             running = len(self._running)
@@ -525,13 +579,20 @@ class FitScheduler:
             t["resumes"] += j.resumes
             t["demotions"] += int(j.demoted)
             t["queue_wait_s"].append(j.queue_wait_s)
+        for t in tenants.values():
+            t["queue_wait_p50_s"] = telemetry.quantile_of(t["queue_wait_s"], 0.5)
+            t["queue_wait_p99_s"] = telemetry.quantile_of(t["queue_wait_s"], 0.99)
+        wait = telemetry.summarize_histogram("scheduler.queue_wait_s")
         return {
             "tenants": tenants,
             "running": running,
             "queued": queued,
+            "queue_wait_p50_s": wait["p50"],
+            "queue_wait_p99_s": wait["p99"],
             "ledger_reserved_bytes": self._ledger.reserved_bytes(),
             "ledger_high_watermark": self._ledger.high_watermark,
             "ledger_utilization": self._ledger.utilization(),
+            "tenant_usage": self._ledger.tenant_usage(),
         }
 
     # ---------------------------------------------------------- shutdown --

@@ -107,6 +107,11 @@ class ScoringEngine:
 
     # ---------------------------------------------------------- lifecycle --
     def start(self) -> "ScoringEngine":
+        # opt-in live scrape surface (SRML_METRICS_PORT): a serving process
+        # is exactly what /metrics + /healthz exist for
+        from ..ops_plane import ensure_server
+
+        ensure_server()
         with self._cond:
             if self._thread is not None and self._thread.is_alive():
                 return self
@@ -171,15 +176,17 @@ class ScoringEngine:
         return self.submit(name, features).result(timeout)
 
     def stats(self) -> Dict[str, Any]:
-        """Latency-centric view of the serve.* telemetry (p50/p99 from the
-        registry's bounded histogram samples; None while telemetry is off or
-        nothing has been served)."""
-        reg = telemetry.registry()
+        """Latency-centric view of the serve.* telemetry (p50/p99 via
+        `telemetry.summarize_histogram` — the ONE shared extraction, also
+        behind `FitScheduler.stats`; None while telemetry is off or nothing
+        has been served)."""
+        qw = telemetry.summarize_histogram("serve.queue_wait_s")
+        e2e = telemetry.summarize_histogram("serve.e2e_s")
         return {
-            "queue_wait_p50_s": reg.quantile("serve.queue_wait_s", 0.5),
-            "queue_wait_p99_s": reg.quantile("serve.queue_wait_s", 0.99),
-            "e2e_p50_s": reg.quantile("serve.e2e_s", 0.5),
-            "e2e_p99_s": reg.quantile("serve.e2e_s", 0.99),
+            "queue_wait_p50_s": qw["p50"],
+            "queue_wait_p99_s": qw["p99"],
+            "e2e_p50_s": e2e["p50"],
+            "e2e_p99_s": e2e["p99"],
         }
 
     # -------------------------------------------------------------- worker --
@@ -229,8 +236,12 @@ class ScoringEngine:
     def _dispatch_group(self, group: List[ScoreFuture]) -> None:
         import jax
 
+        from ..parallel.chaos import maybe_delay_stage
         from ..parallel.mesh import dtype_scope
 
+        # chaos latency injection (`delay:stage=serve:seconds=`): the spike
+        # the SLO burn-rate acceptance test drives through the fast window
+        maybe_delay_stage("serve")
         t0 = time.monotonic()
         reg = telemetry.registry() if telemetry.enabled() else None
         if reg is not None:
@@ -282,12 +293,20 @@ class ScoringEngine:
                 for fut in group:
                     reg.observe("serve.e2e_s", t1 - fut.t_submit)
         except Exception as e:
+            if reg is not None:
+                # the error-rate SLO's numerator, one per failed request
+                reg.inc("serve.errors", len(group))
             self._logger.warning(
                 "scoring dispatch for model %r failed: %s: %s",
                 group[0].name, type(e).__name__, e,
             )
             for fut in group:
                 fut._resolve(error=e)
+        # latency histograms were just recorded: the SLO monitors' inline
+        # evaluation point (throttled to one bucket width; no-op w/o specs)
+        from ..ops_plane import slo as _slo
+
+        _slo.maybe_evaluate()
 
     @staticmethod
     def _resolve_group(

@@ -239,6 +239,7 @@ class ModelRegistry:
 
     def _evict_locked(self, name: str, reason: str) -> None:
         from .. import memory
+        from ..ops_plane import audit as _audit
 
         entry = self._entries.pop(name)
         # the model carries WHY it left residency, largest byte term and all
@@ -247,6 +248,12 @@ class ModelRegistry:
         stamp["verdict"] = "evicted"
         stamp["reason"] = reason
         entry.model._serve_metrics["admission"] = stamp
+        # the queryable side of the stamp (ops_plane.audit): why THIS model
+        # left residency, without holding a reference to it
+        _audit.record_decision(
+            "eviction", "serving", "evicted", subject=name, tenant="serving",
+            reason=reason, estimate_bytes=entry.resident_bytes,
+        )
         # the program (and its device state) are the only HBM pins; the
         # shared-ledger claim returns with them (docs/scheduling.md)
         memory.release_admission(entry.admission)

@@ -40,9 +40,10 @@ import atexit
 import contextlib
 import json
 import os
+import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "enabled",
@@ -58,6 +59,8 @@ __all__ = [
     "record_device_memory",
     "record_solver_result",
     "record_convergence_point",
+    "quantile_of",
+    "summarize_histogram",
 ]
 
 # Span records kept in-process (the JSONL sink receives every record; the
@@ -68,6 +71,11 @@ _MAX_CONVERGENCE_POINTS = 10_000
 # (serving latency p50/p99); the count/sum/min/max summary sees EVERY
 # observation — only the quantile view is windowed.
 _MAX_HIST_SAMPLES = 1024
+# Per-bucket sample retention for the TIME-windowed quantile view (the ops
+# plane's rolling windows): bounded so a traffic burst cannot grow the ring —
+# a bucket past the cap keeps its count/sum exact and its quantiles
+# approximate (computed over the retained samples).
+_MAX_BUCKET_SAMPLES = 256
 
 
 class _State:
@@ -119,6 +127,17 @@ def enable(sink_path: Optional[str] = None, *, convergence: Optional[bool] = Non
         _STATE.sink_path = sink_path
     if convergence is not None:
         _STATE.convergence = bool(convergence)
+    # opt-in live scrape surface (docs/observability.md "Ops plane"): when
+    # SRML_METRICS_PORT names a port, enabling telemetry also stands up the
+    # exporter thread. Best-effort — a busy port degrades to no server, never
+    # to a failed fit.
+    if os.environ.get("SRML_METRICS_PORT"):
+        try:
+            from . import ops_plane
+
+            ops_plane.ensure_server()
+        except Exception:  # pragma: no cover - exporter must never break enable
+            pass
 
 
 def disable() -> None:
@@ -135,6 +154,146 @@ def _rank() -> int:
     on rank identity. Control-plane only — never touches the XLA backend
     (jax.process_index() would initialize it)."""
     return _diag()._rank()
+
+
+# --------------------------------------------------------- rolling windows --
+#
+# Time-bucketed ring aggregation (docs/observability.md "Ops plane"): every
+# counter gets `rate()` and every histogram gets `window_quantile()` over a
+# configurable recent horizon (bucket width x bucket count,
+# `config["metrics_bucket_seconds"]` x `config["metrics_bucket_count"]`,
+# default 10s x 18 = 3 minutes) ALONGSIDE the cumulative views — a long-lived
+# serving process answers "what is the error rate NOW", not since boot.
+# Window updates ride the same single `_STATE.on` check as every recorder
+# (zero-cost when telemetry is disabled, the PR-2 contract); window params are
+# resolved lazily at first record after construction/reset, so tests that
+# shrink the bucket width set config and call `registry().reset()`.
+
+
+def _window_params() -> Tuple[float, int]:
+    """(bucket_seconds, bucket_count) from core.config, via sys.modules like
+    diagnostics.flightrec_dir — telemetry must never pay core's import chain
+    (and an uncustomized process cannot have customized the knobs)."""
+    bucket_s, n = 10.0, 18
+    core = sys.modules.get(__package__ + ".core")
+    if core is not None:
+        try:
+            bucket_s = float(core.config.get("metrics_bucket_seconds") or 10.0)
+            n = int(core.config.get("metrics_bucket_count") or 18)
+        except Exception:  # pragma: no cover - malformed knob keeps defaults
+            pass
+    return max(0.001, bucket_s), max(2, n)
+
+
+class _CounterRing:
+    """Per-counter ring of per-bucket increment sums."""
+
+    __slots__ = ("bucket_s", "n", "vals", "head")
+
+    def __init__(self, bucket_s: float, n: int) -> None:
+        self.bucket_s = bucket_s
+        self.n = n
+        self.vals = [0.0] * n
+        self.head: Optional[int] = None  # absolute index of the newest bucket
+
+    def _advance(self, b: int) -> None:
+        if self.head is None or b - self.head >= self.n:
+            self.vals = [0.0] * self.n
+            self.head = b
+            return
+        while self.head < b:
+            self.head += 1
+            self.vals[self.head % self.n] = 0.0
+
+    def add(self, now: float, v: float) -> None:
+        b = int(now // self.bucket_s)
+        if self.head is None or b > self.head:
+            self._advance(b)
+        # a clock reading from just before the head bucket opened lands in
+        # the head bucket rather than rewriting history
+        self.vals[(self.head if b < (self.head or 0) else b) % self.n] += v
+
+    def window_sum(self, now: float, window_s: Optional[float]) -> Tuple[float, float]:
+        """(sum over the window, window span seconds). The span is clamped to
+        the ring horizon — asking for 1h over a 3min ring reads 3min."""
+        b = int(now // self.bucket_s)
+        if self.head is None or b > self.head:
+            self._advance(b)
+        horizon = self.n * self.bucket_s
+        span = horizon if window_s is None else min(max(float(window_s), self.bucket_s), horizon)
+        k = max(1, min(self.n, int(round(span / self.bucket_s))))
+        assert self.head is not None
+        return sum(self.vals[(self.head - i) % self.n] for i in range(k)), k * self.bucket_s
+
+
+class _HistRing:
+    """Per-histogram ring of per-bucket (count, sum, bounded samples)."""
+
+    __slots__ = ("bucket_s", "n", "counts", "sums", "samples", "head")
+
+    def __init__(self, bucket_s: float, n: int) -> None:
+        self.bucket_s = bucket_s
+        self.n = n
+        self.counts = [0.0] * n
+        self.sums = [0.0] * n
+        self.samples: List[List[float]] = [[] for _ in range(n)]
+        self.head: Optional[int] = None
+
+    def _advance(self, b: int) -> None:
+        if self.head is None or b - self.head >= self.n:
+            self.counts = [0.0] * self.n
+            self.sums = [0.0] * self.n
+            self.samples = [[] for _ in range(self.n)]
+            self.head = b
+            return
+        while self.head < b:
+            self.head += 1
+            i = self.head % self.n
+            self.counts[i] = 0.0
+            self.sums[i] = 0.0
+            self.samples[i] = []
+
+    def add(self, now: float, v: float) -> None:
+        b = int(now // self.bucket_s)
+        if self.head is None or b > self.head:
+            self._advance(b)
+        i = (self.head if b < (self.head or 0) else b) % self.n
+        self.counts[i] += 1.0
+        self.sums[i] += v
+        if len(self.samples[i]) < _MAX_BUCKET_SAMPLES:
+            self.samples[i].append(v)
+
+    def _slots(self, now: float, window_s: Optional[float]) -> List[int]:
+        b = int(now // self.bucket_s)
+        if self.head is None or b > self.head:
+            self._advance(b)
+        horizon = self.n * self.bucket_s
+        span = horizon if window_s is None else min(max(float(window_s), self.bucket_s), horizon)
+        k = max(1, min(self.n, int(round(span / self.bucket_s))))
+        assert self.head is not None
+        return [(self.head - i) % self.n for i in range(k)]
+
+    def window_samples(self, now: float, window_s: Optional[float]) -> List[float]:
+        out: List[float] = []
+        for i in self._slots(now, window_s):
+            out.extend(self.samples[i])
+        return out
+
+    def window_count(self, now: float, window_s: Optional[float]) -> float:
+        return sum(self.counts[i] for i in self._slots(now, window_s))
+
+
+def quantile_of(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank quantile over a (possibly unsorted) sample list — THE one
+    quantile-extraction implementation (ScoringEngine.stats,
+    FitScheduler.stats, the registry's quantile views, and the bench lanes
+    all delegate here, so they cannot drift). None on an empty list."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    q = min(max(float(q), 0.0), 1.0)
+    idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+    return float(ordered[idx])
 
 
 # ---------------------------------------------------------------- registry --
@@ -157,13 +316,31 @@ class MetricsRegistry:
         # bound, so marks must not be absolute list indices
         self._spans_total: int = 0
         self._convergence: Dict[str, List[List[float]]] = {}
+        # rolling windows (ops plane): params resolved at first record after
+        # construction/reset, one ring per counter/histogram
+        self._win_cfg: Optional[Tuple[float, int]] = None
+        self._win_counters: Dict[str, _CounterRing] = {}
+        self._win_hists: Dict[str, _HistRing] = {}
+
+    def _win(self) -> Tuple[float, int]:
+        """Window params, resolved once per construction/reset (caller holds
+        the lock)."""
+        if self._win_cfg is None:
+            self._win_cfg = _window_params()
+        return self._win_cfg
 
     # -- record ------------------------------------------------------------
     def inc(self, name: str, value: float = 1.0) -> None:
         if not _STATE.on:
             return
+        now = time.monotonic()
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + value
+            ring = self._win_counters.get(name)
+            if ring is None:
+                bucket_s, n = self._win()
+                ring = self._win_counters[name] = _CounterRing(bucket_s, n)
+            ring.add(now, value)
 
     def gauge(self, name: str, value: float) -> None:
         if not _STATE.on:
@@ -182,6 +359,7 @@ class MetricsRegistry:
         """Histogram observation (count/sum/min/max summary, not buckets)."""
         if not _STATE.on:
             return
+        now = time.monotonic()
         with self._lock:
             h = self._hists.setdefault(
                 name, {"count": 0.0, "sum": 0.0, "min": float("inf"), "max": float("-inf")}
@@ -194,6 +372,11 @@ class MetricsRegistry:
             samples.append(float(value))
             if len(samples) > _MAX_HIST_SAMPLES:
                 del samples[: -_MAX_HIST_SAMPLES // 2]
+            ring = self._win_hists.get(name)
+            if ring is None:
+                bucket_s, n = self._win()
+                ring = self._win_hists[name] = _HistRing(bucket_s, n)
+            ring.add(now, float(value))
 
     def record_span(
         self,
@@ -241,13 +424,105 @@ class MetricsRegistry:
         serving process reads CURRENT latency, not all-time). None when no
         observations exist. Nearest-rank on the sorted window."""
         with self._lock:
-            samples = self._hist_samples.get(name)
-            if not samples:
+            samples = list(self._hist_samples.get(name) or ())
+        return quantile_of(samples, q)
+
+    # -- windowed reads (ops plane) ----------------------------------------
+    def window_horizon_s(self) -> float:
+        """The rolling-window horizon (bucket width x bucket count)."""
+        with self._lock:
+            bucket_s, n = self._win()
+        return bucket_s * n
+
+    def bucket_seconds(self) -> float:
+        with self._lock:
+            return self._win()[0]
+
+    def rate(self, name: str, window_s: Optional[float] = None) -> Optional[float]:
+        """Counter increments per second over the most recent `window_s`
+        (None = the whole ring horizon; any window clamps to it). None for a
+        counter never incremented since the last reset — a never-seen metric
+        has no rate, which is different from a zero one."""
+        with self._lock:
+            ring = self._win_counters.get(name)
+            if ring is None:
                 return None
-            ordered = sorted(samples)
-        q = min(max(float(q), 0.0), 1.0)
-        idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
-        return ordered[idx]
+            total, span = ring.window_sum(time.monotonic(), window_s)
+        return total / span if span > 0 else None
+
+    def window_count(self, name: str, window_s: Optional[float] = None) -> float:
+        """Observations recorded into histogram `name` within the window."""
+        with self._lock:
+            ring = self._win_hists.get(name)
+            if ring is None:
+                return 0.0
+            return float(ring.window_count(time.monotonic(), window_s))
+
+    def window_quantile(
+        self, name: str, q: float, window_s: Optional[float] = None
+    ) -> Optional[float]:
+        """Quantile over histogram `name`'s observations within the most
+        recent `window_s` (clamped to the ring horizon). Approximate past
+        ``_MAX_BUCKET_SAMPLES`` observations per bucket; None when the window
+        holds no samples."""
+        with self._lock:
+            ring = self._win_hists.get(name)
+            if ring is None:
+                return None
+            samples = ring.window_samples(time.monotonic(), window_s)
+        return quantile_of(samples, q)
+
+    def window_fraction_over(
+        self, name: str, threshold: float, window_s: Optional[float] = None
+    ) -> Optional[Tuple[float, int]]:
+        """(fraction of windowed observations strictly above `threshold`,
+        sample count) — the SLO burn-rate numerator. None when the window is
+        empty (no traffic is not a violation)."""
+        with self._lock:
+            ring = self._win_hists.get(name)
+            if ring is None:
+                return None
+            samples = ring.window_samples(time.monotonic(), window_s)
+        if not samples:
+            return None
+        bad = sum(1 for s in samples if s > threshold)
+        return bad / len(samples), len(samples)
+
+    def windows_snapshot(self) -> Dict[str, Any]:
+        """Machine-readable rolling-window view — what the exporters and
+        `ops_plane.report()` serve: per-counter rates over the fast window
+        (60s, clamped to the horizon) AND the full horizon, and per-histogram
+        p50/p99/count over the full horizon. Taken under ONE lock hold at ONE
+        clock instant, so every metric in the snapshot describes the same
+        window — and a scrape costs one lock round-trip, not O(metrics)."""
+        now = time.monotonic()
+        with self._lock:
+            bucket_s, n = self._win()
+            horizon = bucket_s * n
+            fast = min(60.0, horizon)
+            rates: Dict[str, Any] = {}
+            for name, ring in self._win_counters.items():
+                fsum, fspan = ring.window_sum(now, fast)
+                hsum, hspan = ring.window_sum(now, None)
+                rates[name] = {
+                    "fast_per_s": fsum / fspan if fspan > 0 else None,
+                    "horizon_per_s": hsum / hspan if hspan > 0 else None,
+                }
+            quantiles: Dict[str, Any] = {}
+            for name, ring in self._win_hists.items():
+                samples = ring.window_samples(now, None)
+                quantiles[name] = {
+                    "p50": quantile_of(samples, 0.5),
+                    "p99": quantile_of(samples, 0.99),
+                    "count": float(ring.window_count(now, None)),
+                }
+        return {
+            "bucket_seconds": bucket_s,
+            "bucket_count": n,
+            "horizon_s": horizon,
+            "rates": rates,
+            "quantiles": quantiles,
+        }
 
     def convergence_trace(self, solver: str) -> List[List[float]]:
         """[(iteration, value), ...] points recorded for `solver`."""
@@ -335,6 +610,12 @@ class MetricsRegistry:
             self._hist_samples.clear()
             self._spans.clear()
             self._convergence.clear()
+            # window rings rebuild against the CURRENT config on next record —
+            # this is how tests (and reconfiguring operators) apply new
+            # bucket params
+            self._win_cfg = None
+            self._win_counters.clear()
+            self._win_hists.clear()
 
 
 _REGISTRY = MetricsRegistry()
@@ -372,6 +653,35 @@ def summary() -> str:
     else:
         lines.append("flightrec: disabled (SRML_FLIGHTREC=0)")
     return "\n".join(lines)
+
+
+def summarize_histogram(name: str, *, window_s: Optional[float] = None) -> Dict[str, Optional[float]]:
+    """One histogram's summary view: cumulative count/sum/mean/min/max plus
+    p50/p99 — over the retained cumulative sample window by default, over the
+    most recent `window_s` of the rolling ring when given. THE shared p50/p99
+    extraction (`ScoringEngine.stats`, `FitScheduler.stats`, and the ops
+    plane all delegate here — hand-rolled copies would silently diverge now
+    that windowed quantiles exist). All values None when nothing was
+    observed."""
+    reg = _REGISTRY
+    with reg._lock:
+        h = reg._hists.get(name)
+        cum = dict(h) if h else None
+    out: Dict[str, Optional[float]] = {
+        "count": cum["count"] if cum else None,
+        "sum": cum["sum"] if cum else None,
+        "mean": (cum["sum"] / cum["count"]) if cum and cum["count"] else None,
+        "min": cum["min"] if cum else None,
+        "max": cum["max"] if cum else None,
+    }
+    if window_s is None:
+        out["p50"] = reg.quantile(name, 0.5)
+        out["p99"] = reg.quantile(name, 0.99)
+    else:
+        out["p50"] = reg.window_quantile(name, 0.5, window_s)
+        out["p99"] = reg.window_quantile(name, 0.99, window_s)
+        out["window_count"] = reg.window_count(name, window_s)
+    return out
 
 
 # ------------------------------------------------------------------- sinks --

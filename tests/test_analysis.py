@@ -24,6 +24,7 @@ from ci.analysis.cli import main as cli_main  # noqa: E402
 from ci.analysis.rules import (  # noqa: E402
     BlockingRule,
     ConfigKeyRule,
+    ExporterScopeRule,
     HostSyncRule,
     HygieneRule,
     JsonlRule,
@@ -1029,3 +1030,79 @@ def test_ledger_bypass_fp_guards():
         return admit_fit(1, 2)
     """
     assert run(local, LedgerBypassRule) == []
+
+
+# --------------------------------------------------------------------------
+# exporter-scope (the ops plane's export surface)
+# --------------------------------------------------------------------------
+
+
+def test_exporter_scope_http_server_import_fires():
+    fs = run("import http.server\n", ExporterScopeRule)
+    assert rule_ids(fs) == ["exporter-scope"]
+    fs = run("from http.server import ThreadingHTTPServer\n", ExporterScopeRule)
+    assert rule_ids(fs) == ["exporter-scope"]
+    fs = run("import socketserver\n", ExporterScopeRule)
+    assert rule_ids(fs) == ["exporter-scope"]
+
+
+def test_exporter_scope_raw_socket_call_fires():
+    src = """
+    import socket
+    def probe():
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+    """
+    fs = run(src, ExporterScopeRule)
+    assert rule_ids(fs) == ["exporter-scope"]
+
+
+def test_exporter_scope_prometheus_assembly_fires():
+    src = """
+    def render(counters):
+        lines = []
+        for name, v in counters.items():
+            lines.append("# TYPE " + name + " counter")
+        return lines
+    """
+    fs = run(src, ExporterScopeRule)
+    assert rule_ids(fs) == ["exporter-scope"]
+
+
+def test_exporter_scope_waiver_suppresses():
+    src = """
+    import socket
+    def probe():
+        with socket.socket() as s:  # exporter-ok: coordinator port probe, not a metrics endpoint
+            return s.getsockname()[1]
+    """
+    assert run(src, ExporterScopeRule) == []
+
+
+def test_exporter_scope_exempt_inside_ops_plane():
+    src = """
+    from http.server import ThreadingHTTPServer
+    def render(counters):
+        return ["# TYPE srml_x counter"]
+    """
+    assert (
+        run(src, ExporterScopeRule, relpath="spark_rapids_ml_tpu/ops_plane/export.py")
+        == []
+    )
+
+
+def test_exporter_scope_fp_guards():
+    # non-server socket attribute use, urllib clients, and prose mentioning
+    # the modules (no marker strings) must not fire
+    clean = '''
+    import socket
+    import urllib.request
+    def f():
+        """Scrapes http.server-style endpoints via urllib, no server here."""
+        host = socket.gethostname()
+        return urllib.request.urlopen(f"http://{host}/metrics")
+    '''
+    assert run(clean, ExporterScopeRule) == []
+    # "TYPE" without the exposition marker form is not Prometheus assembly
+    assert run('KIND = "TYPE: counter"\n', ExporterScopeRule) == []

@@ -1,0 +1,670 @@
+#
+# Ops plane tests (docs/observability.md "Ops plane"): rolling windows
+# (rates, windowed quantiles, clamp-to-horizon, concurrent writers), SLO
+# burn-rate monitors (fast-window trip within one bucket width, error-rate
+# and gauge-ceiling kinds, trip/clear events), exporters (Prometheus text,
+# the /metrics + /healthz + /snapshot HTTP surface, rotating on-disk
+# snapshots), the decision audit trail (per-tenant/trace queries, fed by
+# fit admission + scheduler + serving verdicts), per-tenant ledger
+# accounting (byte-seconds/chip-seconds integration), the drift seedling
+# (per-column stats off the validation scan, PSI vs a registered baseline),
+# and the opsreport CLI — including the chaos-injected latency-spike
+# acceptance scenario: a `delay:stage=serve` plan flips /healthz to failing
+# via the fast burn window, and opsreport names the tenant, the violated
+# SLO, and the decision-log entries. All without a TPU.
+#
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import core, ops_plane, telemetry
+from spark_rapids_ml_tpu.ops_plane import audit, drift, export, slo
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture
+def tele():
+    """Fresh enabled registry with FAST window buckets; restore after."""
+    saved = {
+        k: core.config[k] for k in ("metrics_bucket_seconds", "metrics_bucket_count")
+    }
+    core.config["metrics_bucket_seconds"] = 0.05
+    core.config["metrics_bucket_count"] = 20  # 1s horizon
+    telemetry.registry().reset()
+    telemetry.enable()
+    yield telemetry.registry()
+    telemetry.disable()
+    telemetry.registry().reset()
+    core.config.update(saved)
+
+
+@pytest.fixture
+def slo_cfg():
+    saved = core.config["slo"]
+    slo.reset()
+    yield
+    core.config["slo"] = saved
+    slo.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_audit():
+    audit.clear()
+    yield
+    audit.clear()
+
+
+# ------------------------------------------------------------- windows ------
+
+
+def test_counter_rate_over_window(tele):
+    for _ in range(10):
+        tele.inc("ops_test.requests")
+    r = tele.rate("ops_test.requests")  # full 1s horizon
+    assert r is not None and r == pytest.approx(10.0, rel=0.01)
+    # a narrower window clamps to >= one bucket and still sees the burst
+    assert tele.rate("ops_test.requests", 0.05) > 0
+    # never-incremented counters have no rate (not a zero one)
+    assert tele.rate("ops_test.never") is None
+
+
+def test_window_ages_out_but_cumulative_persists(tele):
+    tele.observe("ops_test.lat_s", 5.0)
+    assert tele.window_quantile("ops_test.lat_s", 0.99) == 5.0
+    time.sleep(1.1)  # > the 1s horizon
+    assert tele.window_quantile("ops_test.lat_s", 0.99) is None
+    assert tele.window_count("ops_test.lat_s") == 0.0
+    # the cumulative views never forget
+    assert tele.quantile("ops_test.lat_s", 0.99) == 5.0
+    s = telemetry.summarize_histogram("ops_test.lat_s")
+    assert s["count"] == 1.0 and s["p99"] == 5.0
+    w = telemetry.summarize_histogram("ops_test.lat_s", window_s=1.0)
+    assert w["p99"] is None and w["window_count"] == 0.0
+
+
+def test_window_fraction_over(tele):
+    for v in (0.01, 0.01, 0.01, 1.0):
+        tele.observe("ops_test.lat_s", v)
+    frac, count = tele.window_fraction_over("ops_test.lat_s", 0.5)
+    assert count == 4 and frac == pytest.approx(0.25)
+    assert tele.window_fraction_over("ops_test.empty", 0.5) is None
+
+
+def test_windows_zero_cost_when_disabled(tele):
+    telemetry.disable()
+    tele.inc("ops_test.off")
+    tele.observe("ops_test.off_h", 1.0)
+    assert tele.rate("ops_test.off") is None
+    assert tele.window_quantile("ops_test.off_h", 0.5) is None
+
+
+def test_window_params_resolved_from_config(tele):
+    snap = tele.windows_snapshot()
+    assert snap["bucket_seconds"] == 0.05
+    assert snap["bucket_count"] == 20
+    assert snap["horizon_s"] == pytest.approx(1.0)
+
+
+def test_quantile_of_is_the_one_extraction():
+    assert telemetry.quantile_of([], 0.5) is None
+    assert telemetry.quantile_of([3.0, 1.0, 2.0], 0.5) == 2.0
+    assert telemetry.quantile_of([1.0], 0.99) == 1.0
+    # the registry's cumulative quantile delegates (same nearest-rank rule)
+    telemetry.registry().reset()
+    telemetry.enable()
+    try:
+        for v in (1.0, 2.0, 3.0):
+            telemetry.registry().observe("ops_test.q", v)
+        assert telemetry.registry().quantile("ops_test.q", 0.5) == 2.0
+    finally:
+        telemetry.disable()
+        telemetry.registry().reset()
+
+
+def test_windows_under_concurrent_writers(tele):
+    """The satellite pin: threaded serving + scheduler hammer the registry;
+    window reads must stay consistent (counts exact, quantiles inside the
+    observed range, no exceptions) under concurrent inc/observe."""
+    n_threads, per_thread = 8, 300
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(per_thread):
+                tele.inc("ops_test.conc")
+                tele.observe("ops_test.conc_h", float(tid * per_thread + i))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                tele.rate("ops_test.conc", 0.2)
+                for q in (
+                    tele.window_quantile("ops_test.conc_h", 0.99),
+                    tele.quantile("ops_test.conc_h", 0.5),  # cumulative view too
+                ):
+                    if q is not None:
+                        assert 0.0 <= q < n_threads * per_thread
+                tele.windows_snapshot()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers + threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors
+    # cumulative counter is exact under concurrency
+    snap = tele.snapshot()
+    assert snap["counters"]["ops_test.conc"] == n_threads * per_thread
+    assert snap["histograms"]["ops_test.conc_h"]["count"] == n_threads * per_thread
+    # the whole burst happened inside the horizon: the ring saw every inc
+    r = tele.rate("ops_test.conc")
+    assert r is not None and r > 0
+
+
+# ----------------------------------------------------------------- SLO ------
+
+
+def _latency_spec(threshold_s=0.1, objective=0.9, **over):
+    spec = {
+        "name": "test_lat", "kind": "latency", "histogram": "ops_test.lat_s",
+        "threshold_s": threshold_s, "objective": objective,
+    }
+    spec.update(over)
+    return spec
+
+
+def test_latency_slo_trips_on_fast_window(tele, slo_cfg):
+    core.config["slo"] = [_latency_spec(fast_burn=1.0)]
+    assert slo.health()["healthy"]  # empty window: healthy
+    t0 = time.monotonic()
+    for _ in range(10):
+        tele.observe("ops_test.lat_s", 1.0)  # every request violates
+    h = slo.health()
+    elapsed = time.monotonic() - t0
+    assert not h["healthy"] and h["failing"] == ["test_lat"]
+    # the fast window saw the spike within ONE bucket width of it landing
+    assert elapsed < 2 * core.config["metrics_bucket_seconds"] + 0.5
+    v = h["verdicts"][0]
+    assert v["fast_burn"] is not None and v["fast_burn"] >= 1.0
+    snap = tele.snapshot()
+    assert snap["counters"]["slo.trips"] == 1.0
+    assert snap["gauges"]["slo.failing"] == 1.0
+    # the structured slo.* event landed in the flight recorder
+    from spark_rapids_ml_tpu import diagnostics
+
+    kinds = [e["kind"] for e in diagnostics.flight_recorder().events()]
+    assert "slo.trip" in kinds
+
+
+def test_latency_slo_clears_when_spike_ages_out(tele, slo_cfg):
+    core.config["slo"] = [_latency_spec(fast_burn=1.0)]
+    tele.observe("ops_test.lat_s", 1.0)
+    assert not slo.health()["healthy"]
+    time.sleep(1.1)  # horizon
+    assert slo.health()["healthy"]
+    assert tele.snapshot()["counters"]["slo.clears"] == 1.0
+
+
+def test_error_rate_slo(tele, slo_cfg):
+    core.config["slo"] = [{
+        "name": "errs", "kind": "error_rate", "errors": "ops_test.errors",
+        "total": "ops_test.total", "threshold": 0.01, "fast_burn": 1.0,
+    }]
+    for _ in range(20):
+        tele.inc("ops_test.total")
+    assert slo.health()["healthy"]  # zero errors
+    tele.inc("ops_test.errors", 5)
+    h = slo.health()
+    assert not h["healthy"] and h["failing"] == ["errs"]
+
+
+def test_gauge_ceiling_slo(tele, slo_cfg):
+    core.config["slo"] = [{
+        "name": "util", "kind": "gauge_ceiling",
+        "gauge": "ops_test.util", "ceiling": 0.9,
+    }]
+    tele.gauge("ops_test.util", 0.5)
+    assert slo.health()["healthy"]
+    tele.gauge("ops_test.util", 0.95)
+    h = slo.health()
+    assert not h["healthy"]
+    assert h["verdicts"][0]["value"] == pytest.approx(0.95)
+
+
+def test_malformed_spec_degrades_to_error_verdict(tele, slo_cfg):
+    core.config["slo"] = [
+        {"name": "bad", "kind": "latency", "histogram": "h",
+         "threshold_s": "not-a-number"},
+        {"name": "unknown", "kind": "nope"},
+    ]
+    h = slo.health()  # must not raise
+    assert h["healthy"]
+    assert all("error" in v for v in h["verdicts"])
+
+
+def test_no_specs_is_vacuously_healthy(tele, slo_cfg):
+    core.config["slo"] = None
+    h = slo.health()
+    assert h["healthy"] and h["specs"] == 0
+
+
+# ----------------------------------------------------------- exporters ------
+
+
+def test_prometheus_render_names_and_rank_labels(tele):
+    tele.inc("ops_test.requests", 3)
+    tele.gauge("ops_test.util", 0.5)
+    tele.observe("ops_test.lat_s", 0.25)
+    text = export.render_prometheus()
+    assert "# TYPE srml_ops_test_requests counter" in text
+    assert 'srml_ops_test_requests{rank="0"} 3' in text
+    assert "# TYPE srml_ops_test_util gauge" in text
+    assert "# TYPE srml_ops_test_lat_s summary" in text
+    assert 'srml_ops_test_lat_s{rank="0",quantile="0.99"} 0.25' in text
+    assert 'srml_ops_test_lat_s_count{rank="0"} 1' in text
+
+
+def test_http_surface_and_healthz_flip(tele, slo_cfg):
+    host, port = export.start_server(0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5
+        ).read().decode()
+        assert body.startswith("# TYPE") or body == "\n"
+        # healthy: 200
+        core.config["slo"] = [_latency_spec(fast_burn=1.0)]
+        resp = urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
+        assert resp.status == 200
+        # violate the SLO: the NEXT scrape must be 503 (fresh evaluation)
+        tele.observe("ops_test.lat_s", 1.0)
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
+        assert exc_info.value.code == 503
+        verdict = json.loads(exc_info.value.read())
+        assert verdict["failing"] == ["test_lat"]
+        snap = json.loads(
+            urllib.request.urlopen(
+                f"http://{host}:{port}/snapshot", timeout=5
+            ).read()
+        )
+        assert set(snap) >= {"health", "slo", "windows", "decisions", "tenants"}
+        with pytest.raises(urllib.error.HTTPError) as nf:
+            urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=5)
+        assert nf.value.code == 404
+    finally:
+        export.stop_server()
+
+
+def test_snapshot_rotation(tele, tmp_path):
+    path = str(tmp_path / "ops_snapshot.json")
+    for _ in range(4):
+        assert export.write_snapshot(path, keep=2) == path
+    assert (tmp_path / "ops_snapshot.json").exists()
+    assert (tmp_path / "ops_snapshot.1.json").exists()
+    assert (tmp_path / "ops_snapshot.2.json").exists()
+    assert not (tmp_path / "ops_snapshot.3.json").exists()  # bounded
+    with open(path) as f:
+        rep = json.load(f)
+    assert "health" in rep and "tenants" in rep
+
+
+def test_snapshot_skipped_without_dir(tele, monkeypatch):
+    monkeypatch.delenv("SRML_OPS_SNAPSHOT_DIR", raising=False)
+    saved = core.config["ops_snapshot_dir"]
+    core.config["ops_snapshot_dir"] = None
+    try:
+        assert export.write_snapshot() is None
+    finally:
+        core.config["ops_snapshot_dir"] = saved
+
+
+# ------------------------------------------------------------- audit --------
+
+
+def test_audit_record_and_query(tele):
+    audit.record_decision("admission", "fit", "resident", subject="KMeans",
+                          tenant="t1", reason="fits")
+    audit.record_decision("demotion", "scheduler", "stream", subject="job:1",
+                          tenant="t2", reason="preempted twice")
+    assert len(audit.decisions()) == 2
+    assert [d["tenant"] for d in audit.decisions(tenant="t2")] == ["t2"]
+    assert audit.decisions(kind="demotion")[0]["verdict"] == "stream"
+    assert audit.decisions(subsystem="fit")[0]["subject"] == "KMeans"
+    assert audit.decisions(limit=1)[0]["kind"] == "demotion"  # newest kept
+    st = audit.stats()
+    assert st["recorded"] == 2 and st["retained"] == 2 and st["dropped"] == 0
+    snap = tele.snapshot()
+    assert snap["counters"]["ops.decisions_recorded"] == 2.0
+
+
+def test_audit_records_regardless_of_telemetry():
+    telemetry.disable()
+    audit.record_decision("admission", "fit", "resident", subject="X")
+    assert len(audit.decisions()) == 1  # decisions are robustness state
+
+
+def test_audit_carries_trace_id(tele):
+    from spark_rapids_ml_tpu import diagnostics
+
+    with diagnostics.trace_scope("ops-test"):
+        rec = audit.record_decision("admission", "fit", "resident", subject="X")
+        tid = rec["trace_id"]
+    audit.record_decision("admission", "fit", "resident", subject="Y")
+    assert [d["subject"] for d in audit.decisions(trace_id=tid)] == ["X"]
+
+
+def test_fit_admission_lands_in_audit_trail(tele):
+    """E2E: a real fit's admission verdict is queryable from the trail."""
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    rng = np.random.default_rng(0)
+    df = {"features": rng.standard_normal((200, 4)).astype(np.float32)}
+    est = KMeans(k=2, maxIter=2, seed=1)
+    est.num_workers = 1
+    est.fit(df)
+    recs = audit.decisions(kind="admission", subsystem="fit")
+    assert recs and recs[-1]["verdict"] == "resident"
+    assert recs[-1]["tenant"] == "default"
+    assert recs[-1]["trace_id"]  # fits run inside trace_scope
+
+
+# -------------------------------------------------- tenant accounting -------
+
+
+def test_ledger_tenant_byte_seconds_integration(tele):
+    from spark_rapids_ml_tpu.scheduler.ledger import HbmLedger
+
+    led = HbmLedger()
+    t0 = time.monotonic()
+    r = led.reserve("fit:X", "fit", 1000, tenant="t1", chips=4)
+    time.sleep(0.05)
+    led.resize(r, 2000)
+    time.sleep(0.05)
+    led.release(r)
+    elapsed = time.monotonic() - t0
+    led.release(r)  # idempotent: no double accounting
+    u = led.tenant_usage()["t1"]
+    # interval 1 charged at 1000B, interval 2 at the resized 2000B
+    assert 0.05 * (1000 + 2000) * 0.8 < u["byte_seconds"] <= elapsed * 2000
+    assert 4 * 0.1 * 0.8 < u["chip_seconds"] <= 4 * elapsed
+    assert u["reservations"] == 1
+    assert "live_bytes" not in u  # released
+    # a second tenant_usage() call does not re-accrue the released claim
+    assert led.tenant_usage()["t1"]["byte_seconds"] == u["byte_seconds"]
+
+
+def test_scheduler_job_and_fit_admission_charge_same_chips(tele):
+    """The chip-seconds multiplier must agree across admission paths: a
+    scheduler job's ledger claim carries the mesh width its preflight
+    estimated (not the default 1), and a fit admission stamps its device
+    count on the AdmissionDecision so cache-hit re-reserves charge alike."""
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.scheduler import FitScheduler
+    from spark_rapids_ml_tpu.scheduler.ledger import global_ledger
+
+    rng = np.random.default_rng(1)
+    df = {"features": rng.standard_normal((400, 4)).astype(np.float32)}
+    est = KMeans(k=2, maxIter=2, seed=1)
+    est.num_workers = 8
+    sched = FitScheduler(max_concurrent=1)
+    try:
+        job = sched.submit(est, df, tenant="t8")
+        model = job.result(timeout=120)
+    finally:
+        sched.shutdown(wait=True, timeout=30)
+    assert job.chips == 8
+    # the standalone fit path stamps the same multiplier on its decision
+    est2 = KMeans(k=2, maxIter=2, seed=1)
+    est2.num_workers = 8
+    est2.fit(df)
+    assert est2._last_admission.chips == 8
+    usage = global_ledger().tenant_usage()
+    assert usage["t8"]["chip_seconds"] > 0
+
+
+def test_ledger_live_claims_integrate_to_now(tele):
+    from spark_rapids_ml_tpu.scheduler.ledger import HbmLedger
+
+    led = HbmLedger()
+    led.reserve("serve:M", "serve", 500, tenant="serving")
+    time.sleep(0.03)
+    u1 = led.tenant_usage()["serving"]
+    assert u1["live_bytes"] == 500 and u1["live_reservations"] == 1
+    assert u1["byte_seconds"] > 0
+    time.sleep(0.03)
+    u2 = led.tenant_usage()["serving"]
+    assert u2["byte_seconds"] > u1["byte_seconds"]  # still accruing
+
+
+# --------------------------------------------------------------- drift ------
+
+
+def _extract(x, validate=True):
+    from spark_rapids_ml_tpu.data import extract_dataset
+
+    return extract_dataset({"features": x}, input_col="features", validate=validate)
+
+
+def test_drift_stats_published_from_validation_scan(tele):
+    from spark_rapids_ml_tpu.data import validate_extracted
+
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((500, 3)) * np.array([1.0, 2.0, 3.0])).astype(np.float32)
+    ex = _extract(x)
+    validate_extracted(ex)
+    gauges = tele.snapshot()["gauges"]
+    # a single vector-block column publishes per-column-INDEX gauges
+    for i in range(3):
+        assert gauges[f"ingest.feature.{i}.mean"] == pytest.approx(
+            float(x[:, i].mean()), abs=1e-5
+        )
+        assert gauges[f"ingest.feature.{i}.std"] == pytest.approx(
+            float(x[:, i].std()), rel=1e-4
+        )
+        assert gauges[f"ingest.feature.{i}.null_fraction"] == 0.0
+
+
+def test_drift_stats_exact_per_column_and_chunked(tele):
+    from spark_rapids_ml_tpu.data import validate_extracted
+
+    saved = core.config["ingest_chunk_bytes"]
+    core.config["ingest_chunk_bytes"] = 64 * 4  # force many chunks
+    try:
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((333, 2)).astype(np.float64)
+        import pandas as pd
+
+        from spark_rapids_ml_tpu.data import extract_dataset
+
+        df = pd.DataFrame({"a": x[:, 0], "b": x[:, 1]})
+        ex = extract_dataset(df, input_cols=["a", "b"], float32_inputs=False)
+        validate_extracted(ex)
+        stats = drift.last_stats()
+        assert stats["rows"] == 333 and stats["columns"] == ["a", "b"]
+        np.testing.assert_allclose(stats["mean"], x.mean(axis=0), rtol=1e-9)
+        np.testing.assert_allclose(stats["std"], x.std(axis=0), rtol=1e-6)
+        assert stats["null_fraction"] == [0.0, 0.0]
+        gauges = tele.snapshot()["gauges"]
+        assert gauges["ingest.feature.a.mean"] == pytest.approx(x[:, 0].mean())
+        assert gauges["ingest.feature.b.std"] == pytest.approx(
+            x[:, 1].std(), rel=1e-6
+        )
+    finally:
+        core.config["ingest_chunk_bytes"] = saved
+
+
+def test_drift_psi_against_registered_baseline(tele):
+    from spark_rapids_ml_tpu.data import validate_extracted
+
+    rng = np.random.default_rng(5)
+    ref = rng.standard_normal((2000, 2))
+    base = drift.build_baseline(_extract(ref))
+    drift.register_baseline(base)
+    try:
+        # same distribution: PSI ~ 0
+        same = _extract(rng.standard_normal((2000, 2)))
+        validate_extracted(same)
+        psi_same = tele.snapshot()["gauges"]["ingest.feature.psi_max"]
+        assert psi_same < 0.05
+        # shifted distribution: PSI large
+        shifted = _extract(rng.standard_normal((2000, 2)) + 3.0)
+        validate_extracted(shifted)
+        psi_shift = tele.snapshot()["gauges"]["ingest.feature.psi_max"]
+        assert psi_shift > 0.5
+        assert drift.last_stats()["psi_max"] == pytest.approx(psi_shift)
+    finally:
+        drift.clear_baseline()
+
+
+def test_drift_skips_sparse_and_disabled(tele):
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.ops_plane.drift import accumulator_for
+
+    ex = _extract(np.ones((4, 2)))
+    ex.features = sp.csr_matrix(ex.features)
+    assert accumulator_for(ex) is None
+    telemetry.disable()
+    ex2 = _extract(np.ones((4, 2)))
+    assert accumulator_for(ex2) is None
+
+
+# ----------------------------------------------- report() + opsreport -------
+
+
+def test_report_shape_and_filters(tele):
+    audit.record_decision("admission", "fit", "resident", subject="A", tenant="t1")
+    audit.record_decision("eviction", "serving", "evicted", subject="B",
+                          tenant="serving")
+    rep = ops_plane.report(tenant="t1")
+    assert set(rep) >= {
+        "health", "slo", "windows", "decisions", "decision_log", "tenants",
+        "drift", "telemetry",
+    }
+    assert [d["tenant"] for d in rep["decisions"]] == ["t1"]
+    json.dumps(rep, default=str)  # JSON-able end to end
+
+
+def test_opsreport_cli_unreadable_snapshot(tmp_path, capsys):
+    from benchmark.opsreport import main
+
+    bad = tmp_path / "nope.json"
+    assert main([str(bad)]) == 2
+    bad.write_text("{not json")
+    assert main([str(bad)]) == 2
+
+
+# ------------------------------------------- the acceptance scenario --------
+
+
+def test_chaos_latency_spike_flips_healthz_and_opsreport_names_it(
+    tele, slo_cfg, tmp_path, capsys
+):
+    """The ISSUE acceptance pin: a chaos-injected serving latency spike
+    (`delay:stage=serve` plan) flips /healthz to failing via the fast
+    burn-rate window within one bucket width, and opsreport — fed the
+    on-disk snapshot — names the tenant, the violated SLO, and the
+    decision-log entries for that trace. No TPU involved."""
+    from spark_rapids_ml_tpu.models.clustering import KMeansModel
+    from spark_rapids_ml_tpu.parallel import chaos
+    from spark_rapids_ml_tpu.serving import ModelRegistry, ScoringEngine
+
+    rng = np.random.default_rng(7)
+    centers = (rng.standard_normal((4, 6)) * 5.0).astype(np.float32)
+    model = KMeansModel(cluster_centers_=centers, n_cols=6, dtype="float32")
+
+    saved = {k: core.config[k] for k in ("serve_prewarm_rows", "slo")}
+    core.config["serve_prewarm_rows"] = 16
+    core.config["slo"] = [{
+        "name": "serve_p99", "kind": "latency", "histogram": "serve.e2e_s",
+        "threshold_s": 0.05, "objective": 0.9, "fast_burn": 1.0,
+    }]
+    host, port = export.start_server(0)
+    try:
+        registry = ModelRegistry()
+        registry.load("m", model)
+        with ScoringEngine(registry) as engine:
+            q = rng.standard_normal((8, 6)).astype(np.float32)
+            engine.score("m", q)  # warm, fast request: healthy baseline
+            assert urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=5
+            ).status == 200
+            # inject the spike: every dispatch sleeps 0.2s (>> threshold)
+            chaos.set_fault_plan("delay:stage=serve:seconds=0.2:times=4")
+            t_spike = time.monotonic()
+            for _ in range(4):
+                engine.score("m", q, timeout=30)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
+            detect_s = time.monotonic() - t_spike - 4 * 0.2
+            assert exc_info.value.code == 503
+            verdict = json.loads(exc_info.value.read())
+            assert verdict["failing"] == ["serve_p99"]
+            # detection is scrape-fresh: within ~one bucket width of the
+            # spike landing (generous slack for CI scheduling)
+            assert detect_s < 2 * core.config["metrics_bucket_seconds"] + 1.0
+        # the load's admission decision is in the trail, tenant "serving"
+        recs = audit.decisions(tenant="serving", subsystem="serving")
+        assert recs and recs[0]["verdict"] == "resident"
+        trace = recs[0].get("trace_id")
+        # archive + render: opsreport names the SLO, the tenant, the entries
+        snap_path = str(tmp_path / "ops_snapshot.json")
+        assert export.write_snapshot(snap_path) == snap_path
+        from benchmark.opsreport import main
+
+        args = [snap_path, "--tenant", "serving"]
+        if trace:
+            args += ["--trace-id", trace]
+        rc = main(args)
+        out = capsys.readouterr().out
+        assert rc == 1  # an SLO is failing
+        assert "FAILING" in out and "serve_p99" in out
+        assert "tenant=serving" in out and "resident" in out
+    finally:
+        chaos.clear_fault_plan()
+        export.stop_server()
+        core.config.update(saved)
+        registry.clear()
+
+
+# ------------------------------------------------ stats delegation ----------
+
+
+def test_engine_and_scheduler_stats_share_the_extraction(tele):
+    """The satellite pin: both stats() surfaces read p50/p99 through
+    telemetry.summarize_histogram, so seeding the histograms directly is
+    visible through BOTH with identical nearest-rank semantics."""
+    from spark_rapids_ml_tpu.scheduler import FitScheduler
+    from spark_rapids_ml_tpu.serving import ModelRegistry, ScoringEngine
+
+    for v in (0.1, 0.2, 0.3):
+        tele.observe("serve.e2e_s", v)
+        tele.observe("serve.queue_wait_s", v)
+        tele.observe("scheduler.queue_wait_s", v)
+    engine = ScoringEngine(ModelRegistry())
+    es = engine.stats()
+    assert es["e2e_p50_s"] == telemetry.quantile_of([0.1, 0.2, 0.3], 0.5)
+    assert es["e2e_p99_s"] == 0.3
+    sched = FitScheduler(max_concurrent=1)
+    try:
+        ss = sched.stats()
+        assert ss["queue_wait_p50_s"] == 0.2
+        assert ss["queue_wait_p99_s"] == 0.3
+        assert ss["tenant_usage"] == {} or isinstance(ss["tenant_usage"], dict)
+    finally:
+        sched.shutdown(wait=False)
