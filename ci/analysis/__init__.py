@@ -18,6 +18,7 @@ from .engine import (
     Run,
     RuleBase,
     analyze_source,
+    analyze_sources,
 )
 
 __all__ = [
@@ -28,4 +29,5 @@ __all__ = [
     "Run",
     "RuleBase",
     "analyze_source",
+    "analyze_sources",
 ]

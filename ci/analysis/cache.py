@@ -1,0 +1,116 @@
+#
+# Content-hash result cache for the analysis gate (ci/analysis/cache.json,
+# gitignored). Per file it stores the per-file rule findings, the pass-1
+# program facts (program.py), each collector rule's per-file state
+# contribution (rules/registries.py usages), and the file's dynamic-name
+# entries — everything a re-parse would produce — keyed by the sha256 of the
+# file's bytes. The whole cache is invalidated by the ENGINE hash: a sha256
+# over every .py under ci/analysis/, so editing a rule or the engine re-runs
+# everything (a stale rule result must never survive a rule change).
+#
+# Cross-file work (the program fixpoints, registry finalize, baseline
+# ratchet) always re-runs from the cached facts/states — only parsing and
+# per-file rule traversal are skipped.
+#
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+VERSION = 1
+CACHE_BASENAME = "cache.json"
+
+
+def engine_hash(analysis_dir: str) -> str:
+    """sha256 over every .py in ci/analysis (sorted, path-tagged) — the
+    invalidation key for engine/rule-source changes."""
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(analysis_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(p, analysis_dir).encode())
+            with open(p, "rb") as f:
+                h.update(hashlib.sha256(f.read()).digest())
+    return h.hexdigest()
+
+
+def hash_bytes(raw: bytes) -> str:
+    return hashlib.sha256(raw).hexdigest()
+
+
+class Cache:
+    """Load-mutate-save wrapper over cache.json. Disabled (load returns
+    None) when the analysis dir does not exist under the scanned root —
+    fixture roots in tests must not grow cache files."""
+
+    def __init__(self, path: str, engine: str, entries: Dict[str, Any]):
+        self.path = path
+        self.engine = engine
+        self.entries = entries
+        self.hits = 0
+        self._dirty = False
+
+    @classmethod
+    def load(cls, root: str) -> Optional["Cache"]:
+        analysis_dir = os.path.join(root, "ci", "analysis")
+        if not os.path.isdir(analysis_dir):
+            return None
+        path = os.path.join(analysis_dir, CACHE_BASENAME)
+        engine = engine_hash(os.path.dirname(os.path.abspath(__file__)))
+        entries: Dict[str, Any] = {}
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("version") == VERSION and data.get("engine") == engine:
+                entries = data.get("entries", {})
+        except (OSError, ValueError):
+            entries = {}  # corrupt/missing cache: start cold, never crash
+        return cls(path, engine, entries)
+
+    def lookup(self, relpath: str, content_hash: str) -> Optional[Dict[str, Any]]:
+        """Entry for `relpath` iff its stored hash matches `content_hash` —
+        the caller hashes the exact bytes it will analyze, so a file
+        modified mid-run can never map its new hash onto stale results."""
+        entry = self.entries.get(relpath)
+        if entry is None or content_hash != entry.get("hash"):
+            return None
+        self.hits += 1
+        return entry
+
+    def store(
+        self,
+        relpath: str,
+        content_hash: str,
+        findings: List[Dict[str, Any]],
+        facts: Optional[Dict[str, Any]],
+        state: Dict[str, Any],
+        dynamic: List[str],
+    ) -> None:
+        self.entries[relpath] = {
+            "hash": content_hash,
+            "findings": findings,
+            "facts": facts,
+            "state": state,
+            "dynamic": dynamic,
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        payload = {"version": VERSION, "engine": self.engine, "entries": self.entries}
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

@@ -12,6 +12,7 @@ import importlib
 import json
 import os
 import sys
+import time
 from typing import Dict, List, Optional, Sequence
 
 from . import baseline as baseline_mod
@@ -57,11 +58,38 @@ def _import_smoke(root: str) -> Dict[str, str]:
     return results
 
 
+def explain_rule(run: Run, rule_id: str) -> int:
+    """`--explain <rule-id>`: the rule's one-line invariant, waiver form, and
+    the full module docstring it ships with (the rationale + examples)."""
+    for rule in run.rules:
+        ids = [rule.id] + [sid for sid, _ in getattr(rule, "sub_ids", ())]
+        if rule_id not in ids:
+            continue
+        print(f"rule: {rule.id}")
+        if getattr(rule, "sub_ids", ()):
+            print("sub-ids: " + ", ".join(sid for sid, _ in rule.sub_ids))
+        waiver = f"# {rule.waiver}-ok: <reason>" if rule.waiver else "(none — not waivable)"
+        print(f"waiver: {waiver}")
+        print(f"scope: {', '.join(rule.tree_scope)}")
+        print(f"invariant: {rule.description}")
+        doc = getattr(rule, "explain", None) or sys.modules[type(rule).__module__].__doc__
+        if doc:
+            print("\n" + doc.strip("\n"))
+        return 0
+    for rule_id_known, desc in ENGINE_RULE_IDS:
+        if rule_id == rule_id_known:
+            print(f"rule: {rule_id} (engine-emitted)\nwaiver: (none)\ninvariant: {desc}")
+            return 0
+    print(f"analysis: unknown rule id `{rule_id}` — see --list-rules")
+    return 1
+
+
 def build_verdict(
     run: Run,
     verdict: baseline_mod.Verdict,
     baseline_path: str,
     imports: Dict[str, str],
+    wall_s: float = 0.0,
 ) -> Dict:
     ok = (
         verdict.ok
@@ -76,6 +104,8 @@ def build_verdict(
         "version": VERDICT_VERSION,
         "verdict": "pass" if ok else "fail",
         "files_scanned": run.files_scanned,
+        "files_cached": run.files_cached,
+        "wall_s": wall_s,
         "missing_targets": list(run.missing_targets),
         "rules": _catalog(run),
         "findings": findings,
@@ -111,21 +141,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--no-imports", action="store_true",
                     help="skip the package import smoke (fixture runs)")
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    ap.add_argument("--explain", metavar="RULE_ID", default=None,
+                    help="print one rule's invariant, waiver form, and rationale, then exit")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the per-file result cache "
+                         "(ci/analysis/cache.json)")
+    ap.add_argument("--time-budget", type=float, default=60.0,
+                    help="analysis wall-time budget in seconds — printed with the "
+                         "measured time; exceeding it warns, never fails (default 60)")
     args = ap.parse_args(argv)
 
     root = os.path.abspath(args.root or _repo_root())
-    run = Run(root, targets=args.targets)
+    run = Run(root, targets=args.targets, use_cache=not args.no_cache)
 
     if args.list_rules:
         for row in _catalog(run):
             waiver = f"# {row['waiver']}-ok: <reason>" if row["waiver"] else "(no waiver)"
             print(f"{row['id']:24s} {waiver:28s} {row['description']}")
         return 0
+    if args.explain is not None:
+        return explain_rule(run, args.explain)
 
     baseline_path = args.baseline or os.path.join(
         root, "ci", "analysis", "baseline.json"
     )
+    t0 = time.perf_counter()  # telemetry-ok: CLI wall-time budget, not framework stage timing
     run.analyze()
+    wall_s = time.perf_counter() - t0
     baseline = baseline_mod.load(baseline_path)
     verdict = baseline_mod.apply(run.findings, baseline)
 
@@ -187,7 +229,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     imports = {} if args.no_imports else _import_smoke(root)
-    payload = build_verdict(run, verdict, baseline_path, imports)
+    payload = build_verdict(run, verdict, baseline_path, imports, wall_s=wall_s)
 
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as f:
@@ -213,9 +255,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         n_imp = sum(1 for v in imports.values() if v != "ok")
         if payload["verdict"] == "pass":
             print(
-                f"analysis: OK ({run.files_scanned} files, {len(run.rules)} rules, "
+                f"analysis: OK ({run.files_scanned} files, "
+                f"{run.files_cached} cached, {len(run.rules)} rules, "
                 f"{len(verdict.baselined)} baselined finding(s))"
             )
         else:
             print(f"analysis: {n_new + n_imp} issue(s)")
+        over = " — OVER BUDGET" if wall_s > args.time_budget else ""
+        print(
+            f"analysis: wall time {wall_s:.2f}s "
+            f"(budget {args.time_budget:g}s{over})"
+        )
     return 0 if payload["verdict"] == "pass" else 1

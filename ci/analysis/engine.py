@@ -183,8 +183,13 @@ class RuleBase:
     """One invariant. `check_module` walks a parsed file (rules own their
     traversal — structural rules need custom context the shared walker can't
     anticipate); `finalize` runs once after every file, for cross-file rules
-    (the registries). docs/development.md documents the catalog + how to add
-    one."""
+    (the registries and the program-model concurrency rules).
+    docs/development.md documents the catalog + how to add one.
+
+    `file_state`/`restore_state` are the content-hash cache's hooks for
+    collector rules: the per-file slice of accumulated state is stored on a
+    miss and replayed on a hit, so a cache-skipped file still contributes to
+    `finalize`."""
 
     id: str = ""
     waiver: Optional[str] = None  # waiver tag; comment form `# <tag>-ok: <reason>`
@@ -200,6 +205,14 @@ class RuleBase:
 
     def finalize(self, run: "Run") -> List[Finding]:
         return []
+
+    def file_state(self, relpath: str):
+        """JSON-able per-file contribution to cross-file state (None when
+        the rule accumulates none)."""
+        return None
+
+    def restore_state(self, relpath: str, state) -> None:
+        """Replay a cached `file_state` contribution (cache hit path)."""
 
 
 @dataclass
@@ -270,6 +283,7 @@ class Run:
         targets: Sequence[str] = ("spark_rapids_ml_tpu", "benchmark", "tests"),
         rules: Optional[Sequence[RuleBase]] = None,
         sources: Optional[RegistrySources] = None,
+        use_cache: bool = True,
     ):
         if rules is None:
             from .rules import default_rules
@@ -279,14 +293,20 @@ class Run:
         self.targets = list(targets)
         self.rules = list(rules)
         self.sources = sources if sources is not None else RegistrySources.load(self.root)
+        self.use_cache = use_cache
         self.findings: List[Finding] = []
         self.files_scanned = 0
+        self.files_cached = 0
         self.skipped: List[str] = []
         self.missing_targets: List[str] = []
         # names metric/config rules could not check statically (f-strings,
         # variables) — surfaced in the verdict so dynamic names are a visible
         # gap, not a silent one
         self.dynamic_names: List[str] = []
+        # pass 1 of the two-pass engine: per-file program facts, assembled
+        # into the whole-program model the interprocedural rules finalize on
+        self._facts: Dict[str, Optional[Dict[str, Any]]] = {}
+        self.program: Optional[Any] = None
 
     def discover(self) -> List[Tuple[str, str]]:
         out: List[Tuple[str, str]] = []
@@ -315,10 +335,13 @@ class Run:
                     out.append((target, os.path.join(dirpath, fn)))
         return out
 
-    def analyze_file(self, target: str, path: str) -> List[Finding]:
+    def analyze_file(
+        self, target: str, path: str, raw: Optional[bytes] = None
+    ) -> List[Finding]:
         relpath = os.path.relpath(path, self.root).replace(os.sep, "/")
-        with open(path, "rb") as f:
-            raw = f.read()
+        if raw is None:
+            with open(path, "rb") as f:
+                raw = f.read()
         try:
             # explicit: no locale-dependent reads in CI; -sig strips a BOM,
             # which CPython accepts but compile(str) would reject as U+FEFF
@@ -356,15 +379,72 @@ class Run:
             for rule in self.rules:
                 if getattr(rule, "text_only", False) and rule.applies(ctx):
                     rule.check_module(None, ctx)  # type: ignore[arg-type]
+        # pass-1 facts for the whole-program model (framework tree only —
+        # the concurrency rules scope there)
+        if target == "spark_rapids_ml_tpu":
+            from . import program as program_mod
+
+            self._facts[relpath] = (
+                program_mod.extract_facts(ctx) if ctx.tree is not None else None
+            )
         return ctx.findings
 
     def analyze(self) -> List[Finding]:
+        from . import cache as cache_mod
+        from . import program as program_mod
+
+        cache = cache_mod.Cache.load(self.root) if self.use_cache else None
         for target, path in self.discover():
-            self.findings.extend(self.analyze_file(target, path))
+            relpath = os.path.relpath(path, self.root).replace(os.sep, "/")
+            # ONE read per file: the cache key is the hash of the exact
+            # bytes analyzed below, so a mid-run edit can never bind its new
+            # hash to stale results
+            raw: Optional[bytes] = None
+            content_hash: Optional[str] = None
+            if cache is not None:
+                try:
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                    content_hash = cache_mod.hash_bytes(raw)
+                except OSError:
+                    raw = None
+                if content_hash is not None:
+                    entry = cache.lookup(relpath, content_hash)
+                    if entry is not None:
+                        self.findings.extend(Finding(**f) for f in entry["findings"])
+                        if target == "spark_rapids_ml_tpu":
+                            self._facts[relpath] = entry.get("facts")
+                        for rule in self.rules:
+                            state = entry.get("state", {}).get(rule.id)
+                            if state is not None:
+                                rule.restore_state(relpath, state)
+                        self.dynamic_names.extend(entry.get("dynamic", []))
+                        self.files_scanned += 1
+                        self.files_cached += 1
+                        continue
+            file_findings = self.analyze_file(target, path, raw=raw)
+            self.findings.extend(file_findings)
             self.files_scanned += 1
+            if cache is not None and content_hash is not None:
+                state = {}
+                for rule in self.rules:
+                    s = rule.file_state(relpath)
+                    if s is not None:
+                        state[rule.id] = s
+                cache.store(
+                    relpath,
+                    content_hash,
+                    [f.as_dict() for f in file_findings],
+                    self._facts.get(relpath),
+                    state,
+                    [d for d in self.dynamic_names if d.startswith(relpath + ":")],
+                )
+        self.program = program_mod.build_program(self._facts)
         for rule in self.rules:
             self.findings.extend(rule.finalize(self))
         self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        if cache is not None:
+            cache.save()
         return self.findings
 
 
@@ -379,8 +459,29 @@ def analyze_source(
     it lived at `relpath` under the repo root — the exact same pipeline as
     the tree scan (analyze_one), so fixtures cannot drift from production
     behavior."""
-    run = Run(root, targets=(), rules=rules, sources=sources or RegistrySources())
-    findings = list(run.analyze_one(relpath, relpath, source))
+    return analyze_sources({relpath: source}, rules=rules, sources=sources, root=root)
+
+
+def analyze_sources(
+    files: Dict[str, str],
+    rules: Optional[Sequence[RuleBase]] = None,
+    sources: Optional[RegistrySources] = None,
+    root: str = "/",
+) -> List[Finding]:
+    """Multi-file fixture entry: the cross-file pipeline (per-file rules,
+    pass-1 facts, whole-program assembly, finalize) over in-memory snippets —
+    how the lock-order cycle tests seed an inversion SPLIT across files that
+    no per-file analysis could see."""
+    from . import program as program_mod
+
+    run = Run(
+        root, targets=(), rules=rules, sources=sources or RegistrySources(),
+        use_cache=False,
+    )
+    findings: List[Finding] = []
+    for relpath, source in files.items():
+        findings.extend(run.analyze_one(relpath, relpath, source))
+    run.program = program_mod.build_program(run._facts)
     for rule in run.rules:
         findings.extend(rule.finalize(run))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

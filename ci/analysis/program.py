@@ -1,0 +1,810 @@
+#
+# Pass 1 of the two-pass analysis engine: the whole-program model the
+# interprocedural concurrency rules (rules/concurrency.py) run on.
+#
+# Per file, `extract_facts` distills the parsed AST into a JSON-able fact
+# dict — the repo-wide symbol table (functions/methods/classes), the lock
+# inventory (every `threading.Lock/RLock/Condition` or `lockcheck.make_lock`
+# construction, named `<module>.<Class>.<attr>` / `<module>.<global>`),
+# `# guarded-by: <lock>` field declarations, lock-returning helpers
+# (`def admission(self): return self._admission_lock`), and a per-function
+# event stream: every lock ACQUIRE, CALL, potentially-BLOCKing operation,
+# and guarded-field ACCESS, each tagged with the lexically-held lock set and
+# any waiver tags on its lines. Facts are what the content-hash cache
+# (cache.py) persists, so an unchanged file contributes to the whole-program
+# pass without being re-parsed.
+#
+# `Program` assembles every file's facts into one model: cross-file call
+# resolution (imports -> module functions; unique-method-name match with a
+# receiver-name hint for the stdlib-shaped names like `.get`/`.release`),
+# then three fixpoints pass 2 consumes:
+#
+#   trans_acquires(f)  locks f may acquire, directly or through any resolved
+#                      call chain (with the acquisition site + chain)
+#   may_block(f)       blocking operations f may reach, likewise
+#   entry_held(f)      locks held at EVERY resolved in-program call site of
+#                      f (intersection) — how `_locked`-suffixed helpers and
+#                      other always-called-under-lock functions are proven
+#                      safe without lexical `with` blocks of their own
+#
+# Soundness posture (documented in docs/development.md): dynamic dispatch the
+# resolver cannot see (callbacks, hooks, thread targets, ambiguous method
+# names) is skipped, never guessed — the rules prefer missed findings over
+# false cycles.
+#
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
+
+# ------------------------------------------------------------ lock spotting --
+
+_LOCK_CTOR_KINDS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+# the runtime sanitizer's factory (utils/lockcheck.py) — construction through
+# it must stay visible to the static pass
+_LOCKCHECK_FACTORIES = {"make_lock", "make_condition"}
+
+# blocking-operation trigger set for the held-critical-section rule
+_RENDEZVOUS_TAILS = {"barrier", "allgather", "allgather_concat", "reform"}
+_NETWORK_CALLS = {
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "socket.create_server",
+}
+
+# method tails too generic to resolve by name alone (dict.get, list.append,
+# str.join, file.write ... would alias onto framework methods); these resolve
+# only when the receiver's name hints at the owning class (`self._ledger
+# .release` -> HbmLedger.release, but `self._entries.get` stays unresolved)
+_COMMON_METHOD_TAILS = {
+    "get", "put", "set", "pop", "add", "append", "extend", "clear", "keys",
+    "values", "items", "update", "copy", "remove", "discard", "insert",
+    "sort", "reverse", "count", "index", "join", "split", "strip", "read",
+    "write", "close", "flush", "open", "send", "recv", "load", "save",
+    "dump", "dumps", "loads", "popleft", "appendleft", "setdefault",
+    "move_to_end", "total", "release", "acquire", "submit", "result",
+    "done", "start", "stop", "run", "record", "reset", "stats", "fit",
+    "wait", "notify", "names", "events", "tail",
+}
+
+_WAIVER_TAGS = ("lock-order", "held", "guard")
+
+# a held-set entry is a resolved lock id (str) or an unresolved
+# `with helper():` call spec (dict) normalized at assembly
+HeldEntry = Union[str, Dict[str, Any]]
+
+
+def module_path(relpath: str) -> str:
+    """Repo relpath -> the short dotted module id lock/function names use:
+    `spark_rapids_ml_tpu/scheduler/ledger.py` -> `scheduler.ledger` (package
+    prefix dropped for readability; `__init__.py` names the package)."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = p.split("/")
+    if parts and parts[0] == "spark_rapids_ml_tpu":
+        parts = parts[1:]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else "pkg"
+
+
+def full_module(relpath: str) -> str:
+    """Repo relpath -> the full dotted import path (`spark_rapids_ml_tpu.
+    scheduler.ledger`) used to resolve import origins."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _dotted(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(imports.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def _ctor_kind(value: Optional[ast.AST], imports: Dict[str, str]) -> Optional[str]:
+    """Lock kind when `value` constructs one (threading.* or the lockcheck
+    factory), else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _dotted(value.func, imports)
+    if name is None:
+        return None
+    for ctor, kind in _LOCK_CTOR_KINDS.items():
+        if name == ctor or name.endswith("." + ctor):
+            return kind
+    tail = name.split(".")[-1]
+    if tail in _LOCKCHECK_FACTORIES:
+        if tail == "make_condition":
+            return "condition"
+        for kw in value.keywords:
+            if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                return str(kw.value.value)
+        if len(value.args) > 1 and isinstance(value.args[1], ast.Constant):
+            return str(value.args[1].value)
+        return "lock"
+    return None
+
+
+def _parse_guard(comment: str) -> Optional[str]:
+    """`# guarded-by: <lock>` -> the lock name, else None. The declaration
+    may trail prose (`# events ever recorded  # guarded-by: _lock`)."""
+    idx = comment.find("guarded-by:")
+    if idx < 0:
+        return None
+    name = comment[idx + len("guarded-by:"):].strip()
+    return name.split()[0] if name else None
+
+
+# ------------------------------------------------------------- extraction ---
+
+
+class _FactsBuilder:
+    """One file -> fact dict (see module docstring). Walks class/function
+    structure itself so every event carries the enclosing function and the
+    lexically-held lock tuple."""
+
+    def __init__(self, ctx: Any):
+        self.ctx = ctx
+        self.mod = module_path(ctx.relpath)
+        self.imports: Dict[str, str] = dict(ctx.imports)
+        self.locks: Dict[str, Dict[str, Any]] = {}
+        self.guards: Dict[str, Dict[str, Any]] = {}
+        self.guard_problems: List[Dict[str, Any]] = []
+        self.lock_returns: Dict[str, str] = {}
+        self.functions: Dict[str, Dict[str, Any]] = {}
+        self.classes: List[str] = []
+        # filled by the pre-scan so a method defined ABOVE __init__ still
+        # resolves `self._lock`
+        self._class_locks: Dict[str, Dict[str, str]] = {}
+        self._module_locks: Dict[str, str] = {}
+        self._class_guards: Dict[str, Dict[str, str]] = {}
+        self._module_guards: Dict[str, str] = {}
+
+    # -- entry -------------------------------------------------------------
+    def build(self, tree: ast.Module) -> Dict[str, Any]:
+        self._prescan(tree)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._build_class(node, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._function(node, f"{self.mod}.{node.name}", None)
+        self._resolve_guard_locks()
+        return {
+            "relpath": self.ctx.relpath,
+            "module": self.mod,
+            "full_module": full_module(self.ctx.relpath),
+            "classes": list(self.classes),
+            "locks": self.locks,
+            "guards": self.guards,
+            "guard_problems": self.guard_problems,
+            "lock_returns": self.lock_returns,
+            "functions": self.functions,
+        }
+
+    # -- pre-scan: lock + guard declarations -------------------------------
+    def _prescan(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                kind = _ctor_kind(getattr(node, "value", None), self.imports)
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if kind is not None:
+                        lock_id = f"{self.mod}.{t.id}"
+                        self.locks[lock_id] = {
+                            "kind": kind, "relpath": self.ctx.relpath,
+                            "line": node.lineno, "attr": t.id, "cls": None,
+                        }
+                        self._module_locks[t.id] = lock_id
+                    else:
+                        guard = self._guard_on(node)
+                        if guard is not None:
+                            key = f"{self.mod}.{t.id}"
+                            self._module_guards[t.id] = key
+                            self.guards[key] = {
+                                "lock_name": guard, "relpath": self.ctx.relpath,
+                                "line": node.lineno, "cls": None, "attr": t.id,
+                            }
+            elif isinstance(node, ast.ClassDef):
+                self._prescan_class(node, node.name)
+
+    def _build_class(self, cls: ast.ClassDef, name: str) -> None:
+        """Visit a class's methods (and recurse into NESTED classes —
+        `LocalRendezvous._Shared`-style holders own real locks too)."""
+        self.classes.append(name)
+        for sub in cls.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._function(sub, f"{self.mod}.{name}.{sub.name}", name)
+            elif isinstance(sub, ast.ClassDef):
+                self._build_class(sub, f"{name}.{sub.name}")
+
+    def _prescan_class(self, cls: ast.ClassDef, name: str) -> None:
+        for sub in cls.body:
+            if isinstance(sub, ast.ClassDef):
+                self._prescan_class(sub, f"{name}.{sub.name}")
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in ast.walk(method):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                for t in targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    kind = _ctor_kind(stmt.value, self.imports)
+                    if kind is not None:
+                        lock_id = f"{self.mod}.{name}.{t.attr}"
+                        self.locks[lock_id] = {
+                            "kind": kind, "relpath": self.ctx.relpath,
+                            "line": stmt.lineno, "attr": t.attr, "cls": name,
+                        }
+                        self._class_locks.setdefault(name, {})[t.attr] = lock_id
+                    elif method.name == "__init__":
+                        guard = self._guard_on(stmt)
+                        if guard is not None:
+                            key = f"{self.mod}.{name}.{t.attr}"
+                            self._class_guards.setdefault(name, {})[t.attr] = key
+                            self.guards[key] = {
+                                "lock_name": guard, "relpath": self.ctx.relpath,
+                                "line": stmt.lineno, "cls": name, "attr": t.attr,
+                            }
+
+    def _guard_on(self, node: ast.AST) -> Optional[str]:
+        lo = getattr(node, "lineno", None)
+        hi = getattr(node, "end_lineno", None) or lo
+        if lo is None:
+            return None
+        for ln in range(lo, hi + 1):
+            comment = self.ctx.comments.get(ln)
+            if comment:
+                guard = _parse_guard(comment)
+                if guard is not None:
+                    return guard
+        return None
+
+    def _resolve_guard_locks(self) -> None:
+        """Turn each guard's `lock_name` into a lock id; unresolvable names
+        become guard_problems (the rule reports them — a typo'd guarded-by
+        must not silently guard nothing)."""
+        for key, g in self.guards.items():
+            name = g.pop("lock_name")
+            attr = name[5:] if name.startswith("self.") else name
+            lock_id = None
+            if g["cls"] is not None:
+                lock_id = self._class_locks.get(g["cls"], {}).get(attr)
+            if lock_id is None:
+                lock_id = self._module_locks.get(attr)
+            if lock_id is None:
+                self.guard_problems.append(
+                    {
+                        "relpath": g["relpath"], "line": g["line"],
+                        "attr": g["attr"], "name": name,
+                    }
+                )
+            g["lock"] = lock_id
+
+    # -- helpers -----------------------------------------------------------
+    def _waived(self, node: ast.AST) -> List[str]:
+        return [tag for tag in _WAIVER_TAGS if self.ctx.waived(tag, node)]
+
+    def _lock_of_expr(self, expr: Optional[ast.AST], cls: Optional[str]) -> Optional[str]:
+        """Resolve an expression to a lock id when statically evident:
+        `self._lock` (class lock attr), a module-global lock name, or — for
+        non-self receivers — an attr that is a lock of exactly ONE class in
+        this file."""
+        if isinstance(expr, ast.Name):
+            return self._module_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" and cls:
+                hit = self._class_locks.get(cls, {}).get(expr.attr)
+                if hit:
+                    return hit
+            owners = [c for c, attrs in self._class_locks.items() if expr.attr in attrs]
+            if len(owners) == 1:
+                return self._class_locks[owners[0]][expr.attr]
+            if len(owners) > 1:
+                # `scope.lock` when several classes declare `lock`: the
+                # receiver name disambiguates (same hint rule as calls)
+                hinted = [c for c in owners if self._recv_hint(expr.value, c)]
+                if len(hinted) == 1:
+                    return self._class_locks[hinted[0]][expr.attr]
+        return None
+
+    def _target_spec(self, node: ast.Call, cls: Optional[str]) -> Optional[Dict[str, Any]]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return {"kind": "name", "tail": func.id, "name": self.imports.get(func.id, func.id)}
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            spec: Dict[str, Any] = {
+                "kind": "attr",
+                "tail": func.attr,
+                "dotted": _dotted(func, self.imports),
+            }
+            if isinstance(recv, ast.Name) and recv.id == "self" and cls is not None:
+                spec["self_cls"] = cls
+            hint = None
+            if isinstance(recv, ast.Attribute):
+                hint = recv.attr
+            elif isinstance(recv, ast.Name):
+                hint = recv.id
+            spec["recv_hint"] = hint
+            return spec
+        return None
+
+    # -- function bodies ---------------------------------------------------
+    def _function(self, fn: ast.AST, qual: str, cls: Optional[str]) -> None:
+        events: List[Dict[str, Any]] = []
+        self.functions[qual] = {
+            "relpath": self.ctx.relpath, "line": fn.lineno,
+            "cls": cls, "name": fn.name, "events": events,
+        }
+        # lock-returning helper: `return self._admission_lock`
+        for stmt in fn.body:
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                lock = self._lock_of_expr(stmt.value, cls)
+                if lock is not None:
+                    self.lock_returns[qual] = lock
+        self._scan_block(fn.body, qual, cls, held=(), region_waived=frozenset())
+
+    def _scan_block(
+        self, body: Sequence[ast.AST], qual: str, cls: Optional[str],
+        held: Tuple[HeldEntry, ...], region_waived: frozenset,
+    ) -> None:
+        for stmt in body:
+            self._scan_stmt(stmt, qual, cls, held, region_waived)
+
+    def _scan_stmt(
+        self, stmt: ast.AST, qual: str, cls: Optional[str],
+        held: Tuple[HeldEntry, ...], region_waived: frozenset,
+    ) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs when CALLED, not here — own function entry
+            # (thread targets, closures), resolvable as `<qual>.<name>`
+            self._function(stmt, f"{qual}.{stmt.name}", cls)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            # a waiver on the `with` header covers the whole critical
+            # section it opens — the reason describes the SECTION, so every
+            # event inside inherits it
+            inner_waived = region_waived | frozenset(self._waived(stmt))
+            for item in stmt.items:
+                lock = self._lock_of_expr(item.context_expr, cls)
+                if lock is not None:
+                    self._emit(qual, "acq", stmt, held=inner, lock=lock,
+                               waiver_node=stmt, region_waived=region_waived)
+                    inner = inner + (lock,)
+                    continue
+                # scan the header expr (calls/blocking/accesses inside it)
+                self._scan_expr(item.context_expr, qual, cls, inner, region_waived)
+                if isinstance(item.context_expr, ast.Call):
+                    spec = self._target_spec(item.context_expr, cls)
+                    if spec is not None:
+                        # `with self._ledger.admission():` — the helper's
+                        # returned lock is resolved at assembly; held-set
+                        # entries carry the spec until then
+                        self._emit(qual, "acq", stmt, held=inner, lock=None,
+                                   via_call=spec, waiver_node=stmt,
+                                   region_waived=region_waived)
+                        inner = inner + ({"call": spec},)
+            self._scan_block(stmt.body, qual, cls, inner, inner_waived)
+            return
+        for expr in self._stmt_exprs(stmt):
+            self._scan_expr(expr, qual, cls, held, region_waived)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                for node in ast.walk(t):
+                    self._maybe_access(node, qual, cls, held, "write", region_waived)
+        for block in self._stmt_blocks(stmt):
+            self._scan_block(block, qual, cls, held, region_waived)
+
+    @staticmethod
+    def _stmt_exprs(stmt: ast.AST) -> List[ast.AST]:
+        out = []
+        for field in ("value", "test", "iter", "exc", "msg", "cause"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, ast.AST):
+                out.append(v)
+        return out
+
+    @staticmethod
+    def _stmt_blocks(stmt: ast.AST) -> List[List[ast.AST]]:
+        out = []
+        for field in ("body", "orelse", "finalbody"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, list):
+                out.append(v)
+        for h in getattr(stmt, "handlers", None) or []:
+            out.append(h.body)
+        return out
+
+    # -- expressions: calls, blocking ops, guarded accesses ----------------
+    def _scan_expr(
+        self, expr: ast.AST, qual: str, cls: Optional[str],
+        held: Tuple[HeldEntry, ...], region_waived: frozenset,
+    ) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                self._call_event(node, qual, cls, held, region_waived)
+            else:
+                self._maybe_access(node, qual, cls, held, "read", region_waived)
+
+    def _maybe_access(
+        self, node: ast.AST, qual: str, cls: Optional[str],
+        held: Tuple[HeldEntry, ...], mode: str, region_waived: frozenset,
+    ) -> None:
+        key = None
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self" and cls:
+                key = self._class_guards.get(cls, {}).get(node.attr)
+            if key is None:
+                owners = [
+                    c for c, attrs in self._class_guards.items()
+                    if node.attr in attrs and c != cls
+                ]
+                if len(owners) == 1 and self._recv_hint(node.value, owners[0]):
+                    key = self._class_guards[owners[0]][node.attr]
+        elif isinstance(node, ast.Name):
+            key = self._module_guards.get(node.id)
+        if key is not None:
+            self._emit(qual, "access", node, held=held, guard=key, mode=mode,
+                       waiver_node=node, region_waived=region_waived)
+
+    @staticmethod
+    def _recv_hint(recv: ast.AST, cls_name: str) -> bool:
+        tail = None
+        if isinstance(recv, ast.Attribute):
+            tail = recv.attr
+        elif isinstance(recv, ast.Name):
+            tail = recv.id
+        if not tail:
+            return False
+        t = tail.strip("_").lower().replace("_", "")
+        return bool(t) and t in cls_name.lower()
+
+    def _call_event(
+        self, node: ast.Call, qual: str, cls: Optional[str],
+        held: Tuple[HeldEntry, ...], region_waived: frozenset,
+    ) -> None:
+        dotted = _dotted(node.func, self.imports)
+        tail = None
+        recv: Optional[ast.AST] = None
+        if isinstance(node.func, ast.Attribute):
+            tail = node.func.attr
+            recv = node.func.value
+        elif isinstance(node.func, ast.Name):
+            tail = node.func.id
+        block = self._block_op(node, dotted, tail, recv, cls)
+        if block is not None:
+            self._emit(qual, "block", node, held=held, waiver_node=node,
+                       region_waived=region_waived, **block)
+        spec = self._target_spec(node, cls)
+        if spec is not None:
+            self._emit(qual, "call", node, held=held, target=spec,
+                       waiver_node=node, region_waived=region_waived)
+
+    def _block_op(
+        self, node: ast.Call, dotted: Optional[str], tail: Optional[str],
+        recv: Optional[ast.AST], cls: Optional[str],
+    ) -> Optional[Dict[str, Any]]:
+        if dotted == "time.sleep":
+            return {"op": "time.sleep()"}
+        if tail == "block_until_ready" or dotted == "jax.block_until_ready":
+            return {"op": "block_until_ready() (device sync)"}
+        if dotted == "jax.device_get":
+            return {"op": "jax.device_get() (host fetch)"}
+        if tail == "item" and not node.args and not node.keywords:
+            return {"op": ".item() (host fetch)"}
+        if tail == "wait":
+            recv_lock = self._lock_of_expr(recv, cls)
+            return {"op": ".wait() (event/condition wait)", "recv_lock": recv_lock}
+        if tail in _RENDEZVOUS_TAILS:
+            return {"op": f".{tail}() (rendezvous round)"}
+        if tail == "join" and dotted is not None and "thread" in dotted.lower():
+            return {"op": ".join() (thread join)"}
+        if tail == "result":
+            return {"op": ".result() (future wait)"}
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+            and "open" not in self.imports
+        ):
+            return {"op": "open() (file I/O)"}
+        if dotted in _NETWORK_CALLS or (
+            dotted is not None and dotted.startswith(("requests.", "subprocess."))
+        ):
+            return {"op": f"{dotted}() (network/subprocess)"}
+        return None
+
+    def _emit(
+        self, qual: str, t: str, node: ast.AST, *,
+        held: Tuple[HeldEntry, ...], waiver_node: ast.AST,
+        region_waived: frozenset = frozenset(), **fields: Any,
+    ) -> None:
+        ev = {
+            "t": t,
+            "line": getattr(node, "lineno", 1),
+            "col": getattr(node, "col_offset", 0) + 1,
+            "held": list(held),
+            "waived": sorted(set(self._waived(waiver_node)) | region_waived),
+        }
+        ev.update(fields)
+        self.functions[qual]["events"].append(ev)
+
+
+def extract_facts(ctx: Any) -> Optional[Dict[str, Any]]:
+    """File facts for the whole-program pass; None for unparsable files (the
+    syntax-error finding already fails the gate)."""
+    if ctx.tree is None:
+        return None
+    return _FactsBuilder(ctx).build(ctx.tree)
+
+
+# --------------------------------------------------------------- assembly ---
+
+
+class Program:
+    """Every file's facts assembled into one model + the fixpoints
+    (module docstring). Rebuilt each run from (possibly cached) facts —
+    assembly is linear in the fact count and costs milliseconds."""
+
+    def __init__(self, facts_by_file: Dict[str, Optional[Dict[str, Any]]]):
+        self.files: Dict[str, Dict[str, Any]] = {
+            rel: f for rel, f in facts_by_file.items() if f is not None
+        }
+        self.locks: Dict[str, Dict[str, Any]] = {}
+        self.guards: Dict[str, Dict[str, Any]] = {}
+        self.guard_problems: List[Dict[str, Any]] = []
+        self.lock_returns: Dict[str, str] = {}
+        self.functions: Dict[str, Dict[str, Any]] = {}
+        self._method_index: Dict[str, List[str]] = {}
+        self._module_by_full: Dict[str, str] = {}
+        self._class_index: Dict[str, List[str]] = {}
+        for f in self.files.values():
+            self.locks.update(f["locks"])
+            self.guards.update(f["guards"])
+            self.guard_problems.extend(f["guard_problems"])
+            self.lock_returns.update(f["lock_returns"])
+            self.functions.update(f["functions"])
+            self._module_by_full[f["full_module"]] = f["module"]
+            for c in f["classes"]:
+                self._class_index.setdefault(c, []).append(f["module"])
+        for qual, fn in self.functions.items():
+            if fn["cls"] is not None:
+                self._method_index.setdefault(fn["name"], []).append(qual)
+        self._resolve_all()
+        self._trans_acq: Optional[Dict[str, Dict[str, Any]]] = None
+        self._may_blk: Optional[Dict[str, Dict[str, Any]]] = None
+        self._entry_held: Optional[Dict[str, Set[str]]] = None
+
+    # -- call resolution ---------------------------------------------------
+    def _module_of_dotted_head(self, head: str) -> Optional[str]:
+        """Match a dotted import origin against known modules by suffix —
+        `scheduler.ledger`, `ledger`, and the full import path all hit."""
+        for full, mod in self._module_by_full.items():
+            if full == head or full.endswith("." + head):
+                return mod
+        for f in self.files.values():
+            if f["module"] == head or f["module"].endswith("." + head):
+                return f["module"]
+        return None
+
+    def _resolve_target(self, caller_qual: str, spec: Dict[str, Any]) -> Optional[str]:
+        tail = spec["tail"]
+        mod = self.functions[caller_qual]["relpath"]
+        mod = module_path(mod)
+        if spec["kind"] == "name":
+            name = spec["name"]
+            nested = f"{caller_qual}.{name}"
+            if nested in self.functions:
+                return nested
+            local = f"{mod}.{name}"
+            if local in self.functions:
+                return local
+            if name in self._class_index and len(self._class_index[name]) == 1:
+                init = f"{self._class_index[name][0]}.{name}.__init__"
+                return init if init in self.functions else None
+            if "." in name:
+                head, _, f_name = name.rpartition(".")
+                owner = self._module_of_dotted_head(head)
+                if owner is not None:
+                    cand = f"{owner}.{f_name}"
+                    if cand in self.functions:
+                        return cand
+                    init = f"{owner}.{f_name}.__init__"
+                    if init in self.functions:
+                        return init
+            return None
+        if spec.get("self_cls"):
+            cand = f"{mod}.{spec['self_cls']}.{tail}"
+            if cand in self.functions:
+                return cand
+        dotted = spec.get("dotted")
+        if dotted and "." in dotted:
+            head, _, f_name = dotted.rpartition(".")
+            owner = self._module_of_dotted_head(head)
+            if owner is not None:
+                cand = f"{owner}.{f_name}"
+                if cand in self.functions:
+                    return cand
+        candidates = self._method_index.get(tail, [])
+        if not candidates:
+            return None
+        hint = spec.get("recv_hint")
+        if len(candidates) > 1 or tail in _COMMON_METHOD_TAILS:
+            if hint is None:
+                return None
+            hinted = [
+                q for q in candidates
+                if self._hint_matches(hint, self.functions[q]["cls"])
+            ]
+            return hinted[0] if len(hinted) == 1 else None
+        return candidates[0]
+
+    @staticmethod
+    def _hint_matches(hint: str, cls_name: Optional[str]) -> bool:
+        if not cls_name:
+            return False
+        t = hint.strip("_").lower().replace("_", "")
+        return bool(t) and t in cls_name.lower()
+
+    def _resolve_all(self) -> None:
+        for qual, fn in self.functions.items():
+            for ev in fn["events"]:
+                if ev["t"] == "call":
+                    callee = self._resolve_target(qual, ev["target"])
+                    ev["callee"] = callee
+                    if callee is not None and callee in self.lock_returns:
+                        ev["returns_lock"] = self.lock_returns[callee]
+                elif ev["t"] == "acq" and ev.get("lock") is None and ev.get("via_call"):
+                    callee = self._resolve_target(qual, ev["via_call"])
+                    if callee is not None and callee in self.lock_returns:
+                        ev["lock"] = self.lock_returns[callee]
+        # normalize held-set entries: unresolved `with helper():` specs
+        # become lock ids (or drop when the helper is unknown)
+        for qual, fn in self.functions.items():
+            for ev in fn["events"]:
+                normalized: List[str] = []
+                for entry in ev["held"]:
+                    if isinstance(entry, str):
+                        normalized.append(entry)
+                        continue
+                    callee = self._resolve_target(qual, entry["call"])
+                    if callee is not None and callee in self.lock_returns:
+                        normalized.append(self.lock_returns[callee])
+                ev["held"] = normalized
+
+    # -- fixpoints ---------------------------------------------------------
+    def trans_acquires(self) -> Dict[str, Dict[str, Any]]:
+        """qual -> {lock_id: {"site": (relpath, line), "chain": [quals]}} —
+        every lock the function may acquire through any resolved path.
+        Acquisitions waived `# lock-order-ok` do not propagate (the waiver
+        covers the edges that acquisition creates)."""
+        if self._trans_acq is not None:
+            return self._trans_acq
+        acq: Dict[str, Dict[str, Any]] = {q: {} for q in self.functions}
+        for qual, fn in self.functions.items():
+            for ev in fn["events"]:
+                lock = None
+                if ev["t"] == "acq":
+                    lock = ev.get("lock")
+                elif ev["t"] == "call" and ev.get("returns_lock"):
+                    lock = ev["returns_lock"]
+                if lock is not None and lock not in acq[qual]:
+                    acq[qual][lock] = {
+                        "site": [fn["relpath"], ev["line"]],
+                        "chain": [qual],
+                        "waived": "lock-order" in ev.get("waived", []),
+                    }
+        changed = True
+        while changed:
+            changed = False
+            for qual, fn in self.functions.items():
+                for ev in fn["events"]:
+                    if ev["t"] != "call" or not ev.get("callee"):
+                        continue
+                    for lock, info in acq.get(ev["callee"], {}).items():
+                        if lock not in acq[qual]:
+                            acq[qual][lock] = {
+                                "site": info["site"],
+                                "chain": [qual] + info["chain"],
+                                "waived": info["waived"],
+                            }
+                            changed = True
+        self._trans_acq = acq
+        return acq
+
+    def may_block(self) -> Dict[str, Dict[str, Any]]:
+        """qual -> {op: {"site", "chain", "recv_lock", "waived"}} — blocking
+        operations reachable from the function through resolved calls."""
+        if self._may_blk is not None:
+            return self._may_blk
+        blk: Dict[str, Dict[str, Any]] = {q: {} for q in self.functions}
+        for qual, fn in self.functions.items():
+            for ev in fn["events"]:
+                if ev["t"] != "block":
+                    continue
+                key = ev["op"]
+                if key not in blk[qual]:
+                    blk[qual][key] = {
+                        "site": [fn["relpath"], ev["line"]],
+                        "chain": [qual],
+                        "recv_lock": ev.get("recv_lock"),
+                        "waived": "held" in ev.get("waived", []),
+                    }
+        changed = True
+        while changed:
+            changed = False
+            for qual, fn in self.functions.items():
+                for ev in fn["events"]:
+                    if ev["t"] != "call" or not ev.get("callee"):
+                        continue
+                    for op, info in blk.get(ev["callee"], {}).items():
+                        if op not in blk[qual]:
+                            blk[qual][op] = {
+                                "site": info["site"],
+                                "chain": [qual] + info["chain"],
+                                "recv_lock": info.get("recv_lock"),
+                                "waived": info.get("waived", False),
+                            }
+                            changed = True
+        self._may_blk = blk
+        return blk
+
+    def entry_held(self) -> Dict[str, Set[str]]:
+        """qual -> locks held at EVERY resolved in-program call site
+        (intersection). Functions with no resolved caller hold nothing on
+        entry — public APIs must do their own locking."""
+        if self._entry_held is not None:
+            return self._entry_held
+        callers: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+        for qual, fn in self.functions.items():
+            for ev in fn["events"]:
+                if ev["t"] == "call" and ev.get("callee"):
+                    callers.setdefault(ev["callee"], []).append((qual, tuple(ev["held"])))
+        held: Dict[str, Set[str]] = {q: set() for q in self.functions}
+        # fixpoint from ∅ so the intersection only ever PROVES locks held,
+        # never assumes them
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for callee, sites in callers.items():
+                new: Optional[Set[str]] = None
+                for caller, lex in sites:
+                    site_held = set(lex) | held.get(caller, set())
+                    new = site_held if new is None else (new & site_held)
+                new = new or set()
+                if new != held.get(callee, set()):
+                    held[callee] = new
+                    changed = True
+            if not changed:
+                break
+        self._entry_held = held
+        return held
+
+    def lock_kind(self, lock_id: str) -> str:
+        return self.locks.get(lock_id, {}).get("kind", "lock")
+
+
+def build_program(facts_by_file: Dict[str, Optional[Dict[str, Any]]]) -> Program:
+    return Program(facts_by_file)

@@ -10,6 +10,7 @@ from typing import List
 
 from ..engine import RuleBase
 from .blocking import BlockingRule
+from .concurrency import BlockingUnderLockRule, GuardDisciplineRule, LockOrderRule
 from .distance import RawDistanceRule
 from .exporter import ExporterScopeRule
 from .hostsync import HostSyncRule
@@ -46,6 +47,10 @@ def default_rules() -> List[RuleBase]:
         ExporterScopeRule(),
         ConfigKeyRule(),
         MetricNameRule(),
+        # --- whole-program concurrency rules (pass-2 over program.py) ----
+        LockOrderRule(),
+        BlockingUnderLockRule(),
+        GuardDisciplineRule(),
     ]
     # the hygiene waiver-form check must know every tag the catalog uses
     tags = {r.waiver for r in rules if r.waiver}
@@ -72,4 +77,7 @@ __all__ = [
     "ExporterScopeRule",
     "ConfigKeyRule",
     "MetricNameRule",
+    "LockOrderRule",
+    "BlockingUnderLockRule",
+    "GuardDisciplineRule",
 ]

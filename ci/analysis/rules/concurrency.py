@@ -1,0 +1,356 @@
+#
+# Interprocedural concurrency rules over the pass-1 whole-program model
+# (ci/analysis/program.py). Three invariants, all cross-file — the class of
+# bug the per-file PR-9 rules could not see (docs/robustness.md "Threading
+# model"):
+#
+#   lock-order            the static lock-acquisition graph (which named
+#                         locks can be acquired while which others are held,
+#                         following resolved calls across files) must be
+#                         acyclic; a cycle is a latent deadlock between the
+#                         paths that realize its edges. Re-entrant
+#                         re-acquisition of an RLock/Condition is not an
+#                         edge; re-acquiring a plain Lock while held is an
+#                         immediate self-deadlock finding.
+#   blocking-under-lock   a held lock's critical section must not reach a
+#                         blocking operation — a rendezvous round,
+#                         `block_until_ready`/host fetch, `.wait()` on
+#                         anything but the held condition itself,
+#                         `time.sleep`, file/network I/O, a future `.result`
+#                         or thread join — directly or through any resolved
+#                         call chain. The deadlock-and-tail-latency factory:
+#                         every other thread needing that lock waits out the
+#                         blocked section.
+#   guard-discipline      a field declared `# guarded-by: <lock>` on its
+#                         `__init__` (or module-global) assignment may only
+#                         be read/written with that lock held — lexically,
+#                         or because every resolved in-program call site of
+#                         the enclosing function holds it (how `_locked`-
+#                         suffixed helpers are proven safe).
+#
+# The runtime twin (spark_rapids_ml_tpu/utils/lockcheck.py, SRML_LOCKCHECK=1)
+# validates the same order graph under real contention at test time: the
+# static pass proposes, the sanitizer verifies.
+#
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..engine import FileContext, Finding, RuleBase, Run
+
+
+def _fmt_chain(chain: List[str]) -> str:
+    return " -> ".join(q.rsplit(".", 1)[-1] + "()" for q in chain)
+
+
+class _ProgramRule(RuleBase):
+    """Shared base: these rules run entirely in `finalize` over
+    `run.program`; per-file traversal happens in pass 1."""
+
+    tree_scope = ("spark_rapids_ml_tpu",)
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        pass  # pass-1 facts carry everything; nothing to do per file
+
+
+class LockOrderRule(_ProgramRule):
+    id = "lock-order"
+    waiver = "lock-order"
+    description = (
+        "cycles in the static lock-acquisition graph (lock B acquired while "
+        "A held, across resolved call chains) — a latent deadlock"
+    )
+
+    def finalize(self, run: Run) -> List[Finding]:
+        program = getattr(run, "program", None)
+        if program is None:
+            return []
+        trans = program.trans_acquires()
+        # edge (a, b): lock b acquired while a held; keep the first
+        # (deterministic, shallowest-chain) witness per edge
+        edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        findings: List[Finding] = []
+
+        def note_edge(a: str, b: str, relpath: str, line: int, col: int,
+                      via: Optional[List[str]], acq_site: List[Any]) -> None:
+            if a == b:
+                if program.lock_kind(a) in ("rlock", "condition"):
+                    return  # re-entrant by construction: not an edge
+                findings.append(
+                    Finding(
+                        relpath, line, col, self.id,
+                        f"non-reentrant Lock `{a}` can be re-acquired while "
+                        "already held"
+                        + (f" (via {_fmt_chain(via)})" if via and len(via) > 1 else "")
+                        + " — a guaranteed self-deadlock on that path; use an "
+                        "RLock or drop the inner acquisition, or mark "
+                        "`# lock-order-ok: <reason>`",
+                    )
+                )
+                return
+            key = (a, b)
+            if key not in edges:
+                edges[key] = {
+                    "relpath": relpath, "line": line, "col": col,
+                    "via": via, "acq_site": acq_site,
+                }
+
+        for qual, fn in program.functions.items():
+            for ev in fn["events"]:
+                if "lock-order" in ev.get("waived", []):
+                    continue
+                held = ev.get("held", [])
+                if not held:
+                    continue
+                if ev["t"] == "acq" and ev.get("lock"):
+                    for h in held:
+                        note_edge(h, ev["lock"], fn["relpath"], ev["line"],
+                                  ev["col"], None, [fn["relpath"], ev["line"]])
+                elif ev["t"] == "call" and ev.get("callee"):
+                    for lock, info in trans.get(ev["callee"], {}).items():
+                        if info.get("waived"):
+                            continue
+                        for h in held:
+                            note_edge(h, lock, fn["relpath"], ev["line"],
+                                      ev["col"], [qual] + info["chain"],
+                                      info["site"])
+
+        findings.extend(self._cycles(edges))
+        return findings
+
+    def _cycles(self, edges: Dict[Tuple[str, str], Dict[str, Any]]) -> List[Finding]:
+        graph: Dict[str, List[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, []).append(b)
+            graph.setdefault(b, [])
+        # iterative Tarjan SCC
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(root: str) -> None:
+            work = [(root, iter(graph[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for succ in it:
+                    if succ not in index:
+                        index[succ] = low[succ] = counter[0]
+                        counter[0] += 1
+                        stack.append(succ)
+                        on_stack.add(succ)
+                        work.append((succ, iter(graph[succ])))
+                        advanced = True
+                        break
+                    if succ in on_stack:
+                        low[node] = min(low[node], index[succ])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(sorted(scc))
+
+        for node in sorted(graph):
+            if node not in index:
+                strongconnect(node)
+
+        out: List[Finding] = []
+        for scc in sorted(sccs):
+            cycle = self._extract_cycle(scc, graph)
+            parts = []
+            for a, b in zip(cycle, cycle[1:]):
+                e = edges[(a, b)]
+                via = f" via {_fmt_chain(e['via'])}" if e.get("via") else ""
+                parts.append(f"`{b}` at {e['relpath']}:{e['line']}{via} (while `{a}` held)")
+            rep = min(
+                (edges[(a, b)] for a, b in zip(cycle, cycle[1:])),
+                key=lambda e: (e["relpath"], e["line"], e["col"]),
+            )
+            out.append(
+                Finding(
+                    rep["relpath"], rep["line"], rep["col"], self.id,
+                    "lock-order cycle — these acquisition paths can deadlock "
+                    "against each other: " + "; ".join(parts) + ". Acquire in "
+                    "one global order (docs/robustness.md \"Threading "
+                    "model\"), or mark the safe edge "
+                    "`# lock-order-ok: <reason>`",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _extract_cycle(scc: List[str], graph: Dict[str, List[str]]) -> List[str]:
+        """One concrete cycle through the SCC as [n0, ..., n0]: a BFS from
+        `start`'s successors back to `start`, restricted to SCC members —
+        every consecutive pair is a REAL edge (a greedy walk could dead-end
+        and fabricate a closing edge that was never recorded)."""
+        members = set(scc)
+        start = scc[0]
+        parent: Dict[str, Optional[str]] = {}
+        frontier = []
+        for succ in sorted(graph[start]):
+            if succ in members and succ not in parent:
+                parent[succ] = None
+                frontier.append(succ)
+        while frontier:
+            nxt = []
+            for node in frontier:
+                if node == start:
+                    continue
+                for succ in sorted(graph[node]):
+                    if succ == start and start not in parent:
+                        parent[start] = node
+                    elif succ in members and succ not in parent:
+                        parent[succ] = node
+                        nxt.append(succ)
+            if start in parent:
+                break
+            frontier = nxt
+        # start is reachable from its own successor set by SCC definition
+        path = [start]
+        node = parent[start]
+        while node is not None:
+            path.append(node)
+            node = parent[node]
+        path.append(start)
+        path.reverse()
+        return path
+
+
+class BlockingUnderLockRule(_ProgramRule):
+    id = "blocking-under-lock"
+    waiver = "held"
+    description = (
+        "a blocking operation (rendezvous round, device sync/host fetch, "
+        "foreign .wait(), time.sleep, file/network I/O, future/thread join) "
+        "reachable while a lock is held"
+    )
+
+    _MAX_OPS_NAMED = 3
+
+    def finalize(self, run: Run) -> List[Finding]:
+        program = getattr(run, "program", None)
+        if program is None:
+            return []
+        may_block = program.may_block()
+        out: List[Finding] = []
+        for qual, fn in program.functions.items():
+            for ev in fn["events"]:
+                held = ev.get("held", [])
+                if not held or "held" in ev.get("waived", []):
+                    continue
+                if ev["t"] == "block":
+                    recv = ev.get("recv_lock")
+                    if recv is not None and recv in held:
+                        continue  # waiting on the held condition: sanctioned
+                    out.append(
+                        Finding(
+                            fn["relpath"], ev["line"], ev["col"], self.id,
+                            f"{ev['op']} while holding {self._locks(held)} — "
+                            "every thread needing the lock waits out this "
+                            "blocking call (deadlock/tail-latency factory); "
+                            "narrow the critical section, or mark "
+                            "`# held-ok: <reason>`",
+                        )
+                    )
+                elif ev["t"] == "call" and ev.get("callee"):
+                    ops = []
+                    for op, info in sorted(may_block.get(ev["callee"], {}).items()):
+                        if info.get("waived"):
+                            continue
+                        recv = info.get("recv_lock")
+                        if recv is not None and recv in held:
+                            continue
+                        site = info["site"]
+                        ops.append(
+                            f"{op} at {site[0]}:{site[1]} via "
+                            f"{_fmt_chain([qual] + info['chain'])}"
+                        )
+                    if ops:
+                        named = "; ".join(ops[: self._MAX_OPS_NAMED])
+                        more = len(ops) - self._MAX_OPS_NAMED
+                        if more > 0:
+                            named += f" (+{more} more)"
+                        out.append(
+                            Finding(
+                                fn["relpath"], ev["line"], ev["col"], self.id,
+                                f"call reaches a blocking operation while "
+                                f"holding {self._locks(held)}: {named} — "
+                                "narrow the critical section or hoist the "
+                                "call out of it, or mark "
+                                "`# held-ok: <reason>`",
+                            )
+                        )
+        return out
+
+    @staticmethod
+    def _locks(held: List[str]) -> str:
+        return ", ".join(f"`{h}`" for h in held)
+
+
+class GuardDisciplineRule(_ProgramRule):
+    id = "guard-discipline"
+    waiver = "guard"
+    description = (
+        "fields declared `# guarded-by: <lock>` read/written without that "
+        "lock held (lexically or via every resolved call site)"
+    )
+
+    def finalize(self, run: Run) -> List[Finding]:
+        program = getattr(run, "program", None)
+        if program is None:
+            return []
+        out: List[Finding] = []
+        for p in program.guard_problems:
+            out.append(
+                Finding(
+                    p["relpath"], p["line"], 1, self.id,
+                    f"`# guarded-by: {p['name']}` on field `{p['attr']}` "
+                    "names no lock declared in this class/module — a typo'd "
+                    "guard protects nothing",
+                )
+            )
+        entry_held = program.entry_held()
+        for qual, fn in program.functions.items():
+            for ev in fn["events"]:
+                if ev["t"] != "access" or "guard" in ev.get("waived", []):
+                    continue
+                g = program.guards.get(ev["guard"])
+                if g is None or g.get("lock") is None:
+                    continue
+                if fn["name"] == "__init__" and fn["cls"] == g["cls"]:
+                    continue  # construction happens-before publication
+                held = set(ev.get("held", [])) | entry_held.get(qual, set())
+                if g["lock"] in held:
+                    continue
+                out.append(
+                    Finding(
+                        fn["relpath"], ev["line"], ev["col"], self.id,
+                        f"field `{g['attr']}` is `# guarded-by` "
+                        f"`{g['lock']}` ({g['relpath']}:{g['line']}) but is "
+                        f"{'written' if ev['mode'] == 'write' else 'read'} "
+                        f"here without it (in `{qual}`) — hold the lock, "
+                        "prove every call site holds it, or mark "
+                        "`# guard-ok: <reason>`",
+                    )
+                )
+        return out

@@ -29,6 +29,9 @@ KNOWN_WAIVER_TAGS = {
     "serve",
     "ledger",
     "exporter",
+    "lock-order",
+    "held",
+    "guard",
 }
 
 

@@ -102,6 +102,15 @@ class ConfigKeyRule(RuleBase):
                     (key_node.value, ctx.relpath, node.lineno, node.col_offset + 1)
                 )
 
+    # content-hash cache hooks (engine.RuleBase): the per-file usage slice
+    # is stored on a miss and replayed on a hit, so cache-skipped files
+    # still feed the cross-file registry check in finalize
+    def file_state(self, relpath: str):
+        return [list(u) for u in self.usages if u[1] == relpath]
+
+    def restore_state(self, relpath: str, state) -> None:
+        self.usages.extend(tuple(u) for u in state)
+
     def finalize(self, run: Run) -> List[Finding]:
         out: List[Finding] = []
         schema = run.sources.config_schema_keys
@@ -213,6 +222,13 @@ class MetricNameRule(RuleBase):
                 inner = dotted(node.args[0], ctx.imports)
                 if inner and inner.split(".")[-1] in _CONVERGENCE_FUNCS:
                     self._collect(node.args[1], node, ctx)
+
+    # cache hooks — same contract as ConfigKeyRule.file_state above
+    def file_state(self, relpath: str):
+        return [list(u) for u in self.usages if u[1] == relpath]
+
+    def restore_state(self, relpath: str, state) -> None:
+        self.usages.extend(tuple(u) for u in state)
 
     def finalize(self, run: Run) -> List[Finding]:
         docs = run.sources.metric_docs_text

@@ -14,8 +14,10 @@ cd "$(dirname "$0")/.."
 ARTIFACTS="${CI_ARTIFACT_DIR:-/tmp/srml_ci_artifacts}"
 mkdir -p "$ARTIFACTS"
 
-echo "== static analysis (AST lint: ci/analysis — compile, invariants, registries, imports)"
-python -m ci.analysis --json-out "$ARTIFACTS/analysis_verdict.json"
+echo "== static analysis (AST lint: ci/analysis — compile, invariants, registries, lock discipline, imports)"
+# the gate prints its own wall time against --time-budget; the verdict JSON
+# (incl. wall_s + cache hit count) lands next to the regression verdict
+python -m ci.analysis --json-out "$ARTIFACTS/analysis_verdict.json" --time-budget 60
 
 echo "== perf regression gate (report-only against the checked-in BENCH trajectory)"
 python -m benchmark.regression --report-only --out "$ARTIFACTS/regression_verdict.json"
@@ -25,6 +27,18 @@ python -m benchmark.opsreport --json --write "$ARTIFACTS/ops_snapshot.json" > /d
 
 echo "== chaos smoke (kill one rank mid-solve; survivors must recover + post-mortem must name it)"
 python ci/chaos_smoke.py
+
+echo "== concurrency sanitizer lanes (SRML_LOCKCHECK=1 over the threaded families; report archived)"
+SRML_LOCKCHECK=1 SRML_LOCKCHECK_REPORT="$ARTIFACTS/lockcheck_report.json" \
+  python -m pytest tests/test_chaos.py tests/test_scheduler.py tests/test_serving.py \
+    tests/test_ops_plane.py tests/test_lockcheck.py -q
+python - "$ARTIFACTS/lockcheck_report.json" <<'PY'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+print(f"lockcheck: {len(rep['locks'])} locks, {len(rep['edges'])} edges, "
+      f"{len(rep['inversions'])} inversion(s), {len(rep['long_holds'])} long hold(s)")
+sys.exit(1 if rep["inversions"] else 0)  # zero-inversion acceptance gate
+PY
 
 if [[ "${1:-}" == "--nightly" ]]; then
   echo "== nightly: full suite incl. large-scale slow tests"

@@ -37,6 +37,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .utils import lockcheck
+
 __all__ = [
     "SolverCheckpoint",
     "CheckpointStore",
@@ -77,8 +79,8 @@ class CheckpointStore:
     assert resume-from-checkpoint rather than re-solve-from-scratch."""
 
     def __init__(self) -> None:
-        self._entries: Dict[str, SolverCheckpoint] = {}
-        self._lock = threading.Lock()
+        self._entries: Dict[str, SolverCheckpoint] = {}  # guarded-by: _lock
+        self._lock = lockcheck.make_lock("checkpoint.CheckpointStore._lock")
 
     def save(self, key: str, ckpt: SolverCheckpoint) -> None:
         from . import diagnostics, telemetry

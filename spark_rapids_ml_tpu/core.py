@@ -33,7 +33,17 @@ import numpy as np
 
 from .data import ExtractedData, as_pandas, extract_dataset, vectors_to_pandas_column
 from .params import Param, Params, _TpuParams
-from .utils import get_logger
+from .utils import get_logger, lockcheck
+
+
+def _env_float(name: str, default: float) -> float:
+    """Env-seeded float config value; a typo'd value falls back to the
+    default instead of crashing package import (audit._capacity precedent)."""
+    try:
+        return float(os.environ.get(name) or default)
+    except ValueError:
+        return default
+
 
 # Global framework configuration — the analog of the reference's Spark-conf tier
 # (`spark.sql.execution.arrow.maxRecordsPerBatch`, `spark.rapids.ml.uvm.enabled`;
@@ -173,6 +183,15 @@ config: Dict[str, Any] = {
     # headless-run analog of the SRML_METRICS_PORT scrape surface; seeded
     # from SRML_OPS_SNAPSHOT_DIR. None -> no files.
     "ops_snapshot_dir": os.environ.get("SRML_OPS_SNAPSHOT_DIR") or None,
+    # --- runtime lock-order sanitizer (docs/robustness.md "Threading
+    # model") -------------------------------------------------------------
+    # hold duration (ms) above which the SRML_LOCKCHECK=1 sanitizer records
+    # a `lockcheck.long_hold` violation for a framework lock — the runtime
+    # face of the static blocking-under-lock rule. Seeded from
+    # SRML_LOCKCHECK_LONG_HOLD_MS; only read while the sanitizer is on. A
+    # typo'd value falls back to the default — it must not crash package
+    # import (utils.lockcheck.long_hold_threshold_s guards the same way).
+    "lockcheck_long_hold_ms": _env_float("SRML_LOCKCHECK_LONG_HOLD_MS", 500.0),
 }
 
 def evaluator_label_column(params_obj: Any, evaluator: Any) -> str:
@@ -562,8 +581,8 @@ class DeviceDatasetScope:
     __slots__ = ("cache", "lock", "last")
 
     def __init__(self) -> None:
-        self.cache: Dict[tuple, DeviceDataset] = {}
-        self.lock = threading.Lock()
+        self.cache: Dict[tuple, DeviceDataset] = {}  # guarded-by: lock
+        self.lock = lockcheck.make_lock("core.DeviceDatasetScope.lock")
         self.last: Optional[DeviceDataset] = None
 
 
@@ -988,8 +1007,9 @@ class _TpuCaller(_TpuCommon):
                 extracted, ctx, stage_logger, force_stream, attempt=attempt
             )
         key = self._device_dataset_key(dataset, ctx)
-        with scope.lock:  # one builder per scope: a cache-miss build is
-            # never duplicated by a concurrent fit sharing the scope
+        # one builder per scope: a cache-miss build is never duplicated by a
+        # concurrent fit sharing the scope
+        with scope.lock:  # held-ok: the only rendezvous reachable below (partition build allgather) is SPMD-only, and SPMD fits returned above this lock; the scope is context-local besides
             dds = scope.cache.get(key)
             if dds is not None:
                 scope.cache[key] = scope.cache.pop(key)  # LRU: move to newest
@@ -1504,21 +1524,42 @@ class _FitMultipleIterator:
     def __init__(self, fitMultipleModels: Callable[[], List["_TpuModel"]], numModels: int):
         self.fitMultipleModels = fitMultipleModels
         self.numModels = numModels
-        self.counter = 0
-        self.lock = threading.Lock()
+        self.counter = 0  # guarded-by: lock
+        self.lock = lockcheck.make_lock("core._FitMultipleIterator.lock")
+        # written once by the index-0 claimant, then published through
+        # `_materialized`; readers wait on the event, never the lock
         self.models: Optional[List["_TpuModel"]] = None
+        self._materialized = threading.Event()
+        self._fit_error: Optional[BaseException] = None
 
     def __iter__(self) -> Iterator[Tuple[int, "_TpuModel"]]:
         return self
 
     def __next__(self) -> Tuple[int, "_TpuModel"]:
+        # the lock covers ONLY index claiming: the single fit pass used to
+        # run inside it, which held the iterator lock across rendezvous
+        # rounds and sink I/O (ci/analysis `blocking-under-lock`) — every
+        # concurrent consumer was blocked on the MUTEX instead of on the
+        # models being ready
         with self.lock:
             index = self.counter
             if index >= self.numModels:
                 raise StopIteration()
             self.counter += 1
-            if self.models is None:
+        if index == 0:
+            try:
                 self.models = self.fitMultipleModels()
+            except BaseException as e:
+                self._fit_error = e
+                raise
+            finally:
+                self._materialized.set()
+        else:
+            self._materialized.wait()  # blocking-ok: bounded by the claimant's fit, which owns the retry/rendezvous deadlines (core.retryable_stage)
+            if self._fit_error is not None:
+                raise RuntimeError(
+                    "the fit pass materializing this iterator's models failed"
+                ) from self._fit_error
         return index, self.models[index]
 
     next = __next__
@@ -1687,8 +1728,8 @@ class _TpuModel(_TpuCommon):
 
 # Process-wide record of bucketed shapes already handed to a `predict`
 # program (see `_TpuModel._record_bucket`).
-_BUCKET_LOCK = threading.Lock()
-_BUCKET_SHAPES: set = set()
+_BUCKET_LOCK = lockcheck.make_lock("core._BUCKET_LOCK")
+_BUCKET_SHAPES: set = set()  # guarded-by: _BUCKET_LOCK
 
 
 class PredictProgram:

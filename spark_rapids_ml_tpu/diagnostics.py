@@ -55,6 +55,8 @@ import time
 import uuid
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from .utils import lockcheck
+
 __all__ = [
     "trace_scope",
     "current_trace",
@@ -225,11 +227,11 @@ class FlightRecorder:
             enabled = os.environ.get("SRML_FLIGHTREC", "1") not in ("0", "false", "off")
         self.capacity = max(1, int(capacity))
         self.enabled = bool(enabled)
-        self._lock = threading.Lock()
-        self._buf: List[Optional[Dict[str, Any]]] = [None] * self.capacity
-        self._next = 0  # next slot to write
-        self._total = 0  # events ever recorded
-        self._dropped = 0  # events overwritten (total - retained)
+        self._lock = lockcheck.make_lock("diagnostics.FlightRecorder._lock")
+        self._buf: List[Optional[Dict[str, Any]]] = [None] * self.capacity  # guarded-by: _lock
+        self._next = 0  # next slot to write  # guarded-by: _lock
+        self._total = 0  # events ever recorded  # guarded-by: _lock
+        self._dropped = 0  # events overwritten (total - retained)  # guarded-by: _lock
 
     # -- record (the hot path) ---------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:

@@ -32,6 +32,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from ..utils import lockcheck
+
 __all__ = ["record_decision", "decisions", "stats", "clear"]
 
 _DEFAULT_CAPACITY = 4096
@@ -44,9 +46,9 @@ def _capacity() -> int:
         return _DEFAULT_CAPACITY
 
 
-_LOCK = threading.Lock()
-_LOG: "deque[Dict[str, Any]]" = deque(maxlen=_capacity())
-_TOTAL = 0  # decisions ever recorded (dropped = total - retained)
+_LOCK = lockcheck.make_lock("ops_plane.audit._LOCK")
+_LOG: "deque[Dict[str, Any]]" = deque(maxlen=_capacity())  # guarded-by: _LOCK
+_TOTAL = 0  # decisions ever recorded (dropped = total - retained)  # guarded-by: _LOCK
 
 
 def record_decision(

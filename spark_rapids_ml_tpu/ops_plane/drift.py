@@ -29,6 +29,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..utils import lockcheck
+
 __all__ = [
     "build_baseline",
     "register_baseline",
@@ -87,10 +89,10 @@ class Baseline:
         )
 
 
-_BASELINE_LOCK = threading.Lock()
-_BASELINE: Optional[Baseline] = None
+_BASELINE_LOCK = lockcheck.make_lock("ops_plane.drift._BASELINE_LOCK")
+_BASELINE: Optional[Baseline] = None  # guarded-by: _BASELINE_LOCK
 # the most recent published stats (ops_plane.report()'s drift section)
-_LAST_STATS: Optional[Dict[str, Any]] = None
+_LAST_STATS: Optional[Dict[str, Any]] = None  # guarded-by: _BASELINE_LOCK
 
 
 def build_baseline(

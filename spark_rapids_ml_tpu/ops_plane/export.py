@@ -39,6 +39,8 @@ import re
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils import lockcheck
+
 __all__ = [
     "render_prometheus",
     "start_server",
@@ -92,9 +94,9 @@ def render_prometheus() -> str:
 
 # ------------------------------------------------------------- HTTP server --
 
-_SERVER_LOCK = threading.Lock()
-_SERVER: Any = None
-_SERVER_THREAD: Optional[threading.Thread] = None
+_SERVER_LOCK = lockcheck.make_lock("ops_plane.export._SERVER_LOCK")
+_SERVER: Any = None  # guarded-by: _SERVER_LOCK
+_SERVER_THREAD: Optional[threading.Thread] = None  # guarded-by: _SERVER_LOCK
 
 
 def _make_handler():

@@ -42,6 +42,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..utils import lockcheck
+
 __all__ = ["evaluate", "maybe_evaluate", "health", "last_verdicts", "reset"]
 
 DEFAULT_FAST_WINDOW_S = 60.0
@@ -49,10 +51,10 @@ DEFAULT_SLOW_WINDOW_S = 3600.0
 DEFAULT_FAST_BURN = 14.4
 DEFAULT_SLOW_BURN = 1.0
 
-_LOCK = threading.Lock()
-_LAST: Dict[str, Dict[str, Any]] = {}  # spec name -> newest verdict
-_TRIPPED: Dict[str, bool] = {}
-_LAST_EVAL: float = 0.0
+_LOCK = lockcheck.make_lock("ops_plane.slo._LOCK")
+_LAST: Dict[str, Dict[str, Any]] = {}  # spec name -> newest verdict  # guarded-by: _LOCK
+_TRIPPED: Dict[str, bool] = {}  # guarded-by: _LOCK
+_LAST_EVAL: float = 0.0  # guarded-by: _LOCK
 
 
 def _specs() -> List[Dict[str, Any]]:

@@ -24,6 +24,7 @@ import time
 from typing import List, Optional, Tuple
 
 from ..errors import RankFailedError, RendezvousTimeoutError
+from ..utils import lockcheck
 
 __all__ = [
     "Rendezvous",
@@ -321,7 +322,7 @@ class LocalRendezvous(Rendezvous):
         def __init__(self, nranks: int):
             self.barrier = threading.Barrier(nranks)
             self.slots: List[Optional[str]] = [None] * nranks
-            self.lock = threading.Lock()
+            self.lock = lockcheck.make_lock("parallel.context.LocalRendezvous._Shared.lock")
             self.abort_info: Optional[Tuple[int, str]] = None
             self.epoch = 0
             # generation -> (live original-rank list, the survivors' _Shared):

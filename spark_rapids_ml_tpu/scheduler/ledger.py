@@ -38,6 +38,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..utils import lockcheck
+
 __all__ = ["HbmReservation", "HbmLedger", "global_ledger", "reset_global_ledger"]
 
 
@@ -91,16 +93,18 @@ class HbmLedger:
     utilization gauge's feed."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
-        self._admission_lock = threading.RLock()
-        self._by_id: Dict[int, HbmReservation] = {}
+        self._lock = lockcheck.make_lock("scheduler.ledger.HbmLedger._lock", "rlock")
+        self._admission_lock = lockcheck.make_lock(
+            "scheduler.ledger.HbmLedger._admission_lock", "rlock"
+        )
+        self._by_id: Dict[int, HbmReservation] = {}  # guarded-by: _lock
         self._ids = itertools.count(1)
         self.high_watermark: int = 0
         self.last_budget: Optional[int] = None
         self.admission_hooks: List[Callable[[int, Optional[int]], None]] = []
         # per-tenant integrated usage (byte-seconds / chip-seconds across
         # released AND resized claims; tenant_usage() adds the live ones)
-        self._tenant_usage: Dict[str, Dict[str, float]] = {}
+        self._tenant_usage: Dict[str, Dict[str, float]] = {}  # guarded-by: _lock
 
     # ------------------------------------------------------------ locking --
     def admission(self):
@@ -282,7 +286,7 @@ class HbmLedger:
 # One ledger per process: fits, serving loads, and scheduler jobs all charge
 # the same HBM, so they must share one book.
 _GLOBAL = HbmLedger()
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_LOCK = lockcheck.make_lock("scheduler.ledger._GLOBAL_LOCK")
 
 
 def global_ledger() -> HbmLedger:

@@ -46,7 +46,7 @@ from typing import Any, Dict, List, Optional
 from ..errors import PreemptedError, SchedulerSaturatedError
 from ..ops_plane import audit as _audit
 from ..ops_plane import slo as _slo
-from ..utils import get_logger
+from ..utils import get_logger, lockcheck
 from .context import job_scope
 from .ledger import HbmLedger, global_ledger
 
@@ -205,13 +205,13 @@ class FitScheduler:
             if max_preemptions is not None
             else config.get("sched_max_preemptions", 2)
         )
-        self._lock = threading.RLock()
-        self._queue: List[FitJob] = []
-        self._running: Dict[int, FitJob] = {}
-        self._threads: List[threading.Thread] = []
-        self._jobs: List[FitJob] = []
-        self._next_id = 1
-        self._closed = False
+        self._lock = lockcheck.make_lock("scheduler.queue.FitScheduler._lock", "rlock")
+        self._queue: List[FitJob] = []  # guarded-by: _lock
+        self._running: Dict[int, FitJob] = {}  # guarded-by: _lock
+        self._threads: List[threading.Thread] = []  # guarded-by: _lock
+        self._jobs: List[FitJob] = []  # guarded-by: _lock
+        self._next_id = 1  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         self._logger = get_logger(type(self))
         # opt-in live scrape surface (SRML_METRICS_PORT): a long-lived
         # scheduler is exactly the process an operator wants /metrics on

@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import telemetry
-from ..utils import get_logger
+from ..utils import get_logger, lockcheck
 from .registry import ModelRegistry
 
 
@@ -99,7 +99,7 @@ class ScoringEngine:
             coalesce_window_s = float(config.get("serve_coalesce_window_ms", 2.0)) / 1e3
         self._window_s = max(0.0, float(coalesce_window_s))
         self._max_rows = int(max_batch_rows or config.get("serve_max_batch_rows", 8192))
-        self._cond = threading.Condition()
+        self._cond = lockcheck.make_condition("serving.engine.ScoringEngine._cond")
         self._queue: "deque[ScoreFuture]" = deque()
         self._thread: Optional[threading.Thread] = None
         self._stop = False

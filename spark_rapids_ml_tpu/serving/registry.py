@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import telemetry
 from ..errors import HbmBudgetError
-from ..utils import get_logger
+from ..utils import get_logger, lockcheck
 
 
 @dataclass
@@ -71,8 +71,8 @@ class ModelRegistry:
     ) -> None:
         from ..core import config
 
-        self._lock = threading.RLock()
-        self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()
+        self._lock = lockcheck.make_lock("serving.registry.ModelRegistry._lock", "rlock")
+        self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()  # guarded-by: _lock
         self._prewarm_default = bool(prewarm)
         self._cap = int(max_batch_rows or config.get("serve_max_batch_rows", 8192))
         self._logger = get_logger(type(self))

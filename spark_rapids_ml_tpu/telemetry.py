@@ -45,6 +45,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .utils import lockcheck
+
 __all__ = [
     "enabled",
     "enable",
@@ -305,22 +307,22 @@ class MetricsRegistry:
     entirely with `enabled()` — both layers check)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counters: Dict[str, float] = {}
-        self._gauges: Dict[str, float] = {}
-        self._hists: Dict[str, Dict[str, float]] = {}
+        self._lock = lockcheck.make_lock("telemetry.MetricsRegistry._lock")
+        self._counters: Dict[str, float] = {}  # guarded-by: _lock
+        self._gauges: Dict[str, float] = {}  # guarded-by: _lock
+        self._hists: Dict[str, Dict[str, float]] = {}  # guarded-by: _lock
         # per-histogram ring of the most recent observations (quantile())
-        self._hist_samples: Dict[str, List[float]] = {}
-        self._spans: List[Dict[str, Any]] = []
+        self._hist_samples: Dict[str, List[float]] = {}  # guarded-by: _lock
+        self._spans: List[Dict[str, Any]] = []  # guarded-by: _lock
         # monotone count of ALL spans ever recorded — `_spans` is trimmed to a
         # bound, so marks must not be absolute list indices
-        self._spans_total: int = 0
-        self._convergence: Dict[str, List[List[float]]] = {}
+        self._spans_total: int = 0  # guarded-by: _lock
+        self._convergence: Dict[str, List[List[float]]] = {}  # guarded-by: _lock
         # rolling windows (ops plane): params resolved at first record after
         # construction/reset, one ring per counter/histogram
-        self._win_cfg: Optional[Tuple[float, int]] = None
-        self._win_counters: Dict[str, _CounterRing] = {}
-        self._win_hists: Dict[str, _HistRing] = {}
+        self._win_cfg: Optional[Tuple[float, int]] = None  # guarded-by: _lock
+        self._win_counters: Dict[str, _CounterRing] = {}  # guarded-by: _lock
+        self._win_hists: Dict[str, _HistRing] = {}  # guarded-by: _lock
 
     def _win(self) -> Tuple[float, int]:
         """Window params, resolved once per construction/reset (caller holds
@@ -595,9 +597,14 @@ class MetricsRegistry:
             # than the retained window were recorded, only the tail survives
             since = max(0, self._spans_total - m.spans_total)
             spans = [dict(r) for r in self._spans[len(self._spans) - min(since, len(self._spans)):]] if since else []
+            # copy gauges UNDER the lock: the copy used to happen in the
+            # return expression after releasing it, so a concurrent gauge()
+            # could resize the dict mid-iteration (found by the
+            # guard-discipline rule)
+            gauges = dict(self._gauges)
         return {
             "counters": counters,
-            "gauges": dict(self._gauges),
+            "gauges": gauges,
             "histograms": hists,
             "spans": spans,
         }
@@ -686,7 +693,7 @@ def summarize_histogram(name: str, *, window_s: Optional[float] = None) -> Dict[
 
 # ------------------------------------------------------------------- sinks --
 
-_SINK_LOCK = threading.Lock()
+_SINK_LOCK = lockcheck.make_lock("telemetry._SINK_LOCK")
 _SINK_FILES: Dict[str, Any] = {}
 
 
@@ -721,7 +728,7 @@ def _sink_write(rec: Dict[str, Any]) -> None:
     if path is None:
         return
     line = json.dumps(rec, default=_json_default) + "\n"
-    with _SINK_LOCK:
+    with _SINK_LOCK:  # held-ok: the sink lock exists to serialize exactly this local append (open-once + write + flush); no other lock is ever taken under it
         f = _SINK_FILES.get(path)
         if f is None or f.closed:
             try:

@@ -21,7 +21,7 @@ import numpy as np
 
 from .core import _TpuEstimator, _TpuModel, device_dataset_scope, evaluator_label_column
 from .params import Param, Params, TypeConverters
-from .utils import get_logger
+from .utils import get_logger, lockcheck
 
 
 def _scoring_labels(pdf, est, eva) -> np.ndarray:
@@ -48,9 +48,9 @@ class SweepLedger:
         self.trace_id = trace_id
         self.num_folds = int(num_folds)
         self.num_models = int(num_models)
-        self._metrics: Dict[Tuple[int, int], float] = {}
-        self._models: Dict[Tuple[int, int], Any] = {}
-        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[int, int], float] = {}  # guarded-by: _lock
+        self._models: Dict[Tuple[int, int], Any] = {}  # guarded-by: _lock
+        self._lock = lockcheck.make_lock("tuning.SweepLedger._lock")
 
     def complete(self, fold: int, idx: int, metric: float, model: Any = None) -> None:
         from . import diagnostics, telemetry
@@ -118,7 +118,7 @@ class SweepLedger:
 # last few sweeps' ledgers, keyed by trace_id (inspection / tests); bounded
 # so long-lived drivers don't accumulate model references forever
 _LEDGERS: "OrderedDict[str, SweepLedger]" = OrderedDict()
-_LEDGERS_LOCK = threading.Lock()
+_LEDGERS_LOCK = lockcheck.make_lock("tuning._LEDGERS_LOCK")
 _LEDGERS_CAP = 8
 
 

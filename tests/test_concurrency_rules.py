@@ -1,0 +1,662 @@
+#
+# Fixture corpus for the whole-program concurrency rules (ci/analysis
+# rules/concurrency.py over the program.py pass-1 model): per rule at least
+# one true positive and one false-positive guard, including the cross-file
+# lock-order cycle that PER-FILE analysis provably cannot see, the
+# re-entrant RLock non-finding, and `with a, b` ordering. Plus the
+# content-hash cache (unchanged files skip re-parsing, edits invalidate)
+# and `--explain`.
+#
+import json
+import pathlib
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from ci.analysis import analyze_source, analyze_sources  # noqa: E402
+from ci.analysis.cli import main as cli_main  # noqa: E402
+from ci.analysis.rules import (  # noqa: E402
+    BlockingUnderLockRule,
+    GuardDisciplineRule,
+    LockOrderRule,
+)
+
+
+def run(src, rule_factory, relpath="spark_rapids_ml_tpu/snippet.py"):
+    return analyze_source(textwrap.dedent(src), relpath=relpath, rules=[rule_factory()])
+
+
+def run_files(files, rule_factory):
+    return analyze_sources(
+        {rel: textwrap.dedent(src) for rel, src in files.items()},
+        rules=[rule_factory()],
+    )
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------------------
+# lock-order
+# --------------------------------------------------------------------------
+
+
+def test_lock_order_same_file_inversion_fires():
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+    def forward():
+        with _A:
+            with _B:
+                pass
+    def backward():
+        with _B:
+            with _A:
+                pass
+    """
+    fs = run(src, LockOrderRule)
+    assert rule_ids(fs) == ["lock-order"]
+    assert "snippet._A" in fs[0].message and "snippet._B" in fs[0].message
+
+
+def test_lock_order_consistent_global_order_passes():
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+    def one():
+        with _A:
+            with _B:
+                pass
+    def two():
+        with _A:
+            with _B:
+                pass
+    """
+    assert run(src, LockOrderRule) == []
+
+
+def test_lock_order_with_tuple_item_ordering():
+    # `with a, b` acquires in item order — an inverted pair elsewhere cycles
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+    def one():
+        with _A, _B:
+            pass
+    def two():
+        with _B, _A:
+            pass
+    """
+    fs = run(src, LockOrderRule)
+    assert rule_ids(fs) == ["lock-order"]
+    consistent = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+    def one():
+        with _A, _B:
+            pass
+    def two():
+        with _A, _B:
+            pass
+    """
+    assert run(consistent, LockOrderRule) == []
+
+
+def test_lock_order_reentrant_rlock_is_not_a_finding():
+    src = """
+    import threading
+    class R:
+        def __init__(self):
+            self._lock = threading.RLock()
+        def outer(self):
+            with self._lock:
+                self.inner()
+        def inner(self):
+            with self._lock:
+                pass
+    """
+    assert run(src, LockOrderRule) == []
+
+
+def test_lock_order_plain_lock_self_reacquire_is_self_deadlock():
+    src = """
+    import threading
+    _L = threading.Lock()
+    def f():
+        with _L:
+            with _L:
+                pass
+    """
+    fs = run(src, LockOrderRule)
+    assert rule_ids(fs) == ["lock-order"]
+    assert "self-deadlock" in fs[0].message
+
+
+_CYCLE_FILE_A = """
+import threading
+class FixLedger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+    def forward(self):
+        with self._alock:
+            self.inner()
+    def inner(self):
+        with self._block:
+            pass
+    def callback(self, sched):
+        with self._block:
+            sched.poke()
+"""
+
+_CYCLE_FILE_B = """
+import threading
+from .fix_ledger import FixLedger
+class FixSched:
+    def __init__(self):
+        self._slock = threading.Lock()
+        self._ledger = FixLedger()
+    def schedule(self):
+        with self._slock:
+            self._ledger.forward()
+    def poke(self):
+        with self._slock:
+            pass
+"""
+
+
+def test_lock_order_cross_file_cycle_via_call_graph():
+    # the acceptance fixture: the inversion is SPLIT across two files —
+    # schedule() holds slock and (through forward()) acquires block, while
+    # callback() holds block and (through poke()) acquires slock
+    fs = run_files(
+        {
+            "spark_rapids_ml_tpu/fix_ledger.py": _CYCLE_FILE_A,
+            "spark_rapids_ml_tpu/fix_sched.py": _CYCLE_FILE_B,
+        },
+        LockOrderRule,
+    )
+    assert "lock-order" in rule_ids(fs)
+    assert any("fix_sched.FixSched._slock" in f.message for f in fs)
+
+
+def test_lock_order_cross_file_cycle_invisible_per_file():
+    # each HALF alone is clean: per-file analysis cannot see this bug
+    assert (
+        run(_CYCLE_FILE_A, LockOrderRule, relpath="spark_rapids_ml_tpu/fix_ledger.py")
+        == []
+    )
+    assert (
+        run(_CYCLE_FILE_B, LockOrderRule, relpath="spark_rapids_ml_tpu/fix_sched.py")
+        == []
+    )
+
+
+def test_lock_order_waiver_breaks_the_edge():
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+    def forward():
+        with _A:
+            with _B:
+                pass
+    def backward():
+        with _B:
+            with _A:  # lock-order-ok: fixture rationale — B->A path cannot run concurrently with forward()
+                pass
+    """
+    assert run(src, LockOrderRule) == []
+
+
+def test_lock_order_multi_cycle_scc_does_not_crash():
+    # regression: a greedy cycle walk could dead-end in an SCC with
+    # branching (A->B, B->C, B->D, C->B, D->A) and fabricate a closing
+    # edge that was never recorded — KeyError out of finalize, crashing
+    # the gate exactly when a complex deadlock exists
+    src = """
+    import threading
+    _A = threading.Lock(); _B = threading.Lock(); _C = threading.Lock(); _D = threading.Lock()
+    def e1():
+        with _A:
+            with _B: pass
+    def e2():
+        with _B:
+            with _C: pass
+    def e3():
+        with _B:
+            with _D: pass
+    def e4():
+        with _C:
+            with _B: pass
+    def e5():
+        with _D:
+            with _A: pass
+    """
+    fs = run(src, LockOrderRule)
+    assert fs and all(f.rule == "lock-order" for f in fs)
+
+
+def test_lock_order_through_lock_returning_helper():
+    # `with self.admission():` — acquisition through a lock-returning helper
+    src = """
+    import threading
+    class L:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._admission = threading.Lock()
+        def admission(self):
+            return self._admission
+        def forward(self):
+            with self.admission():
+                with self._lock:
+                    pass
+        def backward(self):
+            with self._lock:
+                with self.admission():
+                    pass
+    """
+    fs = run(src, LockOrderRule)
+    assert rule_ids(fs) == ["lock-order"]
+    assert "_admission" in fs[0].message
+
+
+# --------------------------------------------------------------------------
+# blocking-under-lock
+# --------------------------------------------------------------------------
+
+
+def test_blocking_sleep_under_lock_fires():
+    src = """
+    import threading
+    import time
+    _L = threading.Lock()
+    def f():
+        with _L:
+            time.sleep(0.5)
+    """
+    fs = run(src, BlockingUnderLockRule)
+    assert rule_ids(fs) == ["blocking-under-lock"]
+    assert "time.sleep" in fs[0].message
+
+
+def test_blocking_sleep_outside_lock_passes():
+    src = """
+    import threading
+    import time
+    _L = threading.Lock()
+    def f():
+        with _L:
+            pass
+        time.sleep(0.5)
+    """
+    assert run(src, BlockingUnderLockRule) == []
+
+
+def test_blocking_reached_through_cross_file_call_chain():
+    files = {
+        "spark_rapids_ml_tpu/fix_io.py": """
+            def fetch_all(url):
+                import urllib.request
+                return urllib.request.urlopen(url)
+            """,
+        "spark_rapids_ml_tpu/fix_holder.py": """
+            import threading
+            from .fix_io import fetch_all
+            _L = threading.Lock()
+            def refresh(url):
+                with _L:
+                    return fetch_all(url)
+            """,
+    }
+    fs = run_files(files, BlockingUnderLockRule)
+    assert rule_ids(fs) == ["blocking-under-lock"]
+    assert fs[0].path == "spark_rapids_ml_tpu/fix_holder.py"
+    assert "urlopen" in fs[0].message and "fetch_all" in fs[0].message
+
+
+def test_blocking_condition_wait_on_held_condition_is_sanctioned():
+    src = """
+    import threading
+    class E:
+        def __init__(self):
+            self._cond = threading.Condition()
+        def loop(self):
+            with self._cond:
+                self._cond.wait(0.05)
+    """
+    assert run(src, BlockingUnderLockRule) == []
+
+
+def test_blocking_foreign_wait_under_lock_fires():
+    src = """
+    import threading
+    class E:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._done = threading.Event()
+        def bad(self):
+            with self._cond:
+                self._done.wait(5.0)
+    """
+    fs = run(src, BlockingUnderLockRule)
+    assert rule_ids(fs) == ["blocking-under-lock"]
+
+
+def test_blocking_device_sync_under_lock_fires():
+    src = """
+    import threading
+    import jax
+    _L = threading.Lock()
+    def f(x):
+        with _L:
+            jax.block_until_ready(x)
+    """
+    fs = run(src, BlockingUnderLockRule)
+    assert rule_ids(fs) == ["blocking-under-lock"]
+
+
+def test_blocking_waiver_on_the_with_header_covers_the_section():
+    src = """
+    import threading
+    _L = threading.Lock()
+    def f(path, line):
+        with _L:  # held-ok: fixture rationale — the lock exists to serialize this append
+            with open(path, "a") as fh:
+                fh.write(line)
+    """
+    assert run(src, BlockingUnderLockRule) == []
+
+
+def test_blocking_waiver_on_the_op_line_also_suppresses():
+    src = """
+    import threading
+    import time
+    _L = threading.Lock()
+    def f():
+        with _L:
+            time.sleep(0.01)  # held-ok: fixture rationale — bounded poll tick
+    """
+    assert run(src, BlockingUnderLockRule) == []
+
+
+# --------------------------------------------------------------------------
+# guard-discipline
+# --------------------------------------------------------------------------
+
+
+def test_guard_unlocked_read_fires_and_locked_access_passes():
+    src = """
+    import threading
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded-by: _lock
+        def ok(self):
+            with self._lock:
+                return len(self._items)
+        def bad(self):
+            return self._items
+    """
+    fs = run(src, GuardDisciplineRule)
+    assert rule_ids(fs) == ["guard-discipline"]
+    assert "_items" in fs[0].message and "bad" in fs[0].message
+
+
+def test_guard_locked_helper_proven_by_call_sites():
+    # _drop_locked has no `with` of its own; every resolved call site holds
+    # the lock, so the entry-held fixpoint proves it safe
+    src = """
+    import threading
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded-by: _lock
+        def outer(self):
+            with self._lock:
+                self._drop_locked()
+        def _drop_locked(self):
+            self._items.clear()
+    """
+    assert run(src, GuardDisciplineRule) == []
+
+
+def test_guard_helper_with_one_unlocked_call_site_fires():
+    src = """
+    import threading
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded-by: _lock
+        def outer(self):
+            with self._lock:
+                self._drop_locked()
+        def sloppy(self):
+            self._drop_locked()
+        def _drop_locked(self):
+            self._items.clear()
+    """
+    fs = run(src, GuardDisciplineRule)
+    assert rule_ids(fs) == ["guard-discipline"]
+
+
+def test_guard_init_writes_are_exempt():
+    src = """
+    import threading
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded-by: _lock
+            self._items["seed"] = 1
+    """
+    assert run(src, GuardDisciplineRule) == []
+
+
+def test_guard_module_global_state():
+    src = """
+    import threading
+    _L = threading.Lock()
+    _STATE = {}  # guarded-by: _L
+    def good():
+        with _L:
+            _STATE["x"] = 1
+    def bad():
+        _STATE.clear()
+    """
+    fs = run(src, GuardDisciplineRule)
+    assert rule_ids(fs) == ["guard-discipline"]
+    assert fs[0].message.find("bad") != -1
+
+
+def test_guard_unknown_lock_name_is_itself_a_finding():
+    src = """
+    import threading
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded-by: _nope
+    """
+    fs = run(src, GuardDisciplineRule)
+    assert rule_ids(fs) == ["guard-discipline"]
+    assert "_nope" in fs[0].message
+
+
+def test_guard_waiver_suppresses():
+    src = """
+    import threading
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}  # guarded-by: _lock
+        def snapshot(self):
+            return dict(self._items)  # guard-ok: fixture rationale — benign racy read
+    """
+    assert run(src, GuardDisciplineRule) == []
+
+
+# --------------------------------------------------------------------------
+# content-hash cache + --explain
+# --------------------------------------------------------------------------
+
+
+def _seed_repo(root: pathlib.Path, body: str) -> None:
+    pkg = root / "spark_rapids_ml_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "mod.py").write_text(body)
+    (root / "ci" / "analysis").mkdir(parents=True, exist_ok=True)
+
+
+def test_cache_skips_unchanged_files_and_invalidates_on_edit(tmp_path, capsys):
+    _seed_repo(tmp_path, "import time\n\n\ndef f():\n    time.sleep(1)  # sleep-ok: fixture rationale\n")
+    args = ["spark_rapids_ml_tpu", "--root", str(tmp_path), "--no-imports", "--json",
+            "--baseline", str(tmp_path / "baseline.json")]
+    assert cli_main(args) == 0
+    cold = json.loads(capsys.readouterr().out)
+    assert cold["files_cached"] == 0 and cold["files_scanned"] == 1
+    assert (tmp_path / "ci" / "analysis" / "cache.json").exists()
+
+    assert cli_main(args) == 0
+    warm = json.loads(capsys.readouterr().out)
+    assert warm["files_cached"] == 1
+    assert warm["findings"] == cold["findings"]
+
+    # an edit invalidates exactly that file — and its NEW finding surfaces
+    (tmp_path / "spark_rapids_ml_tpu" / "mod.py").write_text(
+        "import time\n\n\ndef f():\n    time.sleep(1)\n"
+    )
+    assert cli_main(args) == 1
+    edited = json.loads(capsys.readouterr().out)
+    assert edited["files_cached"] == 0
+    assert any(f["rule"] == "bare-sleep" for f in edited["findings"])
+
+
+def test_cache_replays_collector_state_for_registry_rules(tmp_path, capsys):
+    # a config-key usage in a CACHED file must still be checked in finalize
+    _seed_repo(
+        tmp_path,
+        "from .core import config\n\n\ndef f():\n    return config.get('no_such_key')\n",
+    )
+    args = ["spark_rapids_ml_tpu", "--root", str(tmp_path), "--no-imports", "--json",
+            "--baseline", str(tmp_path / "baseline.json")]
+    assert cli_main(args) == 1
+    cold = json.loads(capsys.readouterr().out)
+    assert cli_main(args) == 1
+    warm = json.loads(capsys.readouterr().out)
+    cold_keys = [f for f in cold["findings"] if f["rule"] == "config-key"]
+    warm_keys = [f for f in warm["findings"] if f["rule"] == "config-key"]
+    assert cold_keys and warm_keys == cold_keys
+    assert warm["files_cached"] == 1
+
+
+def test_explain_prints_rule_doc(capsys):
+    assert cli_main(["--explain", "lock-order"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-order" in out
+    assert "# lock-order-ok: <reason>" in out
+    assert cli_main(["--explain", "no-such-rule"]) == 1
+
+
+# --------------------------------------------------------------------------
+# regression pins for the real findings this pass fixed
+# --------------------------------------------------------------------------
+
+
+def test_fit_multiple_iterator_lock_not_held_during_fit():
+    """blocking-under-lock regression: the single fit pass used to run INSIDE
+    the iterator lock (rendezvous rounds + sink I/O under a mutex); now the
+    lock covers only index claiming."""
+    from spark_rapids_ml_tpu.core import _FitMultipleIterator
+
+    in_fit = threading.Event()
+    release_fit = threading.Event()
+
+    def slow_fit():
+        in_fit.set()
+        assert release_fit.wait(10.0)
+        return ["m0", "m1"]
+
+    it = _FitMultipleIterator(slow_fit, 2)
+    results = {}
+
+    def consume():
+        idx, model = next(it)
+        results[idx] = model
+
+    t0 = threading.Thread(target=consume, daemon=True)
+    t0.start()
+    assert in_fit.wait(10.0)
+    # the fit is in flight: the iterator lock must be FREE
+    assert it.lock.acquire(timeout=1.0), "iterator lock held across the fit pass"
+    it.lock.release()
+    release_fit.set()
+    t1 = threading.Thread(target=consume, daemon=True)
+    t1.start()
+    t0.join(10.0)
+    t1.join(10.0)
+    assert results == {0: "m0", 1: "m1"}
+
+
+def test_fit_multiple_iterator_fit_failure_propagates_to_waiters():
+    from spark_rapids_ml_tpu.core import _FitMultipleIterator
+
+    def broken_fit():
+        raise ValueError("boom")
+
+    it = _FitMultipleIterator(broken_fit, 2)
+    first_err = {}
+
+    def first():
+        try:
+            next(it)
+        except BaseException as e:  # noqa: BLE001 - recording for assertion
+            first_err["e"] = e
+
+    t = threading.Thread(target=first, daemon=True)
+    t.start()
+    t.join(10.0)
+    assert isinstance(first_err.get("e"), ValueError)
+    with pytest.raises(RuntimeError, match="fit pass"):
+        next(it)
+
+
+def test_metrics_delta_gauges_copy_is_race_free():
+    """guard-discipline regression: delta() used to copy the gauges dict
+    AFTER releasing the registry lock — a concurrent gauge() could resize it
+    mid-iteration."""
+    from spark_rapids_ml_tpu import telemetry
+
+    telemetry.enable()
+    try:
+        reg = telemetry.registry()
+        mark = reg.mark()
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                reg.gauge(f"fixture.g{i % 257}", float(i))  # metric-ok: synthetic churn names for the race regression test
+                i += 1
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 1.0
+        try:
+            while time.monotonic() < deadline:
+                reg.delta(mark)  # pre-fix: RuntimeError(dict changed size)
+        finally:
+            stop.set()
+            t.join(5.0)
+    finally:
+        telemetry.disable()
