@@ -32,6 +32,23 @@
 # names) is skipped, never guessed — the rules prefer missed findings over
 # false cycles.
 #
+# PR 15 grew the same per-function event stream a NUMERICS layer (consumed by
+# rules/numerics.py): every local dtype binding is tracked through a small
+# lattice (f64/f32/bf16/f16), emitting
+#
+#   narrow   an f64-bound local rebound/augmented with a narrower expression
+#            (silent accumulator narrowing)
+#   lowdot   a dot-like call (dot/matmul/einsum/tensordot/pl.dot or the `@`
+#            operator) with per-operand dtype descriptors ({"dt": token} when
+#            locally evident, {"param": name} when the operand is a bare
+#            function parameter) and its `preferred_element_type` token
+#   f64      a jnp-level float64 constant/cast/ctor, tagged with whether it
+#            sits lexically under an x64 guard (`enable_x64`/`x64_scope`
+#            context or a `jax_enable_x64` conditional)
+#
+# and call events carry `argdt` (positional-arg dtype descriptors) + `x64`
+# so pass 2 can thread dtypes and x64-guardedness through resolved calls.
+#
 from __future__ import annotations
 
 import ast
@@ -71,7 +88,58 @@ _COMMON_METHOD_TAILS = {
     "wait", "notify", "names", "events", "tail",
 }
 
-_WAIVER_TAGS = ("lock-order", "held", "guard")
+_WAIVER_TAGS = ("lock-order", "held", "guard", "precision")
+
+# ------------------------------------------------------------ dtype lattice --
+
+# spelled dtype -> lattice token; anything else is "unknown" (None)
+_DTYPE_TOKENS = {
+    "float64": "f64", "double": "f64", "f64": "f64",
+    "float32": "f32", "single": "f32", "f32": "f32",
+    "bfloat16": "bf16", "bf16": "bf16",
+    "float16": "f16", "half": "f16", "f16": "f16",
+}
+# dot-like call tails the lowdot event covers (plus the `@` operator and
+# einsum, handled separately for its leading equation string)
+_DOT_TAILS = {"dot", "dot_general", "matmul", "tensordot"}
+# array constructors whose dtype argument types the RESULT
+_DTYPE_CTORS = {
+    "zeros", "ones", "full", "empty", "array", "asarray", "arange",
+    "linspace", "eye", "zeros_like", "ones_like", "full_like", "empty_like",
+}
+# attribute accesses that preserve the receiver's dtype (`x.T`, `x.mT`)
+_DTYPE_TRANSPARENT_ATTRS = {"T", "mT", "real"}
+
+
+def _dtype_token(expr: Optional[ast.AST], imports: Dict[str, str]) -> Optional[str]:
+    """A dtype-position expression (`jnp.float64`, `np.float32`, "bfloat16")
+    -> lattice token, else None."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return _DTYPE_TOKENS.get(expr.value)
+    name = _dotted(expr, imports) if expr is not None else None
+    if name is None:
+        return None
+    return _DTYPE_TOKENS.get(name.split(".")[-1])
+
+
+def _is_jax_dtype(expr: Optional[ast.AST], imports: Dict[str, str]) -> bool:
+    """Whether a dtype-position expression is spelled through jax (`jnp.
+    float64`) rather than numpy — host-side np.float64 is sanctioned, a
+    device-side jnp f64 needs the x64 guard."""
+    name = _dotted(expr, imports) if expr is not None else None
+    return name is not None and name.startswith("jax")
+
+
+def _mentions_x64(expr: ast.AST) -> bool:
+    """Whether an expression names the x64 machinery (`enable_x64(...)`,
+    `x64_scope(...)`, `jax.config.jax_enable_x64`) — the lexical guard the
+    f64 events record."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and "x64" in node.id:
+            return True
+        if isinstance(node, ast.Attribute) and "x64" in node.attr:
+            return True
+    return False
 
 # a held-set entry is a resolved lock id (str) or an unresolved
 # `with helper():` call spec (dict) normalized at assembly
@@ -170,6 +238,11 @@ class _FactsBuilder:
         self._module_locks: Dict[str, str] = {}
         self._class_guards: Dict[str, Dict[str, str]] = {}
         self._module_guards: Dict[str, str] = {}
+        # numerics layer: per-function local dtype environment + param set
+        # (live only while that function is being scanned)
+        self._envs: Dict[str, Dict[str, str]] = {}
+        self._params: Dict[str, List[str]] = {}
+        self._x64_depth = 0  # lexical x64-guard nesting (With/If markers)
 
     # -- entry -------------------------------------------------------------
     def build(self, tree: ast.Module) -> Dict[str, Any]:
@@ -350,11 +423,21 @@ class _FactsBuilder:
         return None
 
     # -- function bodies ---------------------------------------------------
-    def _function(self, fn: ast.AST, qual: str, cls: Optional[str]) -> None:
+    def _function(
+        self, fn: ast.AST, qual: str, cls: Optional[str],
+        parent_env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        args = fn.args
+        params = [
+            a.arg
+            for a in getattr(args, "posonlyargs", []) + args.args
+            if a.arg not in ("self", "cls")
+        ]
         events: List[Dict[str, Any]] = []
         self.functions[qual] = {
             "relpath": self.ctx.relpath, "line": fn.lineno,
             "cls": cls, "name": fn.name, "events": events,
+            "params": params,
         }
         # lock-returning helper: `return self._admission_lock`
         for stmt in fn.body:
@@ -362,7 +445,16 @@ class _FactsBuilder:
                 lock = self._lock_of_expr(stmt.value, cls)
                 if lock is not None:
                     self.lock_returns[qual] = lock
+        # closures read outer locals: a nested def's dtype env starts as a
+        # COPY of what was visible at its definition point
+        self._envs[qual] = dict(parent_env) if parent_env else {}
+        self._params[qual] = params
+        # like `held`, the x64 guard does NOT extend into a nested def: the
+        # closure runs when CALLED, after the scoped guard has exited
+        saved_x64 = self._x64_depth
+        self._x64_depth = 0
         self._scan_block(fn.body, qual, cls, held=(), region_waived=frozenset())
+        self._x64_depth = saved_x64
 
     def _scan_block(
         self, body: Sequence[ast.AST], qual: str, cls: Optional[str],
@@ -378,7 +470,8 @@ class _FactsBuilder:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             # a nested def runs when CALLED, not here — own function entry
             # (thread targets, closures), resolvable as `<qual>.<name>`
-            self._function(stmt, f"{qual}.{stmt.name}", cls)
+            self._function(stmt, f"{qual}.{stmt.name}", cls,
+                           parent_env=self._envs.get(qual))
             return
         if isinstance(stmt, (ast.With, ast.AsyncWith)):
             inner = held
@@ -405,7 +498,36 @@ class _FactsBuilder:
                                    via_call=spec, waiver_node=stmt,
                                    region_waived=region_waived)
                         inner = inner + ({"call": spec},)
+            # `with enable_x64(True):` / `with x64_scope(...):` — f64 events
+            # inside the section are guarded
+            guard_x64 = any(_mentions_x64(i.context_expr) for i in stmt.items)
+            if guard_x64:
+                self._x64_depth += 1
             self._scan_block(stmt.body, qual, cls, inner, inner_waived)
+            if guard_x64:
+                self._x64_depth -= 1
+            return
+        if isinstance(stmt, ast.If) and _mentions_x64(stmt.test):
+            # `if jax.config.jax_enable_x64:` guards its TRUE arm; a negated
+            # test (`if not ...:`, `... == False`/`is False`) guards the
+            # ELSE arm instead — the true arm there runs precisely when x64
+            # is OFF, the exact state the f64 findings exist for
+            self._scan_expr(stmt.test, qual, cls, held, region_waived)
+            negated = isinstance(stmt.test, ast.UnaryOp) and isinstance(
+                stmt.test.op, ast.Not
+            )
+            if isinstance(stmt.test, ast.Compare) and len(stmt.test.ops) == 1:
+                comp = stmt.test.comparators[0]
+                if isinstance(comp, ast.Constant) and comp.value is False:
+                    # `== False` / `is False` negate; `!= False` / `is not
+                    # False` are truthy exactly when x64 is ON
+                    negated = isinstance(stmt.test.ops[0], (ast.Eq, ast.Is))
+            for arm, guarded in ((stmt.body, not negated), (stmt.orelse, negated)):
+                if guarded:
+                    self._x64_depth += 1
+                self._scan_block(arm, qual, cls, held, region_waived)
+                if guarded:
+                    self._x64_depth -= 1
             return
         for expr in self._stmt_exprs(stmt):
             self._scan_expr(expr, qual, cls, held, region_waived)
@@ -414,6 +536,7 @@ class _FactsBuilder:
             for t in targets:
                 for node in ast.walk(t):
                     self._maybe_access(node, qual, cls, held, "write", region_waived)
+            self._track_dtype(stmt, qual, held, region_waived)
         for block in self._stmt_blocks(stmt):
             self._scan_block(block, qual, cls, held, region_waived)
 
@@ -448,6 +571,17 @@ class _FactsBuilder:
             if isinstance(node, ast.Call):
                 self._call_event(node, qual, cls, held, region_waived)
             else:
+                if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                    # `a @ b`: the operator spelling of a dot-like op — no
+                    # preferred_element_type is expressible here, so a
+                    # low-precision operand is always a finding candidate
+                    self._emit(
+                        qual, "lowdot", node, held=held, waiver_node=node,
+                        region_waived=region_waived, op="@",
+                        args=[self._operand_desc(node.left, qual),
+                              self._operand_desc(node.right, qual)],
+                        pref=None,
+                    )
                 self._maybe_access(node, qual, cls, held, "read", region_waived)
 
     def _maybe_access(
@@ -483,6 +617,188 @@ class _FactsBuilder:
         t = tail.strip("_").lower().replace("_", "")
         return bool(t) and t in cls_name.lower()
 
+    # -- numerics layer: local dtype inference + events ---------------------
+    _PRECISION_ORDER = {"f64": 3, "f32": 2, "bf16": 1, "f16": 1}
+    _RANDOM_SAMPLER_TAILS = {
+        "normal", "uniform", "truncated_normal", "gamma", "beta",
+        "exponential", "laplace", "gumbel",
+    }
+
+    def _promote(self, a: Optional[str], b: Optional[str]) -> Optional[str]:
+        """Widest-wins promotion; "weak" (python scalar) defers, unknown
+        poisons — a result mixing unknown operands stays unknown so the
+        narrow check never fires on guessed dtypes."""
+        if a == "weak":
+            return b
+        if b == "weak":
+            return a
+        if a is None or b is None:
+            return None
+        return a if self._PRECISION_ORDER[a] >= self._PRECISION_ORDER[b] else b
+
+    def _expr_dtype(self, expr: ast.AST, qual: str) -> Optional[str]:
+        tok = self._dt(expr, self._envs.get(qual, {}))
+        return None if tok == "weak" else tok
+
+    def _dt(self, node: ast.AST, env: Dict[str, str]) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Constant):
+            return "weak" if isinstance(node.value, (int, float)) else None
+        if isinstance(node, ast.Attribute):
+            if node.attr in _DTYPE_TRANSPARENT_ATTRS:
+                return self._dt(node.value, env)
+            return None
+        if isinstance(node, ast.Subscript):
+            return self._dt(node.value, env)
+        if isinstance(node, ast.UnaryOp):
+            return self._dt(node.operand, env)
+        if isinstance(node, ast.BinOp):
+            return self._promote(self._dt(node.left, env), self._dt(node.right, env))
+        if isinstance(node, ast.IfExp):
+            a, b = self._dt(node.body, env), self._dt(node.orelse, env)
+            return a if a == b else None
+        if isinstance(node, ast.Call):
+            return self._call_dtype(node, env)
+        return None
+
+    def _call_dtype(self, node: ast.Call, env: Dict[str, str]) -> Optional[str]:
+        name = _dotted(node.func, self.imports)
+        tail = None
+        if isinstance(node.func, ast.Attribute):
+            tail = node.func.attr
+        elif name is not None:
+            tail = name.split(".")[-1]
+        if tail == "astype" and node.args:
+            return _dtype_token(node.args[0], self.imports)
+        kw_dtype = None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                kw_dtype = kw.value
+        if tail in _DTYPE_CTORS:
+            dt_expr = kw_dtype
+            if dt_expr is None and len(node.args) > 1:
+                dt_expr = node.args[1]
+            tok = _dtype_token(dt_expr, self.imports)
+            if tok is not None:
+                return tok
+            if tail.endswith("_like") and node.args:
+                return self._dt(node.args[0], env)
+            return None
+        if tail in self._RANDOM_SAMPLER_TAILS and name and name.startswith("jax.random"):
+            dt_expr = kw_dtype if kw_dtype is not None else (
+                node.args[2] if len(node.args) > 2 else None
+            )
+            return _dtype_token(dt_expr, self.imports)
+        if tail in _DOT_TAILS or tail == "einsum":
+            for kw in node.keywords:
+                if kw.arg == "preferred_element_type":
+                    return _dtype_token(kw.value, self.imports)
+            out: Optional[str] = "weak"
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    continue  # einsum equation
+                out = self._promote(out, self._dt(a, env))
+            return None if out == "weak" else out
+        if name is not None and _DTYPE_TOKENS.get(name.split(".")[-1]) and node.args:
+            return _DTYPE_TOKENS[name.split(".")[-1]]  # jnp.float64(x)-style cast
+        return None
+
+    def _track_dtype(
+        self, stmt: ast.AST, qual: str,
+        held: Tuple[HeldEntry, ...], region_waived: frozenset,
+    ) -> None:
+        """Maintain the per-function dtype env across (Ann/Aug)Assign and
+        emit `narrow` events when an f64 binding takes a narrower value."""
+        env = self._envs.get(qual)
+        value = getattr(stmt, "value", None)
+        if env is None or value is None:
+            return
+        new_dt = self._expr_dtype(value, qual)
+        if isinstance(stmt, ast.AugAssign):
+            t = stmt.target
+            # `acc += f32_expr` on an f64 accumulator: the dtype survives the
+            # promotion but the ADDEND was computed at the narrow precision
+            if (
+                isinstance(t, ast.Name)
+                and env.get(t.id) == "f64"
+                and new_dt in ("f32", "bf16", "f16")
+            ):
+                self._emit(qual, "narrow", stmt, held=held, waiver_node=stmt,
+                           region_waived=region_waived, name=t.id,
+                           frm="f64", to=new_dt, aug=True)
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if env.get(t.id) == "f64" and new_dt in ("f32", "bf16", "f16"):
+                    self._emit(qual, "narrow", stmt, held=held, waiver_node=stmt,
+                               region_waived=region_waived, name=t.id,
+                               frm="f64", to=new_dt, aug=False)
+                if new_dt is not None:
+                    env[t.id] = new_dt
+                else:
+                    env.pop(t.id, None)
+            else:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        env.pop(sub.id, None)
+
+    def _operand_desc(self, expr: ast.AST, qual: str) -> Dict[str, Any]:
+        """Dtype descriptor for a dot operand / call argument: a locally
+        evident dtype, a bare parameter reference (resolved interprocedurally
+        in pass 2), or unknown."""
+        dt = self._expr_dtype(expr, qual)
+        if dt is not None:
+            return {"dt": dt}
+        inner = expr
+        while isinstance(inner, ast.Attribute) and inner.attr in _DTYPE_TRANSPARENT_ATTRS:
+            inner = inner.value
+        if isinstance(inner, ast.Name) and inner.id in self._params.get(qual, []):
+            return {"param": inner.id}
+        return {"dt": None}
+
+    def _numeric_events(
+        self, node: ast.Call, qual: str, dotted_name: Optional[str],
+        tail: Optional[str], held: Tuple[HeldEntry, ...], region_waived: frozenset,
+    ) -> None:
+        jaxish = dotted_name is not None and dotted_name.startswith("jax")
+        if jaxish and (tail in _DOT_TAILS or tail == "einsum"):
+            pref: Optional[str] = None
+            for kw in node.keywords:
+                if kw.arg == "preferred_element_type":
+                    pref = _dtype_token(kw.value, self.imports) or "dynamic"
+            args = [
+                a for a in node.args
+                if not (isinstance(a, ast.Constant) and isinstance(a.value, str))
+            ]
+            self._emit(qual, "lowdot", node, held=held, waiver_node=node,
+                       region_waived=region_waived, op=tail,
+                       args=[self._operand_desc(a, qual) for a in args[:4]],
+                       pref=pref)
+        f64 = False
+        if (
+            tail == "astype"
+            and node.args
+            and _dtype_token(node.args[0], self.imports) == "f64"
+            and _is_jax_dtype(node.args[0], self.imports)
+        ):
+            f64 = True  # x.astype(jnp.float64) — device-side widening intent
+        elif jaxish and tail is not None and _DTYPE_TOKENS.get(tail) == "f64":
+            f64 = True  # jnp.float64(x)
+        elif jaxish:
+            dt_expr = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt_expr = kw.value
+            if dt_expr is None and tail in _DTYPE_CTORS and len(node.args) > 1:
+                dt_expr = node.args[1]
+            if dt_expr is not None and _dtype_token(dt_expr, self.imports) == "f64":
+                f64 = True  # jnp ctor/sampler typed float64
+        if f64:
+            self._emit(qual, "f64", node, held=held, waiver_node=node,
+                       region_waived=region_waived, x64=self._x64_depth > 0)
+
     def _call_event(
         self, node: ast.Call, qual: str, cls: Optional[str],
         held: Tuple[HeldEntry, ...], region_waived: frozenset,
@@ -499,9 +815,20 @@ class _FactsBuilder:
         if block is not None:
             self._emit(qual, "block", node, held=held, waiver_node=node,
                        region_waived=region_waived, **block)
+        self._numeric_events(node, qual, dotted, tail, held, region_waived)
         spec = self._target_spec(node, cls)
         if spec is not None:
+            # positional alignment is broken past a *args splat: stop there,
+            # so param_dtypes only ever meets a dtype into the parameter
+            # that actually receives it (later params fall off the list and
+            # resolve to unknown)
+            argdt: List[Dict[str, Any]] = []
+            for a in node.args[:8]:
+                if isinstance(a, ast.Starred):
+                    break
+                argdt.append(self._operand_desc(a, qual))
             self._emit(qual, "call", node, held=held, target=spec,
+                       argdt=argdt, x64=self._x64_depth > 0,
                        waiver_node=node, region_waived=region_waived)
 
     def _block_op(
@@ -597,6 +924,8 @@ class Program:
         self._trans_acq: Optional[Dict[str, Dict[str, Any]]] = None
         self._may_blk: Optional[Dict[str, Dict[str, Any]]] = None
         self._entry_held: Optional[Dict[str, Set[str]]] = None
+        self._param_dt: Optional[Dict[str, Dict[str, Optional[str]]]] = None
+        self._entry_x64: Optional[Dict[str, bool]] = None
 
     # -- call resolution ---------------------------------------------------
     def _module_of_dotted_head(self, head: str) -> Optional[str]:
@@ -804,6 +1133,79 @@ class Program:
 
     def lock_kind(self, lock_id: str) -> str:
         return self.locks.get(lock_id, {}).get("kind", "lock")
+
+    # -- numerics fixpoints (rules/numerics.py pass 2) ----------------------
+    def param_dtypes(self) -> Dict[str, Dict[str, Optional[str]]]:
+        """qual -> {param: dtype token} where EVERY resolved in-program call
+        site passes that dtype (meet over sites — an unknown or conflicting
+        site poisons the param to None). Like `entry_held`, this only ever
+        PROVES a dtype, never assumes one: a low-precision param finding
+        requires every caller to agree."""
+        if self._param_dt is not None:
+            return self._param_dt
+        sites: Dict[str, List[Tuple[str, List[Dict[str, Any]]]]] = {}
+        for qual, fn in self.functions.items():
+            for ev in fn["events"]:
+                if ev["t"] == "call" and ev.get("callee") and ev.get("argdt") is not None:
+                    sites.setdefault(ev["callee"], []).append((qual, ev["argdt"]))
+        result: Dict[str, Dict[str, Optional[str]]] = {
+            q: {p: None for p in fn.get("params", [])}
+            for q, fn in self.functions.items()
+        }
+
+        def resolve(caller: str, desc: Dict[str, Any]) -> Optional[str]:
+            if "param" in desc:
+                return result.get(caller, {}).get(desc["param"])
+            return desc.get("dt")
+
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for callee, callers in sites.items():
+                params = self.functions[callee].get("params", [])
+                for i, p in enumerate(params):
+                    met: Optional[str] = "unseen"
+                    for caller, argdt in callers:
+                        tok = resolve(caller, argdt[i]) if i < len(argdt) else None
+                        if tok is None:
+                            met = None
+                            break
+                        met = tok if met == "unseen" else (met if met == tok else None)
+                        if met is None:
+                            break
+                    new = None if met == "unseen" else met
+                    if result[callee].get(p) != new:
+                        result[callee][p] = new
+                        changed = True
+            if not changed:
+                break
+        self._param_dt = result
+        return result
+
+    def entry_x64(self) -> Dict[str, bool]:
+        """qual -> True iff the function is only ever reached through
+        x64-guarded code: every resolved in-program call site is lexically
+        under an x64 guard, or its caller is itself entry-guarded."""
+        if self._entry_x64 is not None:
+            return self._entry_x64
+        callers: Dict[str, List[Tuple[str, bool]]] = {}
+        for qual, fn in self.functions.items():
+            for ev in fn["events"]:
+                if ev["t"] == "call" and ev.get("callee"):
+                    callers.setdefault(ev["callee"], []).append(
+                        (qual, bool(ev.get("x64")))
+                    )
+        guarded: Dict[str, bool] = {q: False for q in self.functions}
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for callee, sites in callers.items():
+                new = all(x64 or guarded.get(caller, False) for caller, x64 in sites)
+                if new != guarded.get(callee, False):
+                    guarded[callee] = new
+                    changed = True
+            if not changed:
+                break
+        self._entry_x64 = guarded
+        return guarded
 
 
 def build_program(facts_by_file: Dict[str, Optional[Dict[str, Any]]]) -> Program:

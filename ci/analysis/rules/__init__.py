@@ -13,11 +13,13 @@ from .blocking import BlockingRule
 from .concurrency import BlockingUnderLockRule, GuardDisciplineRule, LockOrderRule
 from .distance import RawDistanceRule
 from .exporter import ExporterScopeRule
+from .histogram import HistogramLoopRule
 from .hostsync import HostSyncRule
 from .hygiene import KNOWN_WAIVER_TAGS, HygieneRule
 from .jsonl import JsonlRule
 from .ledger import LedgerBypassRule
 from .memstats import MemStatsRule
+from .numerics import PrecisionFlowRule, PrngDisciplineRule
 from .padrows import PadRowsRule
 from .purity import TracedImpurityRule
 from .registries import ConfigKeyRule, MetricNameRule
@@ -42,6 +44,7 @@ def default_rules() -> List[RuleBase]:
         HostSyncRule(),
         TracedImpurityRule(),
         RawDistanceRule(),
+        HistogramLoopRule(),
         ServeDispatchRule(),
         LedgerBypassRule(),
         ExporterScopeRule(),
@@ -51,6 +54,9 @@ def default_rules() -> List[RuleBase]:
         LockOrderRule(),
         BlockingUnderLockRule(),
         GuardDisciplineRule(),
+        # --- whole-program numerics rules (pass-2 over program.py) -------
+        PrecisionFlowRule(),
+        PrngDisciplineRule(),
     ]
     # the hygiene waiver-form check must know every tag the catalog uses
     tags = {r.waiver for r in rules if r.waiver}
@@ -80,4 +86,7 @@ __all__ = [
     "LockOrderRule",
     "BlockingUnderLockRule",
     "GuardDisciplineRule",
+    "PrecisionFlowRule",
+    "PrngDisciplineRule",
+    "HistogramLoopRule",
 ]

@@ -32,6 +32,9 @@ KNOWN_WAIVER_TAGS = {
     "lock-order",
     "held",
     "guard",
+    "precision",
+    "prng",
+    "histogram",
 }
 
 

@@ -1,0 +1,506 @@
+#
+# Numerics gate: two interprocedural rules over the pass-1 whole-program
+# model (ci/analysis/program.py) guarding the framework's headline numeric
+# contracts — streaming==resident at rtol 1e-9, bit-identical checkpoint
+# resume, batched==sequential sweeps, per-partition datagen bit-identity for
+# any process count, and the per-model bf16 serving accuracy contract
+# (docs/robustness.md "Numerics contract"):
+#
+#   precision-flow    a dtype lattice (f64/f32/bf16/f16) threaded through
+#                     local bindings and resolved calls. Three findings:
+#                     (1) silent narrowing into an accumulator — an
+#                     f64-bound local reassigned or augmented with an
+#                     f32/bf16/f16 expression; (2) a low-precision dot —
+#                     `dot`/`matmul`/`einsum`/`tensordot`/Pallas `pl.dot`/
+#                     the `@` operator on a bf16/f16 operand (locally
+#                     evident, or proven via the param-dtype meet over every
+#                     resolved call site) without a `preferred_element_type`
+#                     of f32-or-wider — one-pass MXU bf16 carries ~3 decimal
+#                     digits, the accuracy cliff docs/serving.md documents;
+#                     (3) a jnp-level float64 constant/cast/ctor reachable
+#                     without the x64 guard (`enable_x64`/`x64_scope`
+#                     context, a `jax_enable_x64` conditional, or every
+#                     resolved call site guarded) — with
+#                     `jax_enable_x64=False` those silently run at f32.
+#                     Sanctioned sites (ops/distance.py's parity-tested
+#                     fast-bf16 path) waive `# precision-ok: <reason>`.
+#
+#   prng-discipline   linearity checking of `jax.random` keys, per function:
+#                     a key consumed twice (two sampling sinks, or sampled
+#                     after being `split`) draws correlated streams; a
+#                     `split`/`fold_in` result that is never bound is
+#                     entropy minted and dropped; a key seeded from
+#                     wall-clock/`os.urandom`/process identity — or any
+#                     legacy global `np.random.*` call — breaks the
+#                     per-partition datagen bit-identity contract
+#                     (benchmark/gen_data* is in scope for exactly that
+#                     reason); and rank-dependent key derivation
+#                     (`PRNGKey(seed + rank)`, `fold_in(key, rank)`) in a
+#                     function that reaches a rendezvous collective
+#                     (composing with the PR-9 spmd facts via
+#                     `program.may_block`) seeds divergent streams where the
+#                     SPMD lockstep contract requires agreement. Deliberate
+#                     per-rank sampling (RF bagging, UMAP negative-sample
+#                     salts) waives `# prng-ok: <reason>`.
+#
+# The runtime twin (spark_rapids_ml_tpu/utils/numcheck.py, SRML_NUMCHECK=1)
+# asserts finite-ness and records dtype watermarks at the solver boundaries
+# that already host-fetch — the static pass proposes, the sanitizer verifies,
+# exactly the lockcheck pattern.
+#
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..engine import FileContext, Finding, RuleBase, Run, dotted
+from ..program import module_path
+from .spmd import _mentions_rank
+
+# --------------------------------------------------------- precision-flow --
+
+_LOW = ("bf16", "f16")
+
+
+class PrecisionFlowRule(RuleBase):
+    id = "precision-flow"
+    waiver = "precision"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    description = (
+        "silent f64->f32/bf16 narrowing into accumulators, low-precision "
+        "dot-like ops without preferred_element_type, and unguarded jnp f64"
+    )
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        pass  # pass-1 facts carry everything; findings come from finalize
+
+    def finalize(self, run: Run) -> List[Finding]:
+        program = getattr(run, "program", None)
+        if program is None:
+            return []
+        param_dt = program.param_dtypes()
+        entry_x64 = program.entry_x64()
+        out: List[Finding] = []
+        for qual, fn in program.functions.items():
+            for ev in fn["events"]:
+                if "precision" in ev.get("waived", []):
+                    continue
+                if ev["t"] == "narrow":
+                    how = (
+                        "augmented with"
+                        if ev.get("aug")
+                        else "reassigned"
+                    )
+                    out.append(
+                        Finding(
+                            fn["relpath"], ev["line"], ev["col"], self.id,
+                            f"f64 accumulator `{ev['name']}` {how} "
+                            f"a {ev['to']} expression in `{qual}` — silent "
+                            "precision narrowing breaks the rtol-1e-9 "
+                            "solver contracts; widen the expression "
+                            "(accumulate at f64), or mark "
+                            "`# precision-ok: <reason>`",
+                        )
+                    )
+                elif ev["t"] == "lowdot":
+                    toks = [
+                        self._resolve(d, qual, param_dt) for d in ev.get("args", [])
+                    ]
+                    low = sorted({t for t in toks if t in _LOW})
+                    pref = ev.get("pref")
+                    if low and (pref is None or pref in _LOW):
+                        op = ev["op"]
+                        fix = (
+                            "spell the accumulation dtype with "
+                            "`preferred_element_type=jnp.float32`"
+                            if op != "@"
+                            else "use jnp.matmul/lax.dot with "
+                            "`preferred_element_type=jnp.float32` instead "
+                            "of the `@` operator"
+                        )
+                        out.append(
+                            Finding(
+                                fn["relpath"], ev["line"], ev["col"], self.id,
+                                f"`{op}` on {'/'.join(low)} operand(s) "
+                                "without an f32-or-wider "
+                                f"preferred_element_type in `{qual}` — "
+                                "one-pass MXU bf16 accumulation carries ~3 "
+                                f"decimal digits; {fix}, or mark "
+                                "`# precision-ok: <reason>`",
+                            )
+                        )
+                elif ev["t"] == "f64":
+                    if ev.get("x64") or entry_x64.get(qual):
+                        continue
+                    out.append(
+                        Finding(
+                            fn["relpath"], ev["line"], ev["col"], self.id,
+                            f"jnp-level float64 in `{qual}` reachable "
+                            "without the x64 guard — with "
+                            "jax_enable_x64=False this silently computes at "
+                            "f32; run it under `enable_x64`/`x64_scope` "
+                            "(parallel/mesh.py owns the guard), or mark "
+                            "`# precision-ok: <reason>`",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _resolve(
+        desc: Dict[str, Any], qual: str,
+        param_dt: Dict[str, Dict[str, Optional[str]]],
+    ) -> Optional[str]:
+        if "param" in desc:
+            return param_dt.get(qual, {}).get(desc["param"])
+        return desc.get("dt")
+
+
+# -------------------------------------------------------- prng-discipline --
+
+# jax.random calls that CONSUME their key (linearity: at most one per key
+# binding) — sampling primitives plus `split` (drawing from a key after
+# splitting it correlates with the children, the classic reuse bug)
+_CONSUMING_TAILS = {
+    "split", "normal", "uniform", "randint", "choice", "categorical",
+    "bernoulli", "permutation", "shuffle", "truncated_normal", "gamma",
+    "beta", "exponential", "laplace", "gumbel", "rademacher", "bits",
+    "dirichlet", "poisson", "multivariate_normal", "orthogonal", "ball",
+}
+# derivation that does NOT consume: `fold_in(key, i)` with distinct data is
+# the sanctioned many-streams-from-one-key pattern (per-partition datagen,
+# per-tree bagging)
+_ENTROPY_SOURCES = {
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "os.urandom", "os.getpid", "uuid.uuid4", "secrets.token_bytes",
+    "secrets.randbits",
+}
+# legacy global-state numpy RNG surface; the sanctioned form is
+# `np.random.default_rng(<explicit seed>)`
+_NP_GLOBAL_TAILS = {
+    "seed", "normal", "uniform", "rand", "randn", "randint", "random",
+    "choice", "shuffle", "permutation", "standard_normal",
+}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class PrngDisciplineRule(RuleBase):
+    id = "prng-discipline"
+    waiver = "prng"
+    tree_scope = ("spark_rapids_ml_tpu", "benchmark")
+    description = (
+        "jax.random key reuse/dropped splits, nondeterministic or global-RNG "
+        "seeding, and rank-dependent keys in lockstep (collective) functions"
+    )
+
+    def __init__(self) -> None:
+        # relpath -> deferred rank-dependent mint candidates, resolved in
+        # finalize against the whole-program collective-reachability facts
+        self._deferred: Dict[str, List[Dict[str, Any]]] = {}
+        self._file_emitted: set = set()
+
+    def applies(self, ctx: FileContext) -> bool:
+        if not super().applies(ctx):
+            return False
+        if ctx.target == "benchmark":
+            # only the datagen family carries the bit-identity contract
+            return ctx.filename.startswith("gen_data")
+        return True
+
+    def file_state(self, relpath: str):
+        state = self._deferred.get(relpath)
+        return list(state) if state else None
+
+    def restore_state(self, relpath: str, state) -> None:
+        self._deferred[relpath] = list(state)
+
+    # ------------------------------------------------------------ traversal
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        mod = module_path(ctx.relpath)
+        # finding dedup is FILE-scoped, not scope-scoped: the loop bodies'
+        # double scan re-enters nested scopes too, and a per-scope set would
+        # double-report everything inside a closure defined in a loop
+        self._file_emitted: set = set()
+        self._scan_scope(tree.body, ctx, mod, None)
+
+    def _scan_scope(
+        self, body: List[ast.stmt], ctx: FileContext, qual: str,
+        cls: Optional[str],
+    ) -> None:
+        """One function (or module) scope: a fresh linear key-consumption
+        state; nested defs/classes recurse with fresh scopes (a nested
+        function's `key` parameter is a new binding, not the outer key)."""
+        state: Dict[str, Any] = {"consumed": {}}
+        self._scan_block(body, ctx, qual, cls, state, in_loop=False)
+
+    def _scan_block(
+        self, stmts: List[ast.stmt], ctx: FileContext, qual: str,
+        cls: Optional[str], state: Dict[str, Any], in_loop: bool,
+    ) -> None:
+        for stmt in stmts:
+            self._scan_stmt(stmt, ctx, qual, cls, state, in_loop)
+
+    def _scan_stmt(
+        self, stmt: ast.stmt, ctx: FileContext, qual: str,
+        cls: Optional[str], state: Dict[str, Any], in_loop: bool,
+    ) -> None:
+        if isinstance(stmt, _FUNC_NODES):
+            # nested def: fresh scope, named `<qual>.<name>` exactly as the
+            # program model names it (finalize joins on these quals)
+            self._scan_scope(stmt.body, ctx, f"{qual}.{stmt.name}", None)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            # methods are `<module>.<Class[.Nested]>.<method>`; `qual` here
+            # is still the module path (classes only appear at module level
+            # or nested in other classes in this tree)
+            cname = stmt.name if cls is None else f"{cls}.{stmt.name}"
+            for sub in stmt.body:
+                if isinstance(sub, _FUNC_NODES):
+                    self._scan_scope(sub.body, ctx, f"{qual}.{cname}.{sub.name}", None)
+                elif isinstance(sub, ast.ClassDef):
+                    self._scan_stmt(sub, ctx, qual, cname, state, in_loop)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_exprs([stmt.test], ctx, qual, state, in_loop)
+            snap = dict(state["consumed"])
+            self._scan_block(stmt.body, ctx, qual, cls, state, in_loop)
+            after_body = state["consumed"]
+            state["consumed"] = dict(snap)
+            self._scan_block(stmt.orelse, ctx, qual, cls, state, in_loop)
+            # after the conditional: a key consumed in EITHER arm counts as
+            # consumed (and a key consumed in both arms was consumed once
+            # per execution — not a reuse)
+            merged = dict(state["consumed"])
+            merged.update(after_body)
+            state["consumed"] = merged
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            header = [stmt.iter] if isinstance(stmt, (ast.For, ast.AsyncFor)) else [stmt.test]
+            self._scan_exprs(header, ctx, qual, state, in_loop)
+            # scan the body TWICE: the second pass sees the consumption state
+            # the first iteration left behind, so sampling an outer-scope key
+            # inside the loop is caught as cross-iteration reuse, while a key
+            # re-split/re-minted inside the body stays clean. Findings
+            # deduplicate via the per-file emitted set. The loop TARGET is a
+            # fresh binding each iteration (`for sub in split(key, n):` is
+            # the sanctioned batch-split idiom) — clear it before each pass.
+            targets = (
+                [n.id for n in ast.walk(stmt.target) if isinstance(n, ast.Name)]
+                if isinstance(stmt, (ast.For, ast.AsyncFor))
+                else []
+            )
+            for _ in range(2):
+                for name in targets:
+                    state["consumed"].pop(name, None)
+                self._scan_block(stmt.body, ctx, qual, cls, state, in_loop=True)
+            self._scan_block(stmt.orelse, ctx, qual, cls, state, in_loop)
+            return
+        if isinstance(stmt, ast.Try):
+            snap = dict(state["consumed"])
+            self._scan_block(stmt.body, ctx, qual, cls, state, in_loop)
+            merged = dict(state["consumed"])
+            for handler in stmt.handlers:
+                state["consumed"] = dict(snap)
+                self._scan_block(handler.body, ctx, qual, cls, state, in_loop)
+                merged.update(state["consumed"])
+            state["consumed"] = merged
+            self._scan_block(stmt.orelse, ctx, qual, cls, state, in_loop)
+            self._scan_block(stmt.finalbody, ctx, qual, cls, state, in_loop)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._scan_exprs(
+                [i.context_expr for i in stmt.items], ctx, qual, state, in_loop
+            )
+            self._scan_block(stmt.body, ctx, qual, cls, state, in_loop)
+            return
+        # dropped derivation: a bare `jax.random.split(key)` statement mints
+        # subkeys nobody binds
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            name = dotted(stmt.value.func, ctx.imports)
+            if name in ("jax.random.split", "jax.random.fold_in"):
+                self._emit_once(
+                    ctx, state, stmt.value, "drop",
+                    f"`{name.rsplit('.', 1)[1]}` result is never bound — "
+                    "freshly derived subkeys are dropped (either use them or "
+                    "delete the call); mark `# prng-ok: <reason>` if "
+                    "deliberate",
+                )
+        # expressions first (uses), then bindings (rebind resets linearity)
+        exprs: List[ast.AST] = []
+        for field in ("value", "test", "exc", "msg", "cause"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, ast.AST):
+                exprs.append(v)
+        self._scan_exprs(exprs, ctx, qual, state, in_loop)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                for node in ast.walk(t):
+                    if isinstance(node, ast.Name):
+                        state["consumed"].pop(node.id, None)
+
+    def _scan_exprs(
+        self, exprs: List[ast.AST], ctx: FileContext, qual: str,
+        state: Dict[str, Any], in_loop: bool,
+    ) -> None:
+        for expr in exprs:
+            if expr is None:
+                continue
+            for node in ast.walk(expr):
+                if isinstance(node, (ast.Lambda,) + _FUNC_NODES):
+                    continue
+                if isinstance(node, ast.NamedExpr) and isinstance(
+                    node.target, ast.Name
+                ):
+                    state["consumed"].pop(node.target.id, None)
+                if isinstance(node, ast.Call):
+                    self._check_call(node, ctx, qual, state)
+
+    # ------------------------------------------------------------- checks --
+
+    def _check_call(
+        self, node: ast.Call, ctx: FileContext, qual: str,
+        state: Dict[str, Any],
+    ) -> None:
+        name = dotted(node.func, ctx.imports)
+        if name is None:
+            return
+        tail = name.rsplit(".", 1)[-1]
+        if name.startswith("jax.random."):
+            self._check_entropy(node, ctx, state, tail)
+            self._check_rank_dep(node, ctx, qual, state, name, tail)
+            if tail in _CONSUMING_TAILS and node.args:
+                key = node.args[0]
+                if isinstance(key, ast.Name):
+                    first = state["consumed"].get(key.id)
+                    if first is not None:
+                        self._emit_once(
+                            ctx, state, node, "reuse",
+                            f"key `{key.id}` already consumed by "
+                            f"`{first[2]}` at line {first[0]} is consumed "
+                            f"again by `{tail}` — reusing a jax.random key "
+                            "draws correlated streams; split first, or mark "
+                            "`# prng-ok: <reason>`",
+                        )
+                    else:
+                        state["consumed"][key.id] = (
+                            node.lineno, node.col_offset + 1, tail
+                        )
+            return
+        if name.startswith("numpy.random."):
+            if tail == "default_rng":
+                if not node.args and not node.keywords:
+                    self._emit_once(
+                        ctx, state, node, "unseeded",
+                        "`np.random.default_rng()` without an explicit seed "
+                        "— OS-entropy seeding breaks the per-partition "
+                        "datagen bit-identity contract; pass a seed derived "
+                        "from the partition/config, or mark "
+                        "`# prng-ok: <reason>`",
+                    )
+                else:
+                    self._check_entropy(node, ctx, state, tail)
+                    self._check_rank_dep(node, ctx, qual, state, name, tail)
+            elif tail in _NP_GLOBAL_TAILS:
+                self._emit_once(
+                    ctx, state, node, "global-rng",
+                    f"legacy global-state `np.random.{tail}` — hidden "
+                    "process-wide RNG state is not reproducible per "
+                    "partition; use `np.random.default_rng(<seed>)`, or "
+                    "mark `# prng-ok: <reason>`",
+                )
+
+    def _check_entropy(
+        self, node: ast.Call, ctx: FileContext, state: Dict[str, Any],
+        tail: str,
+    ) -> None:
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Call):
+                    src = dotted(sub.func, ctx.imports)
+                    if src in _ENTROPY_SOURCES:
+                        self._emit_once(
+                            ctx, state, node, "entropy",
+                            f"`{tail}` seeded from `{src}()` — wall-clock/"
+                            "OS-entropy seeds are not reproducible and break "
+                            "the per-partition datagen bit-identity "
+                            "contract; derive the seed from config + "
+                            "partition id, or mark `# prng-ok: <reason>`",
+                        )
+                        return
+
+    def _check_rank_dep(
+        self, node: ast.Call, ctx: FileContext, qual: str,
+        state: Dict[str, Any], name: str, tail: str,
+    ) -> None:
+        """Defer rank-dependent key minting (`PRNGKey(seed + rank)`,
+        `fold_in(key, rank)`, `default_rng(seed * p + rank)`) to finalize —
+        it is only a finding when the enclosing function participates in the
+        SPMD lockstep (reaches a rendezvous collective, per the program
+        model's may_block facts)."""
+        if tail not in ("PRNGKey", "key", "fold_in", "default_rng"):
+            return
+        seed_args = node.args[1:] if tail == "fold_in" else node.args[:1]
+        rank_id = None
+        for a in seed_args:
+            rank_id = _mentions_rank(a)
+            if rank_id:
+                break
+        if not rank_id:
+            return
+        dedup = ("rankdep", node.lineno, node.col_offset + 1)
+        if dedup in self._file_emitted:
+            return
+        self._file_emitted.add(dedup)
+        self._deferred.setdefault(ctx.relpath, []).append(
+            {
+                "line": node.lineno,
+                "col": node.col_offset + 1,
+                "qual": qual,
+                "tail": tail,
+                "rank_id": rank_id,
+                "waived": ctx.waived(self.waiver, node),
+            }
+        )
+
+    def _emit_once(
+        self, ctx: FileContext, state: Dict[str, Any], node: ast.AST,
+        kind: str, message: str,
+    ) -> None:
+        dedup = (kind, getattr(node, "lineno", 1), getattr(node, "col_offset", 0) + 1)
+        if dedup in self._file_emitted:
+            return
+        self._file_emitted.add(dedup)
+        ctx.emit(self, node, message)
+
+    # ------------------------------------------------------------ finalize --
+
+    def finalize(self, run: Run) -> List[Finding]:
+        program = getattr(run, "program", None)
+        may_block = program.may_block() if program is not None else {}
+        out: List[Finding] = []
+        for relpath, cands in sorted(self._deferred.items()):
+            for c in cands:
+                if c.get("waived"):
+                    continue
+                ops = may_block.get(c["qual"], {})
+                collective = next(
+                    (op for op in sorted(ops) if "rendezvous round" in op), None
+                )
+                if collective is None:
+                    continue  # not a lockstep function: per-rank keys are fine
+                out.append(
+                    Finding(
+                        relpath, c["line"], c["col"], self.id,
+                        f"rank-dependent key derivation (`{c['tail']}` over "
+                        f"`{c['rank_id']}`) in `{c['qual']}`, which reaches "
+                        f"a collective ({collective}) — the SPMD lockstep "
+                        "contract requires every rank to agree on "
+                        "key-derived values; derive the key from data/"
+                        "partition identity instead, or mark "
+                        "`# prng-ok: <reason>` for deliberate per-rank "
+                        "sampling whose results are later gathered",
+                    )
+                )
+        return out

@@ -40,6 +40,24 @@ print(f"lockcheck: {len(rep['locks'])} locks, {len(rep['edges'])} edges, "
 sys.exit(1 if rep["inversions"] else 0)  # zero-inversion acceptance gate
 PY
 
+echo "== numerics sanitizer lanes (SRML_NUMCHECK=1 over the solver/streaming/serving/segmented families; report archived)"
+# test_recovery drives run_segmented_while, so the segment.* checkpoint
+# boundary is exercised by the gate (test_numcheck's own segment trips are
+# deliberately discarded by its snapshot/restore fixture)
+SRML_NUMCHECK=1 SRML_NUMCHECK_REPORT="$ARTIFACTS/numcheck_report.json" \
+  python -m pytest tests/test_kmeans.py tests/test_oocore.py tests/test_serving.py \
+    tests/test_recovery.py tests/test_numcheck.py -q
+python - "$ARTIFACTS/numcheck_report.json" <<'PY'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+print(f"numcheck: {rep['checks']} boundary checks, {len(rep['trips'])} trip(s), "
+      f"{len(rep['watermarks'])} watermarked stage(s)")
+if rep["checks"] == 0:
+    print("numcheck: 0 checks — the instrumented lanes did not exercise the hook")
+    sys.exit(1)
+sys.exit(1 if rep["trips"] else 0)  # zero-trip acceptance gate
+PY
+
 if [[ "${1:-}" == "--nightly" ]]; then
   echo "== nightly: full suite incl. large-scale slow tests"
   python -m pytest tests/ -q --runslow

@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 from .errors import (  # noqa: F401
     HbmBudgetError,
     IngestValidationError,
+    NumericsError,
     PreemptedError,
     RankFailedError,
     RendezvousTimeoutError,
@@ -62,6 +63,7 @@ __all__ = [
     "SolverDivergedError",
     "IngestValidationError",
     "HbmBudgetError",
+    "NumericsError",
     "PreemptedError",
     "SchedulerSaturatedError",
     "device_dataset_scope",

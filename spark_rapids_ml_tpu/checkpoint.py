@@ -286,6 +286,11 @@ def run_segmented_while(
 
     seg_j = jax.jit(_segment)
     from .parallel import chaos
+    from .utils import numcheck
+
+    # runtime numerics sanitizer (SRML_NUMCHECK=1): resolved once per loop;
+    # sweeps the checkpoint's already-host-fetched leaves at each boundary
+    _nc = numcheck.hook()
 
     while bool(cond_j(state)):  # host-fetch-ok: one probe per checkpoint SEGMENT (every_iters inner iterations), not per solver step
         it_now = int(np.asarray(it_of(state)))  # host-fetch-ok: segment-boundary counter read, cadence-bounded
@@ -294,6 +299,14 @@ def run_segmented_while(
         if store is not None:
             leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(state)]
             it_after = int(np.asarray(it_of(state)))  # host-fetch-ok: the checkpoint itself — state must land on host to survive the process
+            if _nc is not None:
+                # a NaN leaf here would poison every later resume of this
+                # trajectory; the bytes are already on host. allow_inf: the
+                # GLM/CD states carry deliberate `jnp.inf` sentinels
+                # (best-loss initializers, padding)
+                _nc(f"segment.{solver}", solver=solver, iteration=it_after,
+                    allow_inf=True,
+                    **{f"leaf{i}": lv for i, lv in enumerate(leaves)})
             store.save(key, SolverCheckpoint(
                 solver=solver, iteration=it_after,
                 state={"leaves": leaves}, placement_key=placement_key,

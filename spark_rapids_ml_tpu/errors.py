@@ -34,6 +34,17 @@
 #   │                            place, so `is_transient` stays False —
 #   │                            the scheduler, not `retryable_stage`, owns
 #   │                            the resume
+#   ├── NumericsError            permanent — the opt-in runtime numerics
+#   │                            sanitizer (utils/numcheck.py,
+#   │                            SRML_NUMCHECK=1) found NaN/Inf at a solver
+#   │                            boundary that already host-fetches; carries
+#   │                            solver/iteration/stage + which value
+#   │                            tripped, so the break is named at the
+#   │                            boundary it crossed, not iterations later.
+#   │                            Distinguish from SolverDivergedError: that
+#   │                            is the always-on convergence guard on
+#   │                            scalars the solver fetches anyway; this is
+#   │                            the opt-in sweep of everything else
 #   └── SchedulerSaturatedError  permanent — a submitted job's SMALLEST
 #                                possible footprint (the streaming floor, or
 #                                the resident estimate when the estimator
@@ -59,6 +70,7 @@ __all__ = [
     "SolverDivergedError",
     "IngestValidationError",
     "HbmBudgetError",
+    "NumericsError",
     "PreemptedError",
     "SchedulerSaturatedError",
     "is_transient",
@@ -234,6 +246,46 @@ class HbmBudgetError(SrmlError, MemoryError):
                 else f"largest term: {largest_term} = {self.largest_term_bytes} bytes"
             )
             parts.append(f"[{lt}]")
+        super().__init__(" ".join(parts))
+
+
+class NumericsError(SrmlError, ArithmeticError):
+    """The runtime numerics sanitizer (``spark_rapids_ml_tpu.utils.numcheck``,
+    opt-in via ``SRML_NUMCHECK=1``) found a non-finite value at a solver
+    boundary that already host-fetches — a k-means cadence fetch, a
+    ``run_segmented_while`` segment boundary, a streaming chunk boundary, or
+    the serving response assembly. PERMANENT: like `SolverDivergedError`, a
+    retry re-runs the same arithmetic.
+
+    Carries ``stage`` (the boundary's name, e.g. ``kmeans.iterate``),
+    ``solver``, ``iteration``, ``value_name`` (which checked value tripped),
+    and ``detail`` (NaN/Inf counts) so the report points at the exact
+    boundary the non-finite value crossed."""
+
+    def __init__(
+        self,
+        stage: str,
+        *,
+        solver: str = "",
+        iteration: Optional[int] = None,
+        value_name: str = "",
+        detail: str = "",
+    ):
+        # attributes BEFORE super().__init__: the flight-recorder hook fires
+        # inside it and records whatever diagnostic fields are already set
+        self.stage = stage
+        self.solver = solver
+        self.iteration = None if iteration is None else int(iteration)
+        self.value_name = value_name
+        self.detail = detail
+        parts = [f"non-finite value at numerics boundary {stage!r}"]
+        if solver:
+            at = f" iteration {self.iteration}" if self.iteration is not None else ""
+            parts.append(f"(solver {solver}{at})")
+        if value_name:
+            parts.append(f"in {value_name!r}")
+        if detail:
+            parts.append(f"— {detail}")
         super().__init__(" ".join(parts))
 
 

@@ -242,7 +242,7 @@ class KMeans(_KMeansParams, _TpuEstimator):
                 cap = max(4 * k, 262_144 // inputs.ctx.nranks)
                 n_loc = x_host.shape[0]
                 if n_loc > cap:
-                    rs = np.random.default_rng(seed * 100_003 + inputs.ctx.rank)
+                    rs = np.random.default_rng(seed * 100_003 + inputs.ctx.rank)  # prng-ok: deliberate per-rank sampling of LOCAL rows; the allgather below hands every rank the identical union, so the seeded init agrees
                     sel = np.sort(rs.choice(n_loc, cap, replace=False))
                     xs = np.asarray(x_host[sel], dtype=np.float64)
                     ws = None if w_host is None else np.asarray(w_host[sel], dtype=np.float64)

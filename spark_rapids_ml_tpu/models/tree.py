@@ -207,7 +207,7 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                 cap = 100_000 // inputs.ctx.nranks
                 n_loc = x_host.shape[0]
                 if n_loc > cap:
-                    rs = np.random.default_rng(seed * 99_991 + inputs.ctx.rank)
+                    rs = np.random.default_rng(seed * 99_991 + inputs.ctx.rank)  # prng-ok: deliberate per-rank sampling of LOCAL sketch rows; the allgather below gives every rank the identical union, so all ranks derive the same bin edges
                     sel = np.sort(rs.choice(n_loc, cap, replace=False))
                     x_sketch = inputs.allgather_array(np.asarray(x_host[sel], dtype=np.float64))
                 else:

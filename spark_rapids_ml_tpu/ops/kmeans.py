@@ -251,6 +251,11 @@ def kmeans_fit(
     # point and the NaN/Inf check cost no extra device synchronization.
     prev_shift = None
     last_good = centers  # iterate entering the step that produced prev_shift
+    # runtime numerics sanitizer (SRML_NUMCHECK=1): resolved ONCE per solve;
+    # disabled = a None local, one `is not None` test per boundary
+    from ..utils import numcheck
+
+    _nc = numcheck.hook()
     # Solver checkpoints (docs/robustness.md "Elastic recovery"): the host
     # loop already fetches the shift scalar every iteration, so host-fetching
     # the centers at the configured cadence is near-free. Centers are
@@ -293,6 +298,12 @@ def kmeans_fit(
             shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (documented above) — overlapped with the current step's compute
             if not math.isfinite(shift_host):
                 _raise_diverged(n_iter - 1, last_good, f"center shift = {shift_host}")
+            if _nc is not None:
+                # AFTER the divergence guard (typed SolverDivergedError owns
+                # non-finite shifts); sweeps the already-fetched scalar and
+                # records the iterate's dtype watermark without a new fetch
+                _nc("kmeans.iterate", solver="kmeans", iteration=n_iter - 1,
+                    watermark=centers.dtype, shift=shift_host)
             if telemetry.enabled():
                 telemetry.record_convergence_point("kmeans.shift", n_iter - 1, shift_host)
             if shift_host <= tol:
@@ -305,10 +316,16 @@ def kmeans_fit(
             # round-trip exactly, so the resumed convergence pipeline sees
             # the same value the uninterrupted run would
             prev_shift = float(prev_shift)  # host-fetch-ok: checkpoint-cadence boundary (config["checkpoint_every_iters"])
+            centers_host = np.asarray(centers)  # host-fetch-ok: the checkpoint itself — replicated centers must land on host to survive
+            if _nc is not None:
+                # the checkpoint already fetched the full iterate: sweep it
+                # (a non-finite checkpoint would poison every later resume)
+                _nc("kmeans.checkpoint", solver="kmeans", iteration=n_iter,
+                    centers=centers_host)
             ckpt_store.save(ckpt_key, _ckpt.SolverCheckpoint(
                 solver="kmeans", iteration=n_iter,
                 state={
-                    "centers": np.asarray(centers),  # host-fetch-ok: the checkpoint itself — replicated centers must land on host to survive
+                    "centers": centers_host,
                     "prev_shift": prev_shift,
                     # the divergence-fallback iterate (one step behind)
                     "last_good": np.asarray(last_good),  # host-fetch-ok: checkpoint payload (one step behind, for divergence fallback)

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 
 from .. import telemetry
 from ..parallel.mesh import stream_place_blocks
+from ..utils import numcheck
 
 
 def _ranges(n: int, chunk_rows: int) -> List[Tuple[int, int]]:
@@ -160,6 +161,7 @@ def linear_streaming_stats(inputs: Any) -> Dict[str, np.ndarray]:
     w = np.asarray(inputs.w, dtype=dtype)
     extras = {"y": y, "w": w}
     acc: Optional[List[np.ndarray]] = None
+    _nc = numcheck.hook()  # SRML_NUMCHECK=1: sweep per-chunk host partials
     if inputs.X_sparse is not None:
         d = inputs.n_cols
         for blk in stream_place_blocks(
@@ -169,11 +171,17 @@ def linear_streaming_stats(inputs: Any) -> Dict[str, np.ndarray]:
                 blk["val"], blk["idx"], blk["y"], blk["w"], d=d, tile=8192
             )
             part = [np.asarray(p) for p in part]
+            if _nc is not None:
+                _nc("linear_stream.chunk", solver="linear_stream",
+                    **{n: p for n, p in zip(_STATS_NAMES, part)})
             acc = part if acc is None else [a + b for a, b in zip(acc, part)]
     else:
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, extras)):
             part = _stats_jit(blk["X"], blk["y"], blk["w"])
             part = [np.asarray(p) for p in part]
+            if _nc is not None:
+                _nc("linear_stream.chunk", solver="linear_stream",
+                    **{n: p for n, p in zip(_STATS_NAMES, part)})
             acc = part if acc is None else [a + b for a, b in zip(acc, part)]
     assert acc is not None, "streaming stats over an empty dataset"
     return {name: np.asarray(v) for name, v in zip(_STATS_NAMES, acc)}
@@ -253,9 +261,12 @@ def pca_fit_streaming(inputs: Any, *, k: int) -> Dict[str, jax.Array]:
     def compute() -> Dict[str, np.ndarray]:
         sw = None
         sx = None
+        _nc = numcheck.hook()  # SRML_NUMCHECK=1: sweep per-chunk host partials
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
             b_sw, b_sx, _ = _moments_block(blk["X"], blk["w"])
             b_sw, b_sx = np.asarray(b_sw), np.asarray(b_sx)  # host-fetch-ok: out-of-core by design — per-CHUNK moment partials accumulate on host (tiny [d]-sized payloads)
+            if _nc is not None:
+                _nc("pca_stream.chunk", solver="pca_stream", sum_w=b_sw, sum_x=b_sx)
             sw = b_sw if sw is None else sw + b_sw
             sx = b_sx if sx is None else sx + b_sx
         assert sw is not None
@@ -264,8 +275,12 @@ def pca_fit_streaming(inputs: Any, *, k: int) -> Dict[str, jax.Array]:
         cov_sum = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
             part = np.asarray(_cov_block(blk["X"], blk["w"], mean_dev))  # host-fetch-ok: out-of-core by design — per-CHUNK [d,d] covariance partial accumulates on host
+            if _nc is not None:
+                _nc("pca_stream.chunk", solver="pca_stream", cov_partial=part)
             cov_sum = part if cov_sum is None else cov_sum + part
         cov = cov_sum / (sw - 1.0)
+        if _nc is not None:
+            _nc("pca_stream.stats", solver="pca_stream", mean=mean, cov=cov)
         return {"total_w": np.asarray(sw), "mean": np.asarray(mean), "cov": cov}
 
     store = _ckpt.active_store()
@@ -316,12 +331,16 @@ def kmeans_fit_streaming(
     dtype = inputs.dtype
     w = np.asarray(inputs.w, dtype=dtype)
     centers = jnp.asarray(np.asarray(init_centers), dtype=dtype)
+    _nc = numcheck.hook()  # SRML_NUMCHECK=1: chunk partials + iterate boundary
 
     def step(c):
         sums = counts = inertia = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
             s, n_, i_ = block_assign_accumulate(blk["X"], blk["w"], c)
             s, n_, i_ = np.asarray(s), np.asarray(n_), np.asarray(i_)  # host-fetch-ok: out-of-core by design — per-CHUNK [k,d] assignment partials accumulate on host
+            if _nc is not None:
+                _nc("kmeans_stream.chunk", solver="kmeans_stream",
+                    sums=s, inertia=i_)
             if sums is None:
                 sums, counts, inertia = s, n_, i_
             else:
@@ -358,6 +377,11 @@ def kmeans_fit_streaming(
             shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (resident-loop parity) — overlapped with the current step's compute
             if not math.isfinite(shift_host):
                 _raise_diverged(n_iter - 1, last_good, f"center shift = {shift_host}")
+            if _nc is not None:
+                # after the divergence guard (resident-loop parity)
+                _nc("kmeans_stream.iterate", solver="kmeans_stream",
+                    iteration=n_iter - 1, watermark=centers.dtype,
+                    shift=shift_host)
             if telemetry.enabled():
                 telemetry.record_convergence_point("kmeans.shift", n_iter - 1, shift_host)
             if shift_host <= tol:
@@ -702,6 +726,7 @@ def logistic_fit_streaming(
     _two_loop = jax.jit(lbfgs_two_loop, static_argnums=(6,))
 
     trace_convergence = telemetry.convergence_trace_enabled()
+    _nc = numcheck.hook()  # SRML_NUMCHECK=1: outer-iteration boundary sweep
     while it < max_iter and not stalled:
         rel = abs(f_prev - f_cur) / max(abs(f_cur), 1.0)
         if not rel > tol:
@@ -782,6 +807,11 @@ def logistic_fit_streaming(
         x, z_blocks, g = xn, z_n_blocks, gn
         f_prev, f_cur = f_cur, f_new
         it += 1
+        if _nc is not None:
+            # objective, iterate, and gradient are host state already —
+            # the outer L-BFGS iteration IS the host boundary here
+            _nc("glm_stream.iterate", solver="glm_qn_stream", iteration=it - 1,
+                objective=f_cur, iterate=x, gradient=g)
         if trace_convergence:
             telemetry.record_convergence_point("glm_qn", it - 1, f_cur)
         if use_ckpt and it % every == 0:

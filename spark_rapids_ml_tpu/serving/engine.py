@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import telemetry
-from ..utils import get_logger, lockcheck
+from ..utils import get_logger, lockcheck, numcheck
 from .registry import ModelRegistry
 
 
@@ -104,6 +104,9 @@ class ScoringEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._logger = get_logger(type(self))
+        # runtime numerics sanitizer (SRML_NUMCHECK=1): resolved once per
+        # engine; disabled = a None attribute, one test per dispatch group
+        self._numcheck = numcheck.hook()
 
     # ---------------------------------------------------------- lifecycle --
     def start(self) -> "ScoringEngine":
@@ -286,6 +289,16 @@ class ScoringEngine:
                 # ---- response assembly: THE one blocking point -----------
                 jax.block_until_ready([r for r, _ in in_flight])  # serve-ok: the engine's single response-assembly sync point (docs/serving.md async contract)
                 outs = [program.fetch(r, nv) for r, nv in in_flight]
+            if self._numcheck is not None:
+                # response assembly is the serving plane's one host boundary:
+                # the fetched outputs are swept before any tenant sees them.
+                # allow_inf: top-k pads short result rows with inf distances
+                for oi, out in enumerate(outs):
+                    vals = out if isinstance(out, tuple) else (out,)
+                    self._numcheck(
+                        "serving.response", solver=group[0].name, allow_inf=True,
+                        **{f"chunk{oi}_out{j}": v for j, v in enumerate(vals)},
+                    )
             self._resolve_group(group, sizes, outs)
             if reg is not None:
                 reg.inc("serve.rows", n)

@@ -1,0 +1,235 @@
+#
+# Unit family for the runtime numerics sanitizer
+# (spark_rapids_ml_tpu/utils/numcheck.py): trip shape (typed NumericsError +
+# flight-recorder event + recorded violation), allow_inf sentinels, dtype
+# watermarks, disabled = zero-cost (None hook, nothing recorded), the report
+# artifact ci/test.sh archives and gates on zero trips, snapshot/restore
+# isolation (deliberate test trips never poison the CI gate), and the
+# end-to-end boundaries: a k-means fit and a segmented GLM-style loop sweep
+# clean under SRML_NUMCHECK=1, and a NaN injected into a segmented state is
+# caught AT the boundary with solver/iteration attribution.
+#
+import json
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from spark_rapids_ml_tpu import diagnostics  # noqa: E402
+from spark_rapids_ml_tpu.errors import NumericsError, SrmlError  # noqa: E402
+from spark_rapids_ml_tpu.utils import numcheck  # noqa: E402
+
+
+@pytest.fixture()
+def sanitizer(monkeypatch):
+    """Isolated sanitizer state (the lockcheck fixture discipline): snapshot
+    the process-global state, run against a clean slate, restore EXACTLY —
+    the deliberate trips these tests seed must not poison the CI lane's
+    numcheck report, and the lane's real observations must survive this
+    file (the zero-trip gate would otherwise check a reset report)."""
+    monkeypatch.setenv("SRML_NUMCHECK", "1")
+    state = numcheck.snapshot()
+    numcheck.reset()
+    diagnostics.flight_recorder().reset()
+    yield numcheck
+    numcheck.restore(state)
+
+
+# ------------------------------------------------------------- disabled ----
+
+
+def test_disabled_hook_is_none_and_records_nothing(monkeypatch):
+    monkeypatch.setenv("SRML_NUMCHECK", "0")
+    # the zero-cost contract: no hook object at all — boundary sites hold a
+    # None local and pay one `is not None` test per boundary
+    assert numcheck.hook() is None
+    assert numcheck.enabled() is False
+    state = numcheck.snapshot()  # same isolation discipline as the fixture
+    numcheck.reset()
+    try:
+        from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit  # noqa: F401
+
+        assert numcheck.report()["enabled"] is False
+        assert numcheck.checks() == 0 and numcheck.trips() == []
+    finally:
+        numcheck.restore(state)
+
+
+# ----------------------------------------------------------------- trips ----
+
+
+def test_trip_shape_typed_error_and_flight_recorder(sanitizer):
+    with pytest.raises(NumericsError) as ei:
+        numcheck.check(
+            "t.stage", solver="glm", iteration=7, coef=np.array([1.0, np.nan, np.inf])
+        )
+    e = ei.value
+    assert isinstance(e, SrmlError) and isinstance(e, ArithmeticError)
+    assert e.stage == "t.stage" and e.solver == "glm" and e.iteration == 7
+    assert e.value_name == "coef"
+    assert "1 NaN / 1 Inf" in str(e)
+    trips = numcheck.trips()
+    assert len(trips) == 1
+    t = trips[0]
+    assert t["stage"] == "t.stage" and t["value"] == "coef"
+    assert t["nan"] == 1 and t["inf"] == 1 and t["shape"] == [3]
+    evs = [
+        ev for ev in diagnostics.flight_recorder().events()
+        if ev["kind"] == "numcheck.trip"
+    ]
+    assert len(evs) >= 1
+    assert evs[-1]["stage"] == "t.stage" and evs[-1]["solver"] == "glm"
+
+
+def test_allow_inf_sentinels_pass_but_nan_still_trips(sanitizer):
+    numcheck.check("t.inf", allow_inf=True, d=np.array([np.inf, 1.0]))
+    assert numcheck.trips() == []
+    with pytest.raises(NumericsError):
+        numcheck.check("t.inf", allow_inf=True, d=np.array([np.nan]))
+
+
+def test_non_float_values_and_scalars(sanitizer):
+    numcheck.check("t.int", ids=np.arange(5), n=3)
+    with pytest.raises(NumericsError):
+        numcheck.check("t.scalar", shift=float("nan"))
+    assert numcheck.checks() == 2
+
+
+def test_watermarks_record_every_dtype_seen(sanitizer):
+    numcheck.check(
+        "t.wm", watermark=np.dtype(np.float32),
+        a=np.zeros(2, np.float64), b=np.zeros(2, np.int32),
+    )
+    wm = numcheck.watermarks()["t.wm"]
+    assert wm == {"float32": 1, "float64": 1, "int32": 1}
+
+
+# ---------------------------------------------------------------- report ----
+
+
+def test_report_artifact_roundtrip(sanitizer, tmp_path):
+    numcheck.check("t.ok", v=np.ones(3))
+    path = tmp_path / "numcheck_report.json"
+    assert numcheck.write_report(str(path)) == str(path)
+    rep = json.loads(path.read_text())
+    assert rep["enabled"] is True and rep["checks"] == 1
+    assert rep["trips"] == [] and "t.ok" in rep["watermarks"]
+
+
+def test_snapshot_restore_discards_fixture_trips(sanitizer):
+    numcheck.check("t.before", v=np.ones(1))
+    outer = numcheck.snapshot()
+    with pytest.raises(NumericsError):
+        numcheck.check("t.poison", v=np.array([np.nan]))
+    assert len(numcheck.trips()) == 1
+    numcheck.restore(outer)
+    # the deliberate trip is gone; the prior observation survives
+    assert numcheck.trips() == [] and numcheck.checks() == 1
+    assert "t.before" in numcheck.watermarks()
+
+
+# ------------------------------------------------------------ boundaries ----
+
+
+def test_kmeans_fit_sweeps_clean_under_numcheck(sanitizer):
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 6)).astype(np.float32)
+    out = kmeans_fit(
+        jnp.asarray(X), jnp.ones((256,), jnp.float32), jnp.asarray(X[:3]),
+        mesh=get_mesh(), max_iter=8, tol=1e-7,
+    )
+    assert np.isfinite(float(out["inertia_"]))
+    rep = numcheck.report()
+    assert rep["trips"] == [] and rep["checks"] > 0
+    assert "float32" in rep["watermarks"]["kmeans.iterate"]
+
+
+def test_segmented_while_boundary_catches_injected_nan(sanitizer):
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu import checkpoint as ckpt
+
+    # state = (x, it): x goes NaN at inner iteration 3; the segment
+    # boundary (every=2) must catch it AT the it=4 checkpoint with solver
+    # attribution — not let it poison the store
+    def cond(s):
+        return s[1] < 8
+
+    def body(s):
+        x, it = s
+        x = jnp.where(it == 3, jnp.nan, x * 1.5)
+        return (x, it + 1)
+
+    store = ckpt.CheckpointStore()
+    with pytest.raises(NumericsError) as ei:
+        ckpt.run_segmented_while(
+            cond, body, (jnp.ones((4,), jnp.float32), jnp.asarray(0, jnp.int32)),
+            it_of=lambda s: s[1], every=2, store=store, key="t",
+            solver="toy", max_iter=8,
+        )
+    e = ei.value
+    assert e.solver == "toy" and e.stage == "segment.toy"
+    assert e.iteration == 4 and e.value_name.startswith("leaf")
+    assert len(numcheck.trips()) == 1
+
+
+def test_segmented_while_inf_sentinel_does_not_trip(sanitizer):
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu import checkpoint as ckpt
+
+    # GLM-style state carries a deliberate jnp.inf best-loss sentinel: the
+    # boundary sweep is allow_inf and must stay quiet
+    def cond(s):
+        return s[1] < 4
+
+    def body(s):
+        return (s[0], s[1] + 1)
+
+    store = ckpt.CheckpointStore()
+    out = ckpt.run_segmented_while(
+        cond, body, (jnp.asarray(jnp.inf, jnp.float32), jnp.asarray(0, jnp.int32)),
+        it_of=lambda s: s[1], every=2, store=store, key="t2",
+        solver="toy", max_iter=4,
+    )
+    assert not np.isfinite(float(out[0]))
+    assert numcheck.trips() == [] and numcheck.checks() > 0
+
+
+def test_streaming_kmeans_sweeps_clean_under_numcheck(sanitizer):
+    # the streaming chunk + iterate boundaries fire and stay quiet on a
+    # healthy out-of-core fit (stage names pinned for the report reader)
+    pd = pytest.importorskip("pandas")
+    from spark_rapids_ml_tpu import core as core_mod
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({"features": list(rng.normal(size=(1500, 6)))})
+    saved = {
+        k: core_mod.config[k] for k in ("hbm_budget_bytes", "stream_chunk_rows")
+    }
+    try:
+        core_mod.config["hbm_budget_bytes"] = 16_000  # forces the STREAM verdict
+        core_mod.config["stream_chunk_rows"] = 512
+        model = (
+            KMeans(k=4, seed=7, maxIter=6, float32_inputs=False)
+            .setFeaturesCol("features")
+            .fit(df)
+        )
+    finally:
+        core_mod.config.update(saved)
+    assert np.all(np.isfinite(np.asarray(model.cluster_centers_)))
+    rep = numcheck.report()
+    assert rep["trips"] == []
+    assert "kmeans_stream.chunk" in rep["watermarks"]
+    assert "kmeans_stream.iterate" in rep["watermarks"]

@@ -1,0 +1,769 @@
+#
+# Fixture corpus for the numerics gate (ci/analysis/rules/numerics.py +
+# rules/histogram.py): TP + FP-guard per invariant, the prose/docstring FP
+# class, import-alias resolution, waiver handling, the interprocedural
+# param-dtype / entry-x64 / collective-reachability compositions, and the
+# result-cache engine-hash pin that keeps a new rule module from being
+# masked by stale cached verdicts.
+#
+import pathlib
+import sys
+import textwrap
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from ci.analysis import analyze_source  # noqa: E402
+from ci.analysis.engine import analyze_sources  # noqa: E402
+from ci.analysis import cache as cache_mod  # noqa: E402
+from ci.analysis.rules import (  # noqa: E402
+    HistogramLoopRule,
+    HygieneRule,
+    PrecisionFlowRule,
+    PrngDisciplineRule,
+    default_rules,
+)
+
+
+def run(src, rule_factory, relpath="spark_rapids_ml_tpu/snippet.py"):
+    return analyze_source(textwrap.dedent(src), relpath=relpath, rules=[rule_factory()])
+
+
+def run_files(files, rule_factory):
+    return analyze_sources(
+        {rel: textwrap.dedent(src) for rel, src in files.items()},
+        rules=[rule_factory()],
+    )
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------------------
+# precision-flow: accumulator narrowing
+# --------------------------------------------------------------------------
+
+
+def test_precision_narrow_reassign_fires():
+    src = """
+    import jax.numpy as jnp
+    def solve(x):
+        acc = jnp.zeros((4,), dtype=jnp.float64)
+        acc = acc.astype(jnp.float32)
+        return acc
+    """
+    fs = run(src, PrecisionFlowRule)
+    # the astype itself types the RHS; exactly one narrow finding
+    narrows = [f for f in fs if "accumulator" in f.message]
+    assert len(narrows) == 1 and narrows[0].line == 5
+    assert "`acc`" in narrows[0].message
+
+
+def test_precision_narrow_augassign_fires():
+    src = """
+    import jax.numpy as jnp
+    def solve(x):
+        acc = jnp.zeros((4,), dtype=jnp.float64)
+        acc += x.astype(jnp.bfloat16)
+        return acc
+    """
+    fs = run(src, PrecisionFlowRule)
+    narrows = [f for f in fs if "accumulator" in f.message]
+    assert len(narrows) == 1 and "augmented" in narrows[0].message
+
+
+def test_precision_narrow_fp_guards():
+    # f32 -> f32 rebind, f64 -> f64 promote-preserving update, and an
+    # UNKNOWN-dtype reassign must all stay clean (unknown never guesses);
+    # f64 established via the HOST spelling so no x64 finding mixes in
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+    def solve(x, other):
+        a = jnp.zeros((4,), dtype=jnp.float32)
+        a = a.astype(jnp.float32)
+        b = x.astype(np.float64)
+        b = b + x
+        b = other(b)
+        return a, b
+    """
+    assert run(src, PrecisionFlowRule) == []
+
+
+# --------------------------------------------------------------------------
+# precision-flow: low-precision dots
+# --------------------------------------------------------------------------
+
+
+def test_precision_lowdot_inline_bf16_fires_and_pref_passes():
+    src = """
+    import jax.numpy as jnp
+    def score(x, c):
+        bad = jnp.dot(x.astype(jnp.bfloat16), c.astype(jnp.bfloat16).T)
+        good = jnp.dot(
+            x.astype(jnp.bfloat16), c.astype(jnp.bfloat16).T,
+            preferred_element_type=jnp.float32,
+        )
+        return bad, good
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"] and fs[0].line == 4
+    assert "preferred_element_type" in fs[0].message
+
+
+def test_precision_lowdot_matmul_operator_fires():
+    src = """
+    import jax.numpy as jnp
+    def score(x, c):
+        a = x.astype(jnp.bfloat16)
+        return a @ c
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"]
+    assert "`@`" in fs[0].message
+
+
+def test_precision_lowdot_interprocedural_param_meet_fires():
+    # bf16 flows through a call: the dot is on a bare parameter whose ONE
+    # resolved call site passes bf16 — the param-dtype fixpoint proves it
+    files = {
+        "spark_rapids_ml_tpu/a.py": """
+        import jax.numpy as jnp
+        def caller(x):
+            b = x.astype(jnp.bfloat16)
+            return helper(b)
+        def helper(v):
+            return jnp.matmul(v, v)
+        """,
+    }
+    fs = run_files(files, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"]
+    assert "matmul" in fs[0].message
+
+
+def test_precision_lowdot_conflicting_callers_stay_clean():
+    # two call sites disagree (bf16 vs f32): the meet poisons to unknown —
+    # findings are proven, never guessed
+    files = {
+        "spark_rapids_ml_tpu/a.py": """
+        import jax.numpy as jnp
+        def c1(x):
+            return helper(x.astype(jnp.bfloat16))
+        def c2(x):
+            return helper(x.astype(jnp.float32))
+        def helper(v):
+            return jnp.matmul(v, v)
+        """,
+    }
+    assert run_files(files, PrecisionFlowRule) == []
+
+
+def test_precision_lowdot_einsum_skips_equation_string():
+    src = """
+    import jax.numpy as jnp
+    def score(x):
+        a = x.astype(jnp.bfloat16)
+        return jnp.einsum("td,tcd->tc", a, a)
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"]
+
+
+# --------------------------------------------------------------------------
+# precision-flow: unguarded jnp f64
+# --------------------------------------------------------------------------
+
+
+def test_precision_f64_unguarded_fires_and_np_host_passes():
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+    def place(x):
+        dev = jnp.asarray(x, dtype=jnp.float64)
+        host = np.asarray(x, dtype=np.float64)
+        return dev, host
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"] and fs[0].line == 5
+    assert "x64 guard" in fs[0].message
+
+
+def test_precision_f64_under_with_guard_passes():
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+    def place(x):
+        with enable_x64(True):
+            return jnp.asarray(x, dtype=jnp.float64)
+    """
+    assert run(src, PrecisionFlowRule) == []
+
+
+def test_precision_f64_negated_guard_polarity():
+    # `if not jax_enable_x64:` guards the ELSE arm — f64 in the TRUE arm
+    # runs exactly when x64 is OFF and must still be a finding
+    # (review-caught polarity blindness)
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def place(x):
+        if not jax.config.jax_enable_x64:
+            bad = jnp.asarray(x, dtype=jnp.float64)
+        else:
+            good = jnp.asarray(x, dtype=jnp.float64)
+        return bad, good
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"] and fs[0].line == 6
+
+
+def test_precision_f64_not_equal_false_guard_is_positive_polarity():
+    # `!= False` is truthy exactly when x64 is ON: the true arm IS guarded
+    # (review-caught operator blindness in the negation check)
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def place(x):
+        if jax.config.jax_enable_x64 != False:
+            good = jnp.asarray(x, dtype=jnp.float64)
+        else:
+            bad = jnp.asarray(x, dtype=jnp.float64)
+        return good, bad
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"] and fs[0].line == 8
+
+
+def test_precision_f64_nested_def_escapes_with_guard():
+    # a closure defined inside `with enable_x64():` runs when CALLED —
+    # after the scoped guard exited — so its f64 is NOT guarded
+    # (review-caught: _x64_depth must reset per nested def, like `held`)
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+    def factory(n):
+        with enable_x64(True):
+            def later():
+                return jnp.zeros((n,), dtype=jnp.float64)
+        return later
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"]
+    assert "x64 guard" in fs[0].message
+
+
+def test_precision_starred_args_do_not_shift_param_dtypes():
+    # `callee(*xs, key)`: past the splat, positional alignment is unknown —
+    # the bf16 must NOT be met into param `b` (review-caught misattribution)
+    files = {
+        "spark_rapids_ml_tpu/a.py": """
+        import jax.numpy as jnp
+        def caller(xs, x):
+            key = x.astype(jnp.bfloat16)
+            return callee(*xs, key)
+        def callee(a, b, c):
+            return jnp.dot(a, b)
+        """,
+    }
+    assert run_files(files, PrecisionFlowRule) == []
+
+
+def test_precision_f64_entry_guard_fixpoint_passes():
+    # the f64 helper is ONLY called from inside the x64 guard: the
+    # entry-x64 fixpoint proves it guarded across the call
+    files = {
+        "spark_rapids_ml_tpu/a.py": """
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+        def outer(x):
+            with enable_x64(True):
+                return widen(x)
+        def widen(x):
+            return jnp.asarray(x, dtype=jnp.float64)
+        """,
+    }
+    assert run_files(files, PrecisionFlowRule) == []
+
+
+def test_precision_docstring_mention_does_not_fire():
+    src = '''
+    import jax.numpy as jnp
+    def doc(x):
+        """Uses jnp.dot(a.astype(jnp.bfloat16), b) and jnp.float64 in prose."""
+        return x
+    '''
+    assert run(src, PrecisionFlowRule) == []
+
+
+def test_precision_waiver_suppresses_and_bare_waiver_is_finding():
+    waived = """
+    import jax.numpy as jnp
+    def score(x, c):
+        a = x.astype(jnp.bfloat16)
+        return a @ c  # precision-ok: documented fast path, parity-tested
+    """
+    assert run(waived, PrecisionFlowRule) == []
+    bare = """
+    import jax.numpy as jnp
+    def score(x, c):
+        a = x.astype(jnp.bfloat16)
+        return a @ c  # precision-ok
+    """
+    fs = analyze_source(
+        textwrap.dedent(bare), rules=[PrecisionFlowRule(), HygieneRule()]
+    )
+    assert sorted(rule_ids(fs)) == ["precision-flow", "waiver-missing-reason"]
+
+
+# --------------------------------------------------------------------------
+# prng-discipline: key linearity
+# --------------------------------------------------------------------------
+
+
+def test_prng_reuse_two_samplers_fires():
+    src = """
+    import jax
+    def draw(n):
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, (n,))
+        b = jax.random.uniform(key, (n,))
+        return a, b
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"] and fs[0].line == 6
+    assert "already consumed" in fs[0].message
+
+
+def test_prng_sample_after_split_fires():
+    src = """
+    import jax
+    def draw(n):
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        noise = jax.random.normal(key, (n,))
+        return k1, k2, noise
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+    assert "`split`" in fs[0].message
+
+
+def test_prng_split_rebind_chain_is_clean():
+    src = """
+    import jax
+    def draw(seed, n):
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        a = jax.random.normal(k0, (n,))
+        key, k1 = jax.random.split(key)
+        b = jax.random.uniform(k1, (n,))
+        return a, b
+    """
+    assert run(src, PrngDisciplineRule) == []
+
+
+def test_prng_loop_reuse_of_outer_key_fires():
+    src = """
+    import jax
+    def draw(n):
+        key = jax.random.PRNGKey(0)
+        out = []
+        for i in range(4):
+            out.append(jax.random.normal(key, (n,)))
+        return out
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+
+
+def test_prng_fold_in_per_index_stream_is_clean():
+    # the sanctioned many-streams pattern: fold_in derives without consuming
+    src = """
+    import jax
+    def draw(seed, n):
+        key = jax.random.PRNGKey(seed)
+        out = []
+        for e in range(4):
+            ke = jax.random.fold_in(key, e)
+            out.append(jax.random.normal(ke, (n,)))
+        return out
+    """
+    assert run(src, PrngDisciplineRule) == []
+
+
+def test_prng_loop_remint_inside_body_is_clean():
+    src = """
+    import jax
+    def draw(n):
+        key = jax.random.PRNGKey(0)
+        out = []
+        for i in range(4):
+            key, sub = jax.random.split(key)
+            out.append(jax.random.normal(sub, (n,)))
+        return out
+    """
+    assert run(src, PrngDisciplineRule) == []
+
+
+def test_prng_for_target_subkeys_are_fresh_per_iteration():
+    # the canonical batch-split idiom: the loop TARGET is a fresh binding
+    # each iteration, never a reuse (review-caught FP)
+    src = """
+    import jax
+    def draw(key, n):
+        out = []
+        for sub in jax.random.split(key, n):
+            out.append(jax.random.normal(sub, (3,)))
+        return out
+    """
+    assert run(src, PrngDisciplineRule) == []
+
+
+def test_prng_nested_def_in_loop_reports_once():
+    # the double loop-body scan re-enters nested scopes: a violation inside
+    # a closure defined in a loop must still report exactly ONCE
+    # (review-caught double-report)
+    src = """
+    import numpy as np
+    def outer(n):
+        fns = []
+        for i in range(n):
+            def make():
+                return np.random.rand(3)
+            fns.append(make)
+        return fns
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+
+
+def test_prng_branch_arms_each_consume_once_is_clean():
+    src = """
+    import jax
+    def draw(flag, key, n):
+        if flag:
+            out = jax.random.normal(key, (n,))
+        else:
+            out = jax.random.uniform(key, (n,))
+        return out
+    """
+    assert run(src, PrngDisciplineRule) == []
+
+
+def test_prng_consumed_in_branch_then_after_fires():
+    src = """
+    import jax
+    def draw(flag, key, n):
+        if flag:
+            out = jax.random.normal(key, (n,))
+        else:
+            out = None
+        tail = jax.random.uniform(key, (n,))
+        return out, tail
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+
+
+def test_prng_dropped_split_fires_and_underscore_bind_is_clean():
+    src = """
+    import jax
+    def derive(key):
+        jax.random.split(key)
+        k1, _ = jax.random.split(key)
+        return k1
+    """
+    fs = run(src, PrngDisciplineRule)
+    # one drop finding; the second split of the same key is also reuse
+    kinds = [("never bound" in f.message, "already consumed" in f.message) for f in fs]
+    assert (True, False) in kinds and (False, True) in kinds and len(fs) == 2
+
+
+def test_prng_nested_function_param_shadows_outer_key():
+    # the gen_data shape: the inner fn's `key` PARAM is a fresh binding —
+    # outer split + inner sample is NOT reuse
+    src = """
+    import jax
+    def gen(seed, n):
+        key = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(key)
+        def label_fn(X, key):
+            return jax.random.normal(key, (n,))
+        return label_fn(None, k2), jax.random.normal(k1, (n,))
+    """
+    assert run(src, PrngDisciplineRule) == []
+
+
+# --------------------------------------------------------------------------
+# prng-discipline: seeding
+# --------------------------------------------------------------------------
+
+
+def test_prng_wallclock_seed_fires():
+    src = """
+    import time
+    import jax
+    def mint():
+        return jax.random.PRNGKey(int(time.time()))
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+    assert "time.time" in fs[0].message
+
+
+def test_prng_unseeded_default_rng_and_global_np_random_fire():
+    src = """
+    import numpy as np
+    def mint(n):
+        rng = np.random.default_rng()
+        x = np.random.normal(size=n)
+        return rng, x
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"] * 2
+
+
+def test_prng_seeded_default_rng_is_clean():
+    src = """
+    import numpy as np
+    def mint(seed, part):
+        return np.random.default_rng(seed * 7919 + part)
+    """
+    assert run(src, PrngDisciplineRule) == []
+
+
+def test_prng_alias_import_still_caught():
+    src = """
+    import jax.random as jr
+    def draw(n):
+        key = jr.PRNGKey(0)
+        a = jr.normal(key, (n,))
+        b = jr.normal(key, (n,))
+        return a, b
+    """
+    fs = run(src, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+
+
+def test_prng_scope_gen_data_yes_other_benchmark_no():
+    src = """
+    import numpy as np
+    def mint(n):
+        return np.random.normal(size=n)
+    """
+    assert rule_ids(run(src, PrngDisciplineRule, relpath="benchmark/gen_data.py")) == [
+        "prng-discipline"
+    ]
+    assert run(src, PrngDisciplineRule, relpath="benchmark/bench_foo.py") == []
+
+
+def test_prng_docstring_mention_does_not_fire():
+    src = '''
+    def doc():
+        """Call jax.random.normal(key, ...) twice and np.random.seed(0)."""
+        return None
+    '''
+    assert run(src, PrngDisciplineRule) == []
+
+
+# --------------------------------------------------------------------------
+# prng-discipline: rank-dependent keys x collective reachability
+# --------------------------------------------------------------------------
+
+_RANKDEP_TMPL = """
+import jax
+
+def fit(rank, rdv, seed, n):
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), rank){waiver}
+    x = jax.random.normal(key, (n,))
+    {collective}
+    return x
+"""
+
+
+def test_prng_rank_dep_with_collective_fires():
+    files = {
+        "spark_rapids_ml_tpu/a.py": _RANKDEP_TMPL.format(
+            waiver="", collective="rdv.allgather(x)"
+        )
+    }
+    fs = run_files(files, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+    assert "lockstep" in fs[0].message and "rank" in fs[0].message
+
+
+def test_prng_rank_dep_without_collective_is_clean():
+    files = {
+        "spark_rapids_ml_tpu/a.py": _RANKDEP_TMPL.format(waiver="", collective="pass")
+    }
+    assert run_files(files, PrngDisciplineRule) == []
+
+
+def test_prng_rank_dep_waiver_suppresses():
+    files = {
+        "spark_rapids_ml_tpu/a.py": _RANKDEP_TMPL.format(
+            waiver="  # prng-ok: per-rank sample, allgathered below",
+            collective="rdv.allgather(x)",
+        )
+    }
+    assert run_files(files, PrngDisciplineRule) == []
+
+
+def test_prng_rank_dep_reaches_collective_through_call_chain():
+    # the collective sits one resolved call away: may_block's fixpoint
+    # carries it back to the minting function
+    files = {
+        "spark_rapids_ml_tpu/a.py": """
+        import jax
+        def exchange(rdv, x):
+            return rdv.allgather(x)
+        def fit(rank, rdv, seed, n):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), rank)
+            x = jax.random.normal(key, (n,))
+            return exchange(rdv, x)
+        """,
+    }
+    fs = run_files(files, PrngDisciplineRule)
+    assert rule_ids(fs) == ["prng-discipline"]
+
+
+# --------------------------------------------------------------------------
+# histogram-loop
+# --------------------------------------------------------------------------
+
+
+def test_histogram_segment_sum_over_digitize_fires():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def hist(x, edges, vals, n):
+        bins = jnp.digitize(x, edges)
+        return jax.ops.segment_sum(vals, bins, num_segments=n)
+    """
+    fs = run(src, HistogramLoopRule)
+    assert rule_ids(fs) == ["histogram-loop"]
+    assert "segment_sum" in fs[0].message
+
+
+def test_histogram_at_add_and_one_hot_matmul_fire():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def hist(x, edges, vals, n):
+        bins = jnp.searchsorted(edges, x).astype(jnp.int32)
+        h1 = jnp.zeros((n,), vals.dtype).at[bins].add(vals)
+        oh = jax.nn.one_hot(bins, n)
+        h2 = oh.T @ vals
+        return h1, h2
+    """
+    fs = run(src, HistogramLoopRule)
+    assert rule_ids(fs) == ["histogram-loop"] * 2
+
+
+def test_histogram_cross_function_binning_is_clean():
+    # bins produced by ANOTHER function launder: that factored boundary is
+    # exactly what the future core provides
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def bin_features(x, edges):
+        return jnp.searchsorted(edges, x)
+    def accumulate(bins, vals, n):
+        return jax.ops.segment_sum(vals, bins, num_segments=n)
+    """
+    assert run(src, HistogramLoopRule) == []
+
+
+def test_histogram_non_binned_scatter_is_clean():
+    # argmin-derived ids (the distance core's one-hot accumulate shape) and
+    # plain index scatters are NOT histogram loops
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def assign(x, c, w, k):
+        ids = jnp.argmin(x, axis=1)
+        oh = jax.nn.one_hot(ids, k)
+        return oh.T @ w
+    def scatter(idx, vals, n):
+        return jnp.zeros((n,), vals.dtype).at[idx].add(vals)
+    """
+    assert run(src, HistogramLoopRule) == []
+
+
+def test_histogram_waiver_and_exempt_core_file():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def hist(x, edges, vals, n):
+        bins = jnp.digitize(x, edges)
+        return jax.ops.segment_sum(vals, bins, num_segments=n)  # histogram-ok: genuinely different shape
+    """
+    assert run(src, HistogramLoopRule) == []
+    unwaived = src.replace("  # histogram-ok: genuinely different shape", "")
+    assert (
+        run(unwaived, HistogramLoopRule, relpath="spark_rapids_ml_tpu/ops/histogram.py")
+        == []
+    )
+
+
+def test_histogram_docstring_mention_does_not_fire():
+    src = '''
+    def doc():
+        """segment_sum over jnp.digitize(x, edges) ids is the banned shape."""
+        return None
+    '''
+    assert run(src, HistogramLoopRule) == []
+
+
+# --------------------------------------------------------------------------
+# catalog + cache integration
+# --------------------------------------------------------------------------
+
+
+def test_rules_registered_in_default_catalog():
+    ids = {r.id for r in default_rules()}
+    assert {"precision-flow", "prng-discipline", "histogram-loop"} <= ids
+
+
+def test_engine_hash_covers_rule_modules(tmp_path):
+    # the result cache's invalidation key must change when ANY rule module
+    # changes — a stale cached verdict cannot mask a new/edited rule
+    d = tmp_path / "analysis"
+    (d / "rules").mkdir(parents=True)
+    (d / "engine.py").write_text("ENGINE = 1\n")
+    (d / "rules" / "numerics.py").write_text("RULE = 1\n")
+    h1 = cache_mod.engine_hash(str(d))
+    (d / "rules" / "numerics.py").write_text("RULE = 2\n")
+    h2 = cache_mod.engine_hash(str(d))
+    (d / "rules" / "brand_new_rule.py").write_text("RULE = 3\n")
+    h3 = cache_mod.engine_hash(str(d))
+    assert len({h1, h2, h3}) == 3
+
+
+def test_prng_deferred_state_replays_from_cache(tmp_path, capsys):
+    # cache-hit path: the rank-dep candidates are collector state — a
+    # cached file must still produce the finding through restore_state
+    root = tmp_path / "repo"
+    pkg = root / "spark_rapids_ml_tpu"
+    pkg.mkdir(parents=True)
+    (root / "ci" / "analysis").mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        textwrap.dedent(_RANKDEP_TMPL.format(waiver="", collective="rdv.allgather(x)"))
+    )
+    from ci.analysis.cli import main as cli_main
+
+    args = ["spark_rapids_ml_tpu", "--root", str(root), "--no-imports",
+            "--baseline", str(root / "bl.json")]
+    assert cli_main(args) == 1
+    out1 = capsys.readouterr().out
+    assert "prng-discipline" in out1
+    # freeze the finding, then re-run: the file is served from the cache and
+    # the deferred rank-dep candidate must replay through restore_state —
+    # the finding shows up as baselined, not as silently absent
+    assert cli_main(args + ["--write-baseline", "--allow-baseline-growth"]) == 0
+    capsys.readouterr()
+    assert cli_main(args) == 0
+    out2 = capsys.readouterr().out
+    assert "1 cached" in out2 and "1 baselined" in out2
