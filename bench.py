@@ -69,10 +69,20 @@ BASELINES = {
     "kmeans_scale": 250_000.0,
     "knn": 20_000.0,
     "serving": 50_000.0,
+    # mixed-precision solver lanes (docs/performance.md "Mixed-precision
+    # solvers"): the solver_precision="bf16" contract measured end-to-end.
+    # Baselines reuse the f32 siblings' A100-reference rates — the reference
+    # has no bf16 solver mode, so the speedup shows up as a higher vs_baseline
+    # ratio on the same yardstick.
+    "kmeans_bf16": 8_333.0,
+    "logreg_bf16": 12_500.0,
 }
 # serving runs FIRST: it builds its own small resident model and must not
 # coexist with the ~12 GiB dense protocol block on a single v5e
-ALGOS = ("serving", "pca", "logreg", "kmeans", "kmeans_scale", "knn")
+ALGOS = (
+    "serving", "pca", "logreg", "logreg_bf16", "kmeans", "kmeans_bf16",
+    "kmeans_scale", "knn",
+)
 # lanes that run on ONE local device by construction (the serving plane's
 # registry/engine are single-device): their rows/sec is already per-chip —
 # dividing by the mesh size would underreport them n_chips-fold on
@@ -236,6 +246,35 @@ def bench_kmeans(X, w, mesh) -> float:
     return N_ROWS / fit_s
 
 
+def bench_kmeans_bf16(X, w, mesh) -> float:
+    """The solver_precision="bf16" k-means lane, measured exactly as a user
+    gets it: one-pass bf16-compute/f32-accumulate assignment + accumulation
+    (distance-core fast path, autotuned block plan on TPU), final inertia at
+    full precision — no ambient matmul-precision override. Distinct from the
+    `kmeans` lane, which wraps its fit in the estimator's 3-pass-bf16
+    dtype_scope policy."""
+    import jax
+
+    from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
+
+    k = 1000
+    rng = np.random.default_rng(1)  # same init block as the kmeans lane
+    r0 = int(rng.integers(0, max(1, X.shape[0] - k + 1)))
+    centers0 = jax.jit(lambda X: jax.lax.dynamic_slice_in_dim(X, r0, k, 0))(X)
+    np.asarray(centers0[:1])
+
+    def run():
+        return kmeans_fit(
+            X, w, centers0, mesh=mesh, max_iter=30, tol=1e-20,
+            batch_rows=65536, precision_mode="fast",
+        )
+
+    np.asarray(run()["cluster_centers_"])  # compile + warm
+    fit_s = _time_fit(run, lambda s: s["cluster_centers_"], repeats=1)
+    _log(f"kmeans_bf16: {fit_s:.2f}s fit (k={k}, maxIter=30, solver_precision=bf16)")
+    return N_ROWS / fit_s
+
+
 def bench_kmeans_scale(X, w, mesh) -> float:
     """The distance-core lane: ONE fused assignment + accumulate pass over
     the full 1M x 3k block against k=1000 centers — the exact shape of the
@@ -304,6 +343,28 @@ def bench_logreg(X, w, y_idx) -> float:
         "logistic", n_iter=int(state["n_iter_"]), objective=float(state["objective_"])
     )
     _log(f"logreg: {fit_s:.2f}s fit (maxIter=200, tol=1e-30)")
+    return N_ROWS / fit_s
+
+
+def bench_logreg_bf16(X, w, y_idx) -> float:
+    """The solver_precision="bf16" GLM lane: X·β / Xᵀr matvecs bf16-in with
+    f32 accumulation (ops/logistic._dense_ops), L-BFGS state + line search +
+    convergence scalars full precision — same protocol config as `logreg`."""
+    from spark_rapids_ml_tpu import telemetry
+    from spark_rapids_ml_tpu.ops.logistic import logistic_fit
+
+    run = lambda: logistic_fit(  # noqa: E731
+        X, y_idx, w, k=2, multinomial=False, lam_l2=1e-5,
+        fit_intercept=True, standardize=True, max_iter=200, tol=1e-30,
+        fast=True,
+    )
+    state = run()
+    np.asarray(state["coef_"])  # compile + warm
+    fit_s = _time_fit(lambda: run(), lambda s: s["coef_"], repeats=1)
+    telemetry.record_solver_result(  # outside the timer
+        "logistic", n_iter=int(state["n_iter_"]), objective=float(state["objective_"])
+    )
+    _log(f"logreg_bf16: {fit_s:.2f}s fit (maxIter=200, solver_precision=bf16)")
     return N_ROWS / fit_s
 
 
@@ -512,7 +573,13 @@ def run_child() -> int:
         "logreg": lambda: bench_logreg(
             dense_data()["X"], dense_data()["w"], dense_data()["y_idx"]
         ),
+        "logreg_bf16": lambda: bench_logreg_bf16(
+            dense_data()["X"], dense_data()["w"], dense_data()["y_idx"]
+        ),
         "kmeans": lambda: bench_kmeans(dense_data()["X"], dense_data()["w"], mesh),
+        "kmeans_bf16": lambda: bench_kmeans_bf16(
+            dense_data()["X"], dense_data()["w"], mesh
+        ),
         "kmeans_scale": lambda: bench_kmeans_scale(
             dense_data()["X"], dense_data()["w"], mesh
         ),
@@ -545,7 +612,20 @@ def run_child() -> int:
     # per-stage telemetry snapshot (HBM watermark, solver iterations, span
     # aggregates) for the parent to embed in the BENCH JSON line
     telemetry.record_device_memory()
-    print("@TELEMETRY " + json.dumps(telemetry.snapshot()), flush=True)
+    snap = telemetry.snapshot()
+    # precision provenance: which distance kernel actually ran, the session's
+    # solver_precision default, and the autotuner's hit/miss/measure counts —
+    # embedded so every BENCH record is interpretable without the stderr log
+    from spark_rapids_ml_tpu.core import config as _srml_config
+    from spark_rapids_ml_tpu.ops import autotune as _autotune
+    from spark_rapids_ml_tpu.ops.distance import kernel_mode as _kernel_mode
+
+    snap["precision"] = {
+        "distance_kernel_mode": _kernel_mode(),
+        "solver_precision": _srml_config["solver_precision"],
+        "autotune": _autotune.stats(),
+    }
+    print("@TELEMETRY " + json.dumps(snap), flush=True)
     return 1 if n_fail else 0
 
 
@@ -659,6 +739,7 @@ def emit(
     missing = [a for a in ALGOS if a not in ok]
     unit = (
         f"rows/sec/chip (geomean of PCA k=3 / KMeans k=1000 / LogReg maxIter=200 / "
+        f"their solver_precision=bf16 lanes / "
         f"KMeans-scale 1-pass k=1000 / kNN q={KNN_QUERIES} k={KNN_K} / "
         f"Serving {SERVE_REQUESTS}req k={SERVE_K} "
         f"on {N_ROWS // 1000}k x {N_COLS}, f32"

@@ -43,10 +43,12 @@ PY
 echo "== numerics sanitizer lanes (SRML_NUMCHECK=1 over the solver/streaming/serving/segmented families; report archived)"
 # test_recovery drives run_segmented_while, so the segment.* checkpoint
 # boundary is exercised by the gate (test_numcheck's own segment trips are
-# deliberately discarded by its snapshot/restore fixture)
+# deliberately discarded by its snapshot/restore fixture); test_precision
+# runs every bf16 solver family under the sanitizer (the mixed-precision
+# acceptance: zero trips, no bf16 solver-state watermark)
 SRML_NUMCHECK=1 SRML_NUMCHECK_REPORT="$ARTIFACTS/numcheck_report.json" \
   python -m pytest tests/test_kmeans.py tests/test_oocore.py tests/test_serving.py \
-    tests/test_recovery.py tests/test_numcheck.py -q
+    tests/test_recovery.py tests/test_numcheck.py tests/test_precision.py -q
 python - "$ARTIFACTS/numcheck_report.json" <<'PY'
 import json, sys
 rep = json.load(open(sys.argv[1]))

@@ -192,7 +192,54 @@ config: Dict[str, Any] = {
     # typo'd value falls back to the default — it must not crash package
     # import (utils.lockcheck.long_hold_threshold_s guards the same way).
     "lockcheck_long_hold_ms": _env_float("SRML_LOCKCHECK_LONG_HOLD_MS", 500.0),
+    # --- mixed-precision solver contract (docs/performance.md
+    # "Mixed-precision solvers") ------------------------------------------
+    # default precision for the SANCTIONED hot contractions of every solver
+    # fit: "f32" (default) keeps all fit arithmetic at the ambient input
+    # precision; "bf16" routes the per-solver hot paths (k-means
+    # assign+accumulate, GLM X·β / Xᵀr matvecs, linear/PCA sufficient-stat
+    # einsums) through bf16 inputs with f32 accumulators. Convergence
+    # scalars, L-BFGS state, and all REPORTED metrics stay full precision in
+    # both modes. Per-estimator override via the `solver_precision` solver
+    # param; seeded from SRML_SOLVER_PRECISION.
+    "solver_precision": os.environ.get("SRML_SOLVER_PRECISION") or "f32",
+    # --- measured kernel autotuner (ops/autotune.py) ---------------------
+    # on first TPU contact per (shape-class, dtype, fast-flag) the Pallas
+    # distance-core block planner times a small (block_rows, block_k)
+    # candidate grid on-device and persists the winner as JSON beside the
+    # XLA compile cache (compilation_cache_dir). SRML_AUTOTUNE=0 disables;
+    # off-TPU (or cold-start) the static half-VMEM heuristic is used, so
+    # CPU/CI behavior is unchanged.
+    "autotune_enabled": os.environ.get("SRML_AUTOTUNE", "1")
+    not in ("", "0", "false", "off"),
+    # timing repeats per candidate tiling when the autotuner measures; the
+    # minimum over repeats is scored (robust to one-off scheduling noise)
+    "autotune_repeats": 3,
 }
+
+
+def resolve_solver_precision(params: Optional[Dict[str, Any]] = None) -> str:
+    """Effective solver precision for ONE fit: the estimator's
+    ``solver_precision`` solver-param when set (per-estimator override),
+    else ``config["solver_precision"]``. Returns "f32" or "bf16"; anything
+    else raises ValueError naming the knob. The choice is counted
+    (`fit.precision_f32` / `fit.precision_bf16`) so the BENCH/ops artifacts
+    can audit which precision every fit actually ran at."""
+    value = params.get("solver_precision") if params else None
+    if value is None:
+        value = config.get("solver_precision") or "f32"
+    value = str(value).lower()
+    if value not in ("f32", "bf16"):
+        raise ValueError(
+            f"solver_precision must be 'f32' or 'bf16', got {value!r}"
+        )
+    from . import telemetry
+
+    if telemetry.enabled():
+        telemetry.registry().inc(
+            "fit.precision_bf16" if value == "bf16" else "fit.precision_f32"
+        )
+    return value
 
 def evaluator_label_column(params_obj: Any, evaluator: Any) -> str:
     """The label column an evaluator scores against: its own ``labelCol``

@@ -199,6 +199,11 @@ class _LogisticRegressionParams(
             "standardization": True,
             "lbfgs_memory": 10,  # reference parity: lbfgs_memory=10 (classification.py:1056-1057)
             "verbose": False,
+            # per-estimator override of config["solver_precision"]; "bf16"
+            # runs the X·β / Xᵀr matvecs bf16-in/f32-accumulate while the
+            # L-BFGS state, line search, and convergence scalars stay full
+            # precision (docs/performance.md "Mixed-precision solvers")
+            "solver_precision": None,
         }
 
 
@@ -341,6 +346,7 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             standardize=statics["standardize"],
             max_iter=statics["max_iter"], tol=statics["tol"],
             lbfgs_memory=statics["lbfgs_memory"],
+            fast=statics["fast"],
             # param-identifying key, mirroring the resident checkpointed
             # fit's "logistic:<params>" — a static key would let sequential
             # param sets of one demoted sweep resume EACH OTHER'S trajectories
@@ -429,12 +435,17 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
 
     @staticmethod
     def _solver_statics(params: Dict[str, Any]) -> Dict[str, Any]:
+        from ..core import resolve_solver_precision
+
         return dict(
             fit_intercept=bool(params["fit_intercept"]),
             standardize=bool(params["standardization"]),
             max_iter=int(params["max_iter"]),
             tol=float(params["tol"]),
             lbfgs_memory=int(params["lbfgs_memory"]),
+            # static of every GLM entry point; also part of the checkpoint
+            # key repr, so bf16 and f32 trajectories can never cross-resume
+            fast=resolve_solver_precision(params) == "bf16",
         )
 
     def _resolve_warm_start(self, source: Any) -> Dict[str, Any]:

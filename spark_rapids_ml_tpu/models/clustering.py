@@ -79,6 +79,10 @@ class _KMeansParams(
             # inertia agrees to ~1e-5 (ops/kmeans.py _mm). "high" restores
             # the 3-pass-bf16 in-loop matmuls.
             "distance_precision": "fast",
+            # per-estimator override of config["solver_precision"]; "bf16"
+            # forces the fast in-loop path on BOTH the resident and the
+            # streaming fit (streaming otherwise runs full precision)
+            "solver_precision": None,
         }
 
 
@@ -262,12 +266,19 @@ class KMeans(_KMeansParams, _TpuEstimator):
             else:  # small k: classic k-means++ (exactness-friendly for tests)
                 centers0 = kmeans_plus_plus_init(x_init, k, seed, w_init)
             centers0 = centers0.astype(inputs.dtype)
+            # `solver_precision="bf16"` (per-estimator or config-wide) forces
+            # the bf16-compute/f32-accumulate in-loop path on both fit modes;
+            # the legacy `distance_precision` knob keeps governing the
+            # resident loop when solver_precision stays at its "f32" default
+            from ..core import resolve_solver_precision
+
+            solver_precision = resolve_solver_precision(params)
             if inputs.stream is not None:
                 # out-of-core: per-chunk assignment + center accumulation
                 # under the SAME deferred-convergence host loop and the SAME
-                # checkpoint key as the resident fit. Runs at full (ambient)
-                # precision — `distance_precision="fast"` applies to the
-                # resident in-loop matmuls only.
+                # checkpoint key as the resident fit. In-loop chunk matmuls
+                # honor solver_precision ("bf16" -> distance core fast path);
+                # the reported inertia is always re-evaluated full precision.
                 from ..ops.streaming import kmeans_fit_streaming
 
                 # the streaming kernel materializes its [chunk_dev, k]
@@ -286,6 +297,7 @@ class KMeans(_KMeansParams, _TpuEstimator):
                     centers0,
                     max_iter=int(params["max_iter"]),
                     tol=float(params["tol"]),
+                    precision_mode="fast" if solver_precision == "bf16" else "high",
                 )
             else:
                 state = kmeans_fit(
@@ -296,7 +308,11 @@ class KMeans(_KMeansParams, _TpuEstimator):
                     max_iter=int(params["max_iter"]),
                     tol=float(params["tol"]),
                     batch_rows=int(params.get("max_samples_per_batch", 32768)),
-                    precision_mode=str(params.get("distance_precision", "fast")),
+                    precision_mode=(
+                        "fast"
+                        if solver_precision == "bf16"
+                        else str(params.get("distance_precision", "fast"))
+                    ),
                 )
             return {
                 "cluster_centers_": np.asarray(state["cluster_centers_"]),

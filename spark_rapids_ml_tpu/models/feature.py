@@ -44,6 +44,10 @@ class _PCAParams(HasInputCol, HasInputCols, HasFeaturesCol, HasFeaturesCols, Has
             "svd_solver": "auto",
             "whiten": False,
             "verbose": False,
+            # per-estimator override of config["solver_precision"]; "bf16"
+            # runs the covariance contraction bf16-in/f32-accumulate; the
+            # eigendecomposition and reported variances stay full precision
+            "solver_precision": None,
         }
 
 
@@ -102,7 +106,10 @@ class PCA(_PCAParams, _TpuEstimator):
         )
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            from ..core import resolve_solver_precision
+
             k = int(params["n_components"])
+            fast = resolve_solver_precision(params) == "bf16"
             if k < 1:
                 raise ValueError(f"k must be >= 1, got {k}")
             if k > inputs.n_cols:
@@ -112,7 +119,7 @@ class PCA(_PCAParams, _TpuEstimator):
                 # covariance), same finish kernel as the resident fit
                 from ..ops.streaming import pca_fit_streaming
 
-                state = pca_fit_streaming(inputs, k=k)
+                state = pca_fit_streaming(inputs, k=k, fast=fast)
                 out = {name: np.asarray(v) for name, v in state.items()}
                 check_pca_state(out, k=k)
                 record_pca_fit(out, k=k)
@@ -126,11 +133,11 @@ class PCA(_PCAParams, _TpuEstimator):
             )
             if use_ckpt:
                 state = pca_fit_checkpointed(
-                    inputs.X, inputs.w, k=k,
+                    inputs.X, inputs.w, k=k, fast=fast,
                     placement_key=_ckpt.placement_key_of(inputs),
                 )
             else:
-                state = pca_fit(inputs.X, inputs.w, k=k)
+                state = pca_fit(inputs.X, inputs.w, k=k, fast=fast)
             out = {name: np.asarray(v) for name, v in state.items()}
             check_pca_state(out, k=k)  # guard on the host-fetched attributes
             record_pca_fit(out, k=k)

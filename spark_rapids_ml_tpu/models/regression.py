@@ -136,6 +136,10 @@ class _LinearRegressionParams(
             "solver": "eig",
             "loss": "squared_loss",
             "verbose": False,
+            # per-estimator override of config["solver_precision"]; "bf16"
+            # runs the sufficient-statistics gram contraction bf16-in /
+            # f32-accumulate; the replicated solve stays full precision
+            "solver_precision": None,
         }
 
 
@@ -223,6 +227,8 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
             alpha = float(params["alpha"])
             l1_ratio = float(params["l1_ratio"])
             use_cd = bool(alpha > 0 and l1_ratio > 0)
+            from ..core import resolve_solver_precision
+
             common = dict(
                 alpha=alpha,
                 l1_ratio=l1_ratio,
@@ -231,6 +237,9 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                 use_cd=use_cd,
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
+                # static of every linear entry point (and of the retained-
+                # statistics checkpoint key: bf16 stats are keyed apart)
+                fast=resolve_solver_precision(params) == "bf16",
             )
             if inputs.stream is not None:
                 # out-of-core: one streamed statistics pass, same replicated
@@ -300,12 +309,15 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
             alphas = np.asarray([float(sp["alpha"]) for sp in param_sets], dtype=inputs.dtype)
             l1rs = np.asarray([float(sp["l1_ratio"]) for sp in param_sets], dtype=inputs.dtype)
             p0 = param_sets[0]  # statics are uniform per group key
+            from ..core import resolve_solver_precision
+
             common = dict(
                 fit_intercept=bool(p0["fit_intercept"]),
                 standardize=bool(p0.get("normalize", False)),
                 use_cd=bool(alphas[0] > 0 and l1rs[0] > 0),
                 max_iter=int(p0["max_iter"]),
                 tol=float(p0["tol"]),
+                fast=resolve_solver_precision(p0) == "bf16",
             )
             if inputs.X_sparse is not None:
                 ell_val, ell_idx = inputs.ell_rows()

@@ -96,6 +96,31 @@ def plan_blocks(
     return min(br, max(1, n_rows)), min(bk, max(1, k_side))
 
 
+def effective_itemsize(dtype, fast: bool) -> int:
+    """Bytes per element the kernel blocks ACTUALLY hold on-chip: the fast
+    path casts its VMEM tiles to bf16 (2 bytes), so planning with the input
+    dtype's itemsize (4 for f32) would budget half the tile the core can
+    hold. Pinned by tests/test_autotune.py."""
+    size = jnp.dtype(dtype).itemsize
+    return min(size, 2) if fast else size
+
+
+def _plan(
+    n_rows: int, k_side: int, d: int, dtype, fast: bool
+) -> Optional[Tuple[int, int]]:
+    """Block plan for one kernel dispatch: the measured autotuner's
+    persisted winner when one exists for this (shape-class, dtype, fast)
+    — else the static half-VMEM heuristic over the EFFECTIVE on-chip
+    itemsize. None still means "fall back to the jnp path" (enormous d)."""
+    heuristic = plan_blocks(n_rows, k_side, d, effective_itemsize(dtype, fast))
+    if heuristic is None:
+        return None
+    from . import autotune
+
+    tuned = autotune.lookup(n_rows, k_side, d, dtype, fast)
+    return tuned if tuned is not None else heuristic
+
+
 # ---------------------------------------------------------- backend probe ---
 
 
@@ -409,7 +434,7 @@ def assign_argmin(
     k, d = centers.shape
     c_sq = _c_sq(centers)
     plan = (
-        plan_blocks(xb.shape[0], k, d, xb.dtype.itemsize)
+        _plan(xb.shape[0], k, d, xb.dtype, fast)
         if _use_kernel()
         else None
     )
@@ -442,7 +467,7 @@ def assign_accumulate(
     kernel path."""
     k, d = centers.shape
     plan = (
-        plan_blocks(xb.shape[0], k, d, xb.dtype.itemsize)
+        _plan(xb.shape[0], k, d, xb.dtype, fast)
         if _use_kernel()
         else None
     )
@@ -586,7 +611,7 @@ def topk_tile(
     kk = min(kk, n)
     if item_sq is None:
         item_sq = row_sq(items)
-    plan = plan_blocks(q.shape[0], n, d, q.dtype.itemsize) if _use_kernel() else None
+    plan = _plan(q.shape[0], n, d, q.dtype, fast) if _use_kernel() else None
     use_kernel = plan is not None
     if k_tile is None:
         # fallback: one block (today's one-matmul shape, right for CPU);

@@ -146,15 +146,19 @@ def _lloyd_step_1dev(X, w, centers, batch_rows, fast=False):
 _ONE_DISPATCH_MAX_BYTES = 2 << 30
 
 
-@jax.jit
-def block_assign_accumulate(xb: jax.Array, wb: jax.Array, centers: jax.Array):
+@partial(jax.jit, static_argnames=("fast",))
+def block_assign_accumulate(
+    xb: jax.Array, wb: jax.Array, centers: jax.Array, fast: bool = False
+):
     """One streaming chunk's Lloyd contribution: (sums [k,d], counts [k],
     inertia) — the shared core's fused assign+accumulate
     (ops/distance.py), over ONE placed row block. The out-of-core driver
     (ops/streaming.py) sums these per-chunk partials across the
     double-buffered pipeline; padding rows carry zero weight, so they
-    contribute nothing — exactly the resident pad contract."""
-    return assign_accumulate(xb, wb, centers)
+    contribute nothing — exactly the resident pad contract. `fast` runs the
+    chunk's distance matmuls in the parity-tested fast-bf16 mode; the
+    streaming driver keeps its final inertia pass at full precision."""
+    return assign_accumulate(xb, wb, centers, fast=fast)
 
 
 def kmeans_ckpt_key(init_centers, max_iter: int, tol: float) -> str:
@@ -229,6 +233,15 @@ def kmeans_fit(
 
     centers = jnp.asarray(init_centers)
     fast = precision_mode == "fast" and X.dtype == jnp.float32
+    # measured autotuner (ops/autotune.py): make sure a tiling winner exists
+    # for this fit's tile shape BEFORE the jitted loop traces — the traced
+    # block planner then hits the persisted table; off-TPU (and with
+    # SRML_AUTOTUNE=0) this is a no-op and the static heuristic plans.
+    from . import autotune
+
+    autotune.ensure(
+        min(batch_rows, X.shape[0]), centers.shape[0], X.shape[1], X.dtype, fast
+    )
     inertia = jnp.zeros((), X.dtype)
     n_iter = 0
     one_dev = mesh.devices.size == 1
@@ -275,7 +288,12 @@ def kmeans_fit(
         # fingerprint (one tiny host fetch, once per fit) plus the loop
         # statics pin the trajectory; tol/maxIter only move the STOP point
         # on it, but keying them too keeps the entries disjoint and cheap.
+        # The fast flag is part of the trajectory too (bf16 assignments walk
+        # a different path), so bf16 keys apart — same suffix on the
+        # streaming driver, preserving the resident<->streaming sharing.
         ckpt_key = kmeans_ckpt_key(init_centers, max_iter, tol)
+        if fast:
+            ckpt_key = ckpt_key + ":bf16"
         saved = ckpt_store.load(ckpt_key)
         if saved is not None and tuple(saved.state["centers"].shape) == tuple(
             jnp.shape(centers)

@@ -28,18 +28,36 @@ def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, 
 
 
 def weighted_cov(
-    X: jax.Array, w: jax.Array, ddof: int = 1
+    X: jax.Array, w: jax.Array, ddof: int = 1, fast: bool = False
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Weighted covariance: returns (total_weight, mean [d], cov [d, d]).
 
     ``cov = Σ w_i (x_i-μ)(x_i-μ)ᵀ / (Σw - ddof)`` — matches the reference's
     sample covariance (cuML PCA divides by n-1). The centered outer-product
     contraction is one large MXU matmul per shard + one psum.
+
+    ``fast`` runs the big contraction bf16-in / f32-accumulate (the
+    solver_precision="bf16" contract, docs/performance.md "Mixed-precision
+    solvers"): weighting and centering stay at full precision, only the
+    [n,d]x[n,d] outer product is cast. Parity vs the full-precision cov is
+    pinned by tests/test_precision.py.
     """
     total_w = jnp.sum(w)
     mean = jnp.einsum("n,nd->d", w, X) / total_w
     Xc = X - mean
-    cov = jnp.einsum("nd,n,ne->de", Xc, w, Xc) / (total_w - ddof)
+    if fast:
+        # weights applied at FULL precision first — a mixed-dtype einsum
+        # would promote the bf16 operand straight back to f32 and defeat
+        # the cast; the bf16 dot accumulates in f32 on the MXU
+        Xcw = Xc * w[:, None]
+        cov = jnp.einsum(
+            "nd,ne->de",
+            Xcw.astype(jnp.bfloat16),
+            Xc.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ).astype(X.dtype) / (total_w - ddof)
+    else:
+        cov = jnp.einsum("nd,n,ne->de", Xc, w, Xc) / (total_w - ddof)
     return total_w, mean, cov
 
 

@@ -28,14 +28,30 @@ import jax
 import jax.numpy as jnp
 
 
-def _sufficient_stats(X, y, w):
-    """One distributed pass: (Σw, Σwx [d], Σwy, XᵀWX [d,d], XᵀWy [d], Σwy²)."""
+def _sufficient_stats(X, y, w, fast: bool = False):
+    """One distributed pass: (Σw, Σwx [d], Σwy, XᵀWX [d,d], XᵀWy [d], Σwy²).
+
+    ``fast`` (solver_precision="bf16") runs the O(n·d²) gram and the O(n·d)
+    correlation bf16-in / f32-accumulate; the weighting and every scalar
+    moment stay full precision (docs/performance.md "Mixed-precision
+    solvers"; parity pinned by tests/test_precision.py)."""
     sw = jnp.sum(w)
     sx = jnp.einsum("n,nd->d", w, X)
     sy = jnp.sum(w * y)
     Xw = X * w[:, None]
-    G = jnp.einsum("nd,ne->de", Xw, X)
-    c = jnp.einsum("nd,n->d", Xw, y)
+    if fast:
+        bXw = Xw.astype(jnp.bfloat16)
+        G = jnp.einsum(
+            "nd,ne->de", bXw, X.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ).astype(X.dtype)
+        c = jnp.einsum(
+            "nd,n->d", bXw, y.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ).astype(X.dtype)
+    else:
+        G = jnp.einsum("nd,ne->de", Xw, X)
+        c = jnp.einsum("nd,n->d", Xw, y)
     syy = jnp.sum(w * y * y)
     return sw, sx, sy, G, c, syy
 
@@ -86,7 +102,7 @@ def _cd_elastic_net(A, r, lam, l1_ratio, max_iter, tol):
     return b, n_iter
 
 
-@partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter", "use_cd"))
+@partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter", "use_cd", "fast"))
 def linear_fit(
     X: jax.Array,
     y: jax.Array,
@@ -99,13 +115,14 @@ def linear_fit(
     use_cd: bool = False,
     max_iter: int = 1000,
     tol: float = 1e-6,
+    fast: bool = False,
 ) -> Dict[str, jax.Array]:
     """Weighted linear regression on row-sharded global (X, y).
 
     `alpha` is Spark's regParam (per-sample-normalized objective); the Σw
-    scaling for the ridge path happens inside.
-    """
-    stats = _sufficient_stats(X, y, w)
+    scaling for the ridge path happens inside. `fast` runs the sufficient-
+    stat contractions bf16-in / f32-accumulate (`_sufficient_stats`)."""
+    stats = _sufficient_stats(X, y, w, fast)
     return _solve_from_stats(
         stats, X.dtype,
         alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
@@ -115,7 +132,7 @@ def linear_fit(
 
 @partial(
     jax.jit,
-    static_argnames=("d", "tile", "fit_intercept", "standardize", "max_iter", "use_cd"),
+    static_argnames=("d", "tile", "fit_intercept", "standardize", "max_iter", "use_cd", "fast"),
 )
 def linear_fit_ell(
     values: jax.Array,  # [n, k_max] padded-ELL (ops/sparse.py)
@@ -132,6 +149,7 @@ def linear_fit_ell(
     max_iter: int = 1000,
     tol: float = 1e-6,
     tile: int = 8192,
+    fast: bool = False,
 ) -> Dict[str, jax.Array]:
     """Sparse linear regression: identical math to `linear_fit` — the gram and
     moment sufficient statistics are accumulated from the ELL layout by
@@ -141,22 +159,29 @@ def linear_fit_ell(
     never the data, so sparsity is preserved AND full dense-parity holds
     (unlike the logistic path, no scale-only compromise is needed)."""
     return _solve_from_stats(
-        _ell_sufficient_stats(values, indices, y, w, d, tile), values.dtype,
+        _ell_sufficient_stats(values, indices, y, w, d, tile, fast), values.dtype,
         alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
         standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
     )
 
 
-def _ell_sufficient_stats(values, indices, y, w, d: int, tile: int):
-    """ELL-layout sufficient statistics (same tuple as `_sufficient_stats`)."""
+def _ell_sufficient_stats(values, indices, y, w, d: int, tile: int, fast: bool = False):
+    """ELL-layout sufficient statistics (same tuple as `_sufficient_stats`).
+
+    ``fast`` is the scatter-add analog of the dense bf16 contract: there is
+    no MXU dot to cast here, so the stored values feeding the gram and the
+    XᵀWy correlation are ROUNDED through bf16 once (bf16 inputs) while all
+    accumulation stays at full precision — same contract shape, parity
+    pinned by tests/test_precision.py."""
     from .sparse import ell_rmatvec
 
     dtype = values.dtype
+    gv = values.astype(jnp.bfloat16).astype(dtype) if fast else values
     sw = jnp.sum(w)
     sy = jnp.sum(w * y)
     syy = jnp.sum(w * y * y)
     sx = ell_rmatvec(values, indices, w, d)
-    c = ell_rmatvec(values, indices, w * y, d)
+    c = ell_rmatvec(gv, indices, w * y, d)
 
     # tiled gram accumulation: scan a reshape of the full-tile prefix (free,
     # contiguous view) + one direct tail step — never jnp.pad the whole block
@@ -180,13 +205,13 @@ def _ell_sufficient_stats(values, indices, y, w, d: int, tile: int):
             add_tile,
             G,
             (
-                values[:n_full].reshape(-1, tile, k_max),
+                gv[:n_full].reshape(-1, tile, k_max),
                 indices[:n_full].reshape(-1, tile, k_max),
                 w[:n_full].reshape(-1, tile),
             ),
         )
     if n - n_full:
-        G, _ = add_tile(G, (values[n_full:], indices[n_full:], w[n_full:]))
+        G, _ = add_tile(G, (gv[n_full:], indices[n_full:], w[n_full:]))
     return sw, sx, sy, G, c, syy
 
 
@@ -209,7 +234,7 @@ def _solve_grid_from_stats(
     return jax.vmap(solve)(alphas, l1_ratios)
 
 
-@partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter", "use_cd"))
+@partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter", "use_cd", "fast"))
 def linear_fit_batched(
     X: jax.Array,
     y: jax.Array,
@@ -222,6 +247,7 @@ def linear_fit_batched(
     use_cd: bool = False,
     max_iter: int = 1000,
     tol: float = 1e-6,
+    fast: bool = False,
 ) -> Dict[str, jax.Array]:
     """ONE compiled program solving a whole (alpha, l1_ratio) grid: the
     normal-equation sufficient statistics are computed in ONE distributed
@@ -230,7 +256,7 @@ def linear_fit_batched(
     program (it selects the solver), so the model layer groups grids by it.
 
     Returns the `linear_fit` dict with a leading [S] axis on every entry."""
-    stats = _sufficient_stats(X, y, w)
+    stats = _sufficient_stats(X, y, w, fast)
     return _solve_grid_from_stats(
         stats, X.dtype, alphas, l1_ratios,
         fit_intercept=fit_intercept, standardize=standardize, use_cd=use_cd,
@@ -240,7 +266,7 @@ def linear_fit_batched(
 
 @partial(
     jax.jit,
-    static_argnames=("d", "tile", "fit_intercept", "standardize", "max_iter", "use_cd"),
+    static_argnames=("d", "tile", "fit_intercept", "standardize", "max_iter", "use_cd", "fast"),
 )
 def linear_fit_ell_batched(
     values: jax.Array,
@@ -257,10 +283,11 @@ def linear_fit_ell_batched(
     max_iter: int = 1000,
     tol: float = 1e-6,
     tile: int = 8192,
+    fast: bool = False,
 ) -> Dict[str, jax.Array]:
     """Sparse (padded-ELL) analog of `linear_fit_batched`: one tiled gram
     accumulation feeds the whole grid's solves."""
-    stats = _ell_sufficient_stats(values, indices, y, w, d, tile)
+    stats = _ell_sufficient_stats(values, indices, y, w, d, tile, fast)
     return _solve_grid_from_stats(
         stats, values.dtype, alphas, l1_ratios,
         fit_intercept=fit_intercept, standardize=standardize, use_cd=use_cd,
@@ -319,8 +346,8 @@ def _solve_from_stats(
 # `_sufficient_stats` tuple order
 _STATS_NAMES = ("sw", "sx", "sy", "G", "c", "syy")
 
-_stats_jit = jax.jit(_sufficient_stats)
-_ell_stats_jit = jax.jit(_ell_sufficient_stats, static_argnames=("d", "tile"))
+_stats_jit = jax.jit(_sufficient_stats, static_argnames=("fast",))
+_ell_stats_jit = jax.jit(_ell_sufficient_stats, static_argnames=("d", "tile", "fast"))
 
 
 @partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter", "use_cd"))
@@ -387,15 +414,20 @@ def linear_fit_checkpointed(
     use_cd: bool = False,
     max_iter: int = 1000,
     tol: float = 1e-6,
+    fast: bool = False,
     ckpt_key: str = "linear_stats",
     placement_key=None,
 ) -> Dict[str, jax.Array]:
     """`linear_fit` with the sufficient statistics retained on host (see
     `_fit_from_retained_stats`). The statistics depend only on (X, y, w) —
     never on alpha/l1_ratio — so one retained pass serves a whole sequential
-    hyperparameter sweep AND any bounded-retry resume."""
+    hyperparameter sweep AND any bounded-retry resume. `fast` statistics are
+    keyed separately: a bf16 pass must never be resumed from (or serve) a
+    full-precision one."""
+    if fast:
+        ckpt_key = ckpt_key + ":bf16"
     return _fit_from_retained_stats(
-        lambda: _stats_jit(X, y, w), X.dtype,
+        lambda: _stats_jit(X, y, w, fast=fast), X.dtype,
         alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
         standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,
         ckpt_key=ckpt_key, placement_key=placement_key,
@@ -417,13 +449,16 @@ def linear_fit_ell_checkpointed(
     max_iter: int = 1000,
     tol: float = 1e-6,
     tile: int = 8192,
+    fast: bool = False,
     ckpt_key: str = "linear_stats_ell",
     placement_key=None,
 ) -> Dict[str, jax.Array]:
     """Sparse (padded-ELL) analog of `linear_fit_checkpointed`: the tiled
     gram accumulation is the retained pass."""
+    if fast:
+        ckpt_key = ckpt_key + ":bf16"
     return _fit_from_retained_stats(
-        lambda: _ell_stats_jit(values, indices, y, w, d=d, tile=min(tile, values.shape[0])),
+        lambda: _ell_stats_jit(values, indices, y, w, d=d, tile=min(tile, values.shape[0]), fast=fast),
         values.dtype,
         alpha=alpha, l1_ratio=l1_ratio, fit_intercept=fit_intercept,
         standardize=standardize, use_cd=use_cd, max_iter=max_iter, tol=tol,

@@ -326,6 +326,7 @@ def _lbfgs_minimize(loss, params0, max_iter: int, tol: float, memory: int = 10):
     jax.jit,
     static_argnames=(
         "k", "fit_intercept", "standardize", "max_iter", "lbfgs_memory", "multinomial", "use_l1",
+        "fast",
     ),
 )
 def logistic_fit(
@@ -344,16 +345,19 @@ def logistic_fit(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    fast: bool = False,
     warm_start=None,  # (coef [k_out, d], intercept [k_out]) original-space seed
 ) -> Dict[str, jax.Array]:
     """Returns coef_ [k_out, d] and intercept_ [k_out] in ORIGINAL feature space
     (standardization folded out), plus objective_ and n_iter_. `warm_start`
     seeds the iterate from a previous model's coefficients (the public
-    warm_start_from API, docs/scheduling.md "Warm starts")."""
+    warm_start_from API, docs/scheduling.md "Warm starts"). `fast` runs the
+    per-iteration matvecs bf16-in / f32-accumulate (`_dense_ops`)."""
     d = X.shape[1]
     mu, d_scale, total_w = _make_scaling(X, w, standardize, fit_intercept)
+    matvec, rmat = _dense_ops(X, fast)
     return _fit_common(
-        lambda Beff: X @ Beff, lambda r: X.T @ r, X.shape[0],
+        matvec, rmat, X.shape[0],
         X.dtype, d, y_idx, w, mu, d_scale, total_w,
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol, lbfgs_memory=lbfgs_memory,
@@ -365,7 +369,7 @@ def logistic_fit(
     jax.jit,
     static_argnames=(
         "d", "k", "fit_intercept", "standardize", "max_iter", "lbfgs_memory", "multinomial",
-        "use_l1",
+        "use_l1", "fast",
     ),
 )
 def logistic_fit_ell(
@@ -385,6 +389,7 @@ def logistic_fit_ell(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    fast: bool = False,
     warm_start=None,
 ) -> Dict[str, jax.Array]:
     """Sparse (padded-ELL) logistic fit. Standardization is SCALE-ONLY — the
@@ -393,7 +398,7 @@ def logistic_fit_ell(
     standardizes sparse input without mean subtraction). Coefficients return in
     original space; no mu offset is folded into the intercept."""
     mu, d_scale, total_w = _ell_scaling(values, indices, w, d, standardize)
-    matvec, rmat = _ell_ops(values, indices, d)
+    matvec, rmat = _ell_ops(values, indices, d, fast)
     return _fit_common(
         matvec, rmat, values.shape[0],
         values.dtype, d, y_idx, w, mu, d_scale, total_w,
@@ -419,23 +424,58 @@ def _ell_scaling(values, indices, w, d: int, standardize: bool):
     return mu, d_scale, total_w
 
 
-def _ell_ops(values, indices, d: int):
-    """(matvec, rmat) closures over the ELL layout for `_fit_common`."""
+def _dense_ops(X, fast: bool = False):
+    """(matvec, rmat) closures over dense X for `_fit_common`. ``fast``
+    (solver_precision="bf16") runs the X·β forward and Xᵀr gradient matvecs
+    — the two O(n·d) contractions every L-BFGS iteration pays twice — with
+    bf16 inputs and f32 accumulation on the MXU; the L-BFGS state, line
+    search, and convergence scalars downstream stay at the ambient
+    precision (docs/performance.md "Mixed-precision solvers"; parity pinned
+    by tests/test_precision.py)."""
+    if not fast:
+        return (lambda Beff: X @ Beff), (lambda r: X.T @ r)
+    bX = X.astype(jnp.bfloat16)
+
+    def matvec(Beff):
+        return jax.lax.dot(
+            bX, Beff.astype(jnp.bfloat16),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        ).astype(X.dtype)
+
+    def rmat(r):
+        return jax.lax.dot(
+            bX.T, r.astype(jnp.bfloat16),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        ).astype(X.dtype)
+
+    return matvec, rmat
+
+
+def _ell_ops(values, indices, d: int, fast: bool = False):
+    """(matvec, rmat) closures over the ELL layout for `_fit_common`.
+    ``fast`` is the scatter-path analog of `_dense_ops`' bf16 contract:
+    no MXU dot to cast, so the stored values are ROUNDED through bf16 once
+    (bf16 inputs) while all accumulation stays at the ambient precision."""
     from .sparse import ell_matmul, ell_rmatvec
+
+    gv = values.astype(jnp.bfloat16).astype(values.dtype) if fast else values
 
     def rmat(r):  # Xᵀ r via per-column ELL scatter
         return jnp.stack(
-            [ell_rmatvec(values, indices, r[:, j], d) for j in range(r.shape[1])],
+            [ell_rmatvec(gv, indices, r[:, j], d) for j in range(r.shape[1])],
             axis=1,
         )
 
-    return (lambda Beff: ell_matmul(values, indices, Beff)), rmat
+    return (lambda Beff: ell_matmul(gv, indices, Beff)), rmat
 
 
 @partial(
     jax.jit,
     static_argnames=(
         "k", "fit_intercept", "standardize", "max_iter", "lbfgs_memory", "multinomial", "use_l1",
+        "fast",
     ),
 )
 def logistic_fit_batched(
@@ -453,6 +493,7 @@ def logistic_fit_batched(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    fast: bool = False,
 ) -> Dict[str, jax.Array]:
     """ONE compiled program that solves a whole (lam_l2, lam_l1) grid.
 
@@ -469,10 +510,11 @@ def logistic_fit_batched(
     Returns the `logistic_fit` dict with a leading [S] axis on every entry."""
     d = X.shape[1]
     mu, d_scale, total_w = _make_scaling(X, w, standardize, fit_intercept)
+    matvec, rmat = _dense_ops(X, fast)
 
     def fit_one(lam_l2, lam_l1):
         return _fit_common(
-            lambda Beff: X @ Beff, lambda r: X.T @ r, X.shape[0],
+            matvec, rmat, X.shape[0],
             X.dtype, d, y_idx, w, mu, d_scale, total_w,
             k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
             fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
@@ -486,7 +528,7 @@ def logistic_fit_batched(
     jax.jit,
     static_argnames=(
         "d", "k", "fit_intercept", "standardize", "max_iter", "lbfgs_memory", "multinomial",
-        "use_l1",
+        "use_l1", "fast",
     ),
 )
 def logistic_fit_ell_batched(
@@ -506,11 +548,12 @@ def logistic_fit_ell_batched(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    fast: bool = False,
 ) -> Dict[str, jax.Array]:
     """Sparse (padded-ELL) analog of `logistic_fit_batched`: one program for
     the whole grid, scale-only standardization computed once and shared."""
     mu, d_scale, total_w = _ell_scaling(values, indices, w, d, standardize)
-    matvec, rmat = _ell_ops(values, indices, d)
+    matvec, rmat = _ell_ops(values, indices, d, fast)
 
     def fit_one(lam_l2, lam_l1):
         return _fit_common(
@@ -749,6 +792,7 @@ def logistic_fit_checkpointed(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    fast: bool = False,
     ckpt_key: str = "logistic",
     placement_key=None,
     warm_start=None,
@@ -757,11 +801,15 @@ def logistic_fit_checkpointed(
     (shared closures), segmented loop. The model layer routes here when
     ``config["checkpoint_every_iters"]`` > 0 and a `CheckpointStore` is
     active; a same-placement resume is bit-identical to an uninterrupted
-    checkpointed fit (pinned by tests/test_recovery.py)."""
+    checkpointed fit (pinned by tests/test_recovery.py). `fast` trajectories
+    are keyed apart — a bf16 solve must never resume a full-precision one."""
     d = X.shape[1]
     mu, d_scale, total_w = _make_scaling(X, w, standardize, fit_intercept)
+    if fast:
+        ckpt_key = ckpt_key + ":bf16"
+    matvec, rmat = _dense_ops(X, fast)
     return _fit_common_checkpointed(
-        lambda Beff: X @ Beff, lambda r: X.T @ r, X.shape[0],
+        matvec, rmat, X.shape[0],
         X.dtype, d, y_idx, w, mu, d_scale, total_w,
         k=k, multinomial=multinomial, lam_l2=lam_l2, lam_l1=lam_l1, use_l1=use_l1,
         fit_intercept=fit_intercept, max_iter=max_iter, tol=tol,
@@ -787,6 +835,7 @@ def logistic_fit_ell_checkpointed(
     max_iter: int = 100,
     tol: float = 1e-6,
     lbfgs_memory: int = 10,
+    fast: bool = False,
     ckpt_key: str = "logistic_ell",
     placement_key=None,
     warm_start=None,
@@ -794,7 +843,9 @@ def logistic_fit_ell_checkpointed(
     """Sparse (padded-ELL) analog of `logistic_fit_checkpointed` — scale-only
     standardization, same closures as `logistic_fit_ell`, segmented loop."""
     mu, d_scale, total_w = _ell_scaling(values, indices, w, d, standardize)
-    matvec, rmat = _ell_ops(values, indices, d)
+    if fast:
+        ckpt_key = ckpt_key + ":bf16"
+    matvec, rmat = _ell_ops(values, indices, d, fast)
     return _fit_common_checkpointed(
         matvec, rmat, values.shape[0],
         values.dtype, d, y_idx, w, mu, d_scale, total_w,

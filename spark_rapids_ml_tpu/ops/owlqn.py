@@ -190,7 +190,11 @@ def _owlqn_setup(
         d = jnp.where(d * (-pg) > 0, d, 0.0)
         xi = jnp.where(x != 0, jnp.sign(x), jnp.sign(-pg))
         xn, fn, ok = line_search(x, d, f_cur, pg, xi)
-        gn = grad_f(xn)
+        # pin the gradient to the iterate's dtype: under the bf16 solver
+        # contract the loss closes over bf16-input matvecs, and autodiff of
+        # a mixed-precision loss must not leak a narrowed dtype into the
+        # L-BFGS S/Y memory (docs/performance.md "Mixed-precision solvers")
+        gn = grad_f(xn).astype(x0.dtype)
         s = xn - x
         y = gn - g
         sy = jnp.dot(s, y)
@@ -209,7 +213,7 @@ def _owlqn_setup(
             )
         return x, g, S, Y, rho, (count, pos), f_cur, f_new, it + 1, ~ok
 
-    g0 = grad_f(x0)
+    g0 = grad_f(x0).astype(x0.dtype)  # same dtype pin as the in-loop gradient
     f0 = f_total(x0)
     state0 = (
         x0, g0,

@@ -59,25 +59,27 @@ def record_pca_fit(state: Dict[str, jax.Array], *, k: int) -> None:
     )
 
 
-@partial(jax.jit, static_argnames=("k",))
-def pca_fit(X: jax.Array, w: jax.Array, *, k: int) -> Dict[str, jax.Array]:
+@partial(jax.jit, static_argnames=("k", "fast"))
+def pca_fit(X: jax.Array, w: jax.Array, *, k: int, fast: bool = False) -> Dict[str, jax.Array]:
     """Fit PCA on a row-sharded global X with padding/sample weights w.
 
     Returns the model-state dict matching the reference's model attributes
     (reference feature.py:250-257): mean_, components_, explained_variance_,
     explained_variance_ratio_, singular_values_. `components_` rows are always
     unit-norm (cuML/sklearn store unwhitened components; whitening is applied
-    at transform time).
+    at transform time). `fast` runs the covariance contraction bf16-in /
+    f32-accumulate (linalg.weighted_cov); the eigendecomposition and every
+    reported variance stay full precision.
     """
-    total_w, mean, cov = weighted_cov(X, w, ddof=1)
+    total_w, mean, cov = weighted_cov(X, w, ddof=1, fast=fast)
     # one shared finish kernel with the checkpointed path (stats -> model),
     # so the two entry points cannot drift
     return _pca_finish(total_w, mean, cov, k=k)
 
 
-@jax.jit
-def _pca_stats(X: jax.Array, w: jax.Array):
-    return weighted_cov(X, w, ddof=1)
+@partial(jax.jit, static_argnames=("fast",))
+def _pca_stats(X: jax.Array, w: jax.Array, fast: bool = False):
+    return weighted_cov(X, w, ddof=1, fast=fast)
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -98,7 +100,7 @@ def _pca_finish(total_w, mean, cov, *, k: int) -> Dict[str, jax.Array]:
 
 
 def pca_fit_checkpointed(
-    X: jax.Array, w: jax.Array, *, k: int,
+    X: jax.Array, w: jax.Array, *, k: int, fast: bool = False,
     ckpt_key: str = "pca_stats", placement_key=None,
 ) -> Dict[str, jax.Array]:
     """`pca_fit` with the sufficient statistics — weighted (total_w, mean,
@@ -114,9 +116,13 @@ def pca_fit_checkpointed(
     from ..parallel import chaos
 
     store = _ckpt.active_store()
+    if fast:
+        # bf16 statistics are keyed apart: a bf16 pass must never be
+        # resumed from (or serve) a full-precision one
+        ckpt_key = ckpt_key + ":bf16"
 
     def compute() -> Dict:
-        total_w, mean, cov = _pca_stats(X, w)
+        total_w, mean, cov = _pca_stats(X, w, fast=fast)
         return {
             "total_w": np.asarray(total_w),
             "mean": np.asarray(mean),

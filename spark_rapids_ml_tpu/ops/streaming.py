@@ -149,11 +149,13 @@ def _ell_block_iter(
 # ------------------------------------------------------- linear / PCA -------
 
 
-def linear_streaming_stats(inputs: Any) -> Dict[str, np.ndarray]:
+def linear_streaming_stats(inputs: Any, fast: bool = False) -> Dict[str, np.ndarray]:
     """One streamed pass accumulating the normal-equation sufficient
     statistics (ops/linear._sufficient_stats tuple) — dense or padded-ELL.
     Padding rows carry zero weight and zero features, so per-chunk partials
-    sum to exactly the resident statistics (up to summation rounding)."""
+    sum to exactly the resident statistics (up to summation rounding).
+    ``fast`` runs each chunk's stat contractions bf16-in / f32-accumulate;
+    the cross-chunk host accumulation stays at full precision."""
     from .linear import _STATS_NAMES, _ell_stats_jit, _stats_jit
 
     dtype = inputs.dtype
@@ -168,7 +170,8 @@ def linear_streaming_stats(inputs: Any) -> Dict[str, np.ndarray]:
             inputs.mesh, _ell_block_iter(inputs, extras, cache=False)
         ):
             part = _ell_stats_jit(
-                blk["val"], blk["idx"], blk["y"], blk["w"], d=d, tile=8192
+                blk["val"], blk["idx"], blk["y"], blk["w"], d=d, tile=8192,
+                fast=fast,
             )
             part = [np.asarray(p) for p in part]
             if _nc is not None:
@@ -177,7 +180,7 @@ def linear_streaming_stats(inputs: Any) -> Dict[str, np.ndarray]:
             acc = part if acc is None else [a + b for a, b in zip(acc, part)]
     else:
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, extras)):
-            part = _stats_jit(blk["X"], blk["y"], blk["w"])
+            part = _stats_jit(blk["X"], blk["y"], blk["w"], fast=fast)
             part = [np.asarray(p) for p in part]
             if _nc is not None:
                 _nc("linear_stream.chunk", solver="linear_stream",
@@ -197,12 +200,14 @@ def linear_fit_streaming(
     use_cd: bool = False,
     max_iter: int = 1000,
     tol: float = 1e-6,
+    fast: bool = False,
 ) -> Dict[str, jax.Array]:
     """Out-of-core linear regression: the one streamed statistics pass feeds
     the SAME replicated (d, d) solve as the resident path. The statistics are
     retained in the active `CheckpointStore` (when one is installed), so a
     transient retry — or every further param set of a sequential sweep —
-    skips the data pass, exactly like the resident checkpointed fit."""
+    skips the data pass, exactly like the resident checkpointed fit. `fast`
+    statistics are keyed apart from full-precision ones."""
     from .. import checkpoint as _ckpt
     from ..parallel import chaos
     from .linear import _STATS_NAMES, _solve_stats_jit
@@ -210,14 +215,16 @@ def linear_fit_streaming(
     dtype = inputs.dtype
     store = _ckpt.active_store()
     key = "linear_stats_stream" + ("_ell" if inputs.X_sparse is not None else "")
+    if fast:
+        key = key + ":bf16"
     pkey = ("stream", int(inputs.n_valid), int(inputs.n_cols), np.dtype(dtype).name)
     if store is not None:
         state = store.get_or_compute(
-            key, lambda: linear_streaming_stats(inputs), solver="linear",
+            key, lambda: linear_streaming_stats(inputs, fast=fast), solver="linear",
             placement_key=pkey,
         )
     else:
-        state = linear_streaming_stats(inputs)
+        state = linear_streaming_stats(inputs, fast=fast)
     chaos.maybe_fail_stage("solve", 0)
     stats = tuple(jnp.asarray(state[n], dtype) for n in _STATS_NAMES)
     return _solve_stats_jit(
@@ -237,20 +244,32 @@ def _moments_block(xb, wb):
     )
 
 
-@jax.jit
-def _cov_block(xb, wb, mean):
+@partial(jax.jit, static_argnames=("fast",))
+def _cov_block(xb, wb, mean, fast: bool = False):
     """Per-chunk CENTERED outer-product sum: Σ w (x-μ)(x-μ)ᵀ. Padding rows
-    contribute (0-μ) terms scaled by w=0 — nothing."""
+    contribute (0-μ) terms scaled by w=0 — nothing. ``fast`` runs the outer
+    product bf16-in / f32-accumulate (weights applied at full precision
+    first — linalg.weighted_cov's contract)."""
     xc = xb - mean
+    if fast:
+        xcw = xc * wb[:, None]
+        return jnp.einsum(
+            "nd,ne->de",
+            xcw.astype(jnp.bfloat16),
+            xc.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ).astype(xb.dtype)
     return jnp.einsum("nd,n,ne->de", xc, wb, xc)
 
 
-def pca_fit_streaming(inputs: Any, *, k: int) -> Dict[str, jax.Array]:
+def pca_fit_streaming(inputs: Any, *, k: int, fast: bool = False) -> Dict[str, jax.Array]:
     """Out-of-core PCA: two streamed passes — weighted mean, then the
     CENTERED covariance (the same ``Σw(x-μ)(x-μ)ᵀ/(Σw-1)`` formula as
     linalg.weighted_cov, never the cancellation-prone uncentered form) — and
     the SAME finish kernel as the resident fit. Statistics retained through
-    the checkpoint store like the resident checkpointed path."""
+    the checkpoint store like the resident checkpointed path. ``fast``
+    applies to each chunk's covariance contraction only; the mean pass and
+    the eigendecomposition stay full precision."""
     from .. import checkpoint as _ckpt
     from ..parallel import chaos
     from .pca import _pca_finish
@@ -274,7 +293,7 @@ def pca_fit_streaming(inputs: Any, *, k: int) -> Dict[str, jax.Array]:
         mean_dev = jnp.asarray(mean, dtype)
         cov_sum = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
-            part = np.asarray(_cov_block(blk["X"], blk["w"], mean_dev))  # host-fetch-ok: out-of-core by design — per-CHUNK [d,d] covariance partial accumulates on host
+            part = np.asarray(_cov_block(blk["X"], blk["w"], mean_dev, fast=fast))  # host-fetch-ok: out-of-core by design — per-CHUNK [d,d] covariance partial accumulates on host
             if _nc is not None:
                 _nc("pca_stream.chunk", solver="pca_stream", cov_partial=part)
             cov_sum = part if cov_sum is None else cov_sum + part
@@ -284,10 +303,12 @@ def pca_fit_streaming(inputs: Any, *, k: int) -> Dict[str, jax.Array]:
         return {"total_w": np.asarray(sw), "mean": np.asarray(mean), "cov": cov}
 
     store = _ckpt.active_store()
+    # bf16 statistics are keyed apart from full-precision ones
+    stats_key = "pca_stats_stream" + (":bf16" if fast else "")
     pkey = ("stream", int(inputs.n_valid), int(inputs.n_cols), np.dtype(dtype).name)
     if store is not None:
         state = store.get_or_compute(
-            "pca_stats_stream", compute, solver="pca", placement_key=pkey
+            stats_key, compute, solver="pca", placement_key=pkey
         )
     else:
         state = compute()
@@ -310,6 +331,7 @@ def kmeans_fit_streaming(
     max_iter: int = 20,
     tol: float = 1e-4,
     final_inertia: bool = True,
+    precision_mode: str = "high",
 ) -> Dict[str, jax.Array]:
     """Out-of-core Lloyd: each iteration streams the row chunks through the
     double-buffered pipeline, accumulating (sums, counts, inertia) per chunk.
@@ -318,7 +340,12 @@ def kmeans_fit_streaming(
     the resident `kmeans_fit` loop verbatim, and the checkpoint key is
     SHARED with it (`kmeans_ckpt_key`), so a resident fit interrupted by an
     OOM resumes on this path from its own checkpoint (centers are replicated
-    state: fully portable)."""
+    state: fully portable).
+
+    precision_mode: "high" (default) keeps every chunk at the ambient
+    precision; "fast" (solver_precision="bf16", f32 inputs only) runs the
+    IN-LOOP chunk assignment matmuls in one-pass bf16 — the final inertia
+    pass always reruns at full precision, resident-contract parity."""
     from .. import checkpoint as _ckpt
     from ..parallel import chaos
     from .kmeans import (
@@ -329,14 +356,15 @@ def kmeans_fit_streaming(
     )
 
     dtype = inputs.dtype
+    fast = precision_mode == "fast" and dtype == jnp.float32
     w = np.asarray(inputs.w, dtype=dtype)
     centers = jnp.asarray(np.asarray(init_centers), dtype=dtype)
     _nc = numcheck.hook()  # SRML_NUMCHECK=1: chunk partials + iterate boundary
 
-    def step(c):
+    def step(c, f=False):
         sums = counts = inertia = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
-            s, n_, i_ = block_assign_accumulate(blk["X"], blk["w"], c)
+            s, n_, i_ = block_assign_accumulate(blk["X"], blk["w"], c, fast=f)
             s, n_, i_ = np.asarray(s), np.asarray(n_), np.asarray(i_)  # host-fetch-ok: out-of-core by design — per-CHUNK [k,d] assignment partials accumulate on host
             if _nc is not None:
                 _nc("kmeans_stream.chunk", solver="kmeans_stream",
@@ -359,6 +387,8 @@ def kmeans_fit_streaming(
     ckpt_key = None
     if ckpt_store is not None and ckpt_every > 0:
         ckpt_key = kmeans_ckpt_key(init_centers, max_iter, tol)
+        if fast:  # bf16 trajectories key apart (same suffix as the resident loop)
+            ckpt_key = ckpt_key + ":bf16"
         saved = ckpt_store.load(ckpt_key)
         if saved is not None and tuple(saved.state["centers"].shape) == tuple(
             jnp.shape(centers)
@@ -371,7 +401,7 @@ def kmeans_fit_streaming(
             prev_shift = None if ps is None else float(ps)
     while n_iter < max_iter:
         step_in = centers
-        centers, inertia, shift = step(centers)
+        centers, inertia, shift = step(centers, fast)
         n_iter += 1
         if prev_shift is not None:
             shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (resident-loop parity) — overlapped with the current step's compute
@@ -408,7 +438,9 @@ def kmeans_fit_streaming(
     if telemetry.enabled():
         telemetry.record_solver_result("kmeans", n_iter=n_iter)
     if final_inertia:
-        _, inertia, _ = step(centers)
+        # always at full precision: the REPORTED inertia (and the divergence
+        # guard on it) must never see bf16 rounding, resident-loop parity
+        _, inertia, _ = step(centers, False)
         inertia_host = float(inertia)
         if not math.isfinite(inertia_host):
             _raise_diverged(n_iter, last_good, f"final inertia = {inertia_host}")
@@ -458,58 +490,83 @@ def _search_losses(zb, z_d, yb, wb, alphas, multinomial: bool):
     return jnp.einsum("n,ns->s", wb, jax.nn.softplus(z) - yf[:, None] * z)
 
 
-@partial(jax.jit, static_argnames=("k", "multinomial"))
-def _glm_eval_block_dense(xb, yb, wb, Beff, offset, total_w, *, k, multinomial):
+def _fdot(a, b, fast: bool):
+    """a @ b, optionally on the bf16-compute / f32-accumulate contract
+    (``solver_precision="bf16"``): both operands rounded to bf16 so the MXU
+    runs its native-width pass, `preferred_element_type` pins the f32
+    accumulator, result cast back to the working dtype. Mirrors
+    ops/logistic._dense_ops for the resident solver."""
+    if not fast:
+        return a @ b
+    return jax.lax.dot(
+        a.astype(jnp.bfloat16),
+        b.astype(jnp.bfloat16),
+        precision=jax.lax.Precision.DEFAULT,
+        preferred_element_type=jnp.float32,
+    ).astype(a.dtype)
+
+
+@partial(jax.jit, static_argnames=("k", "multinomial", "fast"))
+def _glm_eval_block_dense(xb, yb, wb, Beff, offset, total_w, *, k, multinomial, fast=False):
     """z + loss + gradient partials for one dense chunk (the init/warm pass)."""
-    z = xb @ Beff + offset[None, :]
+    z = _fdot(xb, Beff, fast) + offset[None, :]
     loss = _glm_loss_block(z, yb, wb, multinomial=multinomial)
     r = _glm_residual(z, yb, wb, total_w, k, multinomial)
-    return z, loss, xb.T @ r, jnp.sum(r, axis=0)
+    return z, loss, _fdot(xb.T, r, fast), jnp.sum(r, axis=0)
 
 
-@partial(jax.jit, static_argnames=("multinomial",))
-def _glm_search_block_dense(xb, zb, yb, wb, Beff_d, offset_d, alphas, *, multinomial):
+@partial(jax.jit, static_argnames=("multinomial", "fast"))
+def _glm_search_block_dense(xb, zb, yb, wb, Beff_d, offset_d, alphas, *, multinomial, fast=False):
     """Line-search pass: the direction's logits z_d (ONE data read) and the
     batched-Armijo candidate losses for all step sizes from it."""
-    z_d = xb @ Beff_d + offset_d[None, :]
+    z_d = _fdot(xb, Beff_d, fast) + offset_d[None, :]
     return z_d, _search_losses(zb, z_d, yb, wb, alphas, multinomial)
 
 
-@partial(jax.jit, static_argnames=("k", "multinomial"))
-def _glm_grad_block_dense(xb, zb, yb, wb, total_w, *, k, multinomial):
+@partial(jax.jit, static_argnames=("k", "multinomial", "fast"))
+def _glm_grad_block_dense(xb, zb, yb, wb, total_w, *, k, multinomial, fast=False):
     """Gradient pass: analytic Xᵀ·residual from the accepted logits."""
     r = _glm_residual(zb, yb, wb, total_w, k, multinomial)
-    return xb.T @ r, jnp.sum(r, axis=0)
+    return _fdot(xb.T, r, fast), jnp.sum(r, axis=0)
 
 
-@partial(jax.jit, static_argnames=("d", "k", "multinomial"))
-def _glm_eval_block_ell(val, idx, yb, wb, Beff, offset, total_w, *, d, k, multinomial):
+def _ell_fast_values(val, fast: bool):
+    """ELL gather/scatter has no MXU contraction to cast — the honest bf16
+    analog (resident ops/logistic._ell_ops parity) rounds the stored values
+    once; index arithmetic and accumulation stay full precision."""
+    return val.astype(jnp.bfloat16).astype(val.dtype) if fast else val
+
+
+@partial(jax.jit, static_argnames=("d", "k", "multinomial", "fast"))
+def _glm_eval_block_ell(val, idx, yb, wb, Beff, offset, total_w, *, d, k, multinomial, fast=False):
     from .sparse import ell_matmul, ell_rmatvec
 
-    z = ell_matmul(val, idx, Beff) + offset[None, :]
+    gv = _ell_fast_values(val, fast)
+    z = ell_matmul(gv, idx, Beff) + offset[None, :]
     loss = _glm_loss_block(z, yb, wb, multinomial=multinomial)
     r = _glm_residual(z, yb, wb, total_w, k, multinomial)
     g = jnp.stack(
-        [ell_rmatvec(val, idx, r[:, j], d) for j in range(r.shape[1])], axis=1
+        [ell_rmatvec(gv, idx, r[:, j], d) for j in range(r.shape[1])], axis=1
     )
     return z, loss, g, jnp.sum(r, axis=0)
 
 
-@partial(jax.jit, static_argnames=("multinomial",))
-def _glm_search_block_ell(val, idx, zb, yb, wb, Beff_d, offset_d, alphas, *, multinomial):
+@partial(jax.jit, static_argnames=("multinomial", "fast"))
+def _glm_search_block_ell(val, idx, zb, yb, wb, Beff_d, offset_d, alphas, *, multinomial, fast=False):
     from .sparse import ell_matmul
 
-    z_d = ell_matmul(val, idx, Beff_d) + offset_d[None, :]
+    z_d = ell_matmul(_ell_fast_values(val, fast), idx, Beff_d) + offset_d[None, :]
     return z_d, _search_losses(zb, z_d, yb, wb, alphas, multinomial)
 
 
-@partial(jax.jit, static_argnames=("d", "k", "multinomial"))
-def _glm_grad_block_ell(val, idx, zb, yb, wb, total_w, *, d, k, multinomial):
+@partial(jax.jit, static_argnames=("d", "k", "multinomial", "fast"))
+def _glm_grad_block_ell(val, idx, zb, yb, wb, total_w, *, d, k, multinomial, fast=False):
     from .sparse import ell_rmatvec
 
+    gv = _ell_fast_values(val, fast)
     r = _glm_residual(zb, yb, wb, total_w, k, multinomial)
     g = jnp.stack(
-        [ell_rmatvec(val, idx, r[:, j], d) for j in range(r.shape[1])], axis=1
+        [ell_rmatvec(gv, idx, r[:, j], d) for j in range(r.shape[1])], axis=1
     )
     return g, jnp.sum(r, axis=0)
 
@@ -585,6 +642,7 @@ def logistic_fit_streaming(
     lbfgs_memory: int = 10,
     n_alphas: int = 12,
     c1: float = 1e-4,
+    fast: bool = False,
     ckpt_key: str = "logistic_stream",
 ) -> Dict[str, jax.Array]:
     """Out-of-core logistic regression (smooth L2 path; the L1/elastic-net
@@ -604,6 +662,10 @@ def logistic_fit_streaming(
     from .logistic import _finish_glm
     from .owlqn import lbfgs_two_loop
 
+    if fast:
+        # bf16 iterates/logits are keyed apart: a bf16 run must never resume
+        # from (or serve) a full-precision checkpoint
+        ckpt_key = ckpt_key + ":bf16"
     dtype = np.dtype(inputs.dtype)
     d = int(inputs.n_cols)
     k_out = k if multinomial else 1
@@ -668,12 +730,12 @@ def logistic_fit_streaming(
             if sparse:
                 z, l_, g, sr = _glm_eval_block_ell(
                     blk["val"], blk["idx"], blk["y"], blk["w"], Beff, off,
-                    total_w_f, d=d, k=k, multinomial=multinomial,
+                    total_w_f, d=d, k=k, multinomial=multinomial, fast=fast,
                 )
             else:
                 z, l_, g, sr = _glm_eval_block_dense(
                     blk["X"], blk["y"], blk["w"], Beff, off, total_w_f,
-                    k=k, multinomial=multinomial,
+                    k=k, multinomial=multinomial, fast=fast,
                 )
             z_blocks.append(np.asarray(z)[: row_counts[bi]])  # host-fetch-ok: out-of-core by design — per-CHUNK logits retained on host (z-block reuse saves an X pass per line search)
             loss += float(l_)  # host-fetch-ok: per-CHUNK scalar loss partial, accumulated on host
@@ -751,12 +813,12 @@ def logistic_fit_streaming(
             if sparse:
                 z_d, part = _glm_search_block_ell(
                     blk["val"], blk["idx"], blk["z"], blk["y"], blk["w"],
-                    Beff_d, off_d, alphas_dev, multinomial=multinomial,
+                    Beff_d, off_d, alphas_dev, multinomial=multinomial, fast=fast,
                 )
             else:
                 z_d, part = _glm_search_block_dense(
                     blk["X"], blk["z"], blk["y"], blk["w"], Beff_d, off_d,
-                    alphas_dev, multinomial=multinomial,
+                    alphas_dev, multinomial=multinomial, fast=fast,
                 )
             z_d_blocks.append(np.asarray(z_d)[: row_counts[bi]])  # host-fetch-ok: out-of-core by design — per-CHUNK direction logits retained on host
             loss_cand = loss_cand + np.asarray(part)  # host-fetch-ok: per-CHUNK batched-Armijo loss partials, accumulated on host
@@ -785,12 +847,12 @@ def logistic_fit_streaming(
             if sparse:
                 gb, sr = _glm_grad_block_ell(
                     blk["val"], blk["idx"], blk["z"], blk["y"], blk["w"],
-                    total_w_f, d=d, k=k, multinomial=multinomial,
+                    total_w_f, d=d, k=k, multinomial=multinomial, fast=fast,
                 )
             else:
                 gb, sr = _glm_grad_block_dense(
                     blk["X"], blk["z"], blk["y"], blk["w"], total_w_f,
-                    k=k, multinomial=multinomial,
+                    k=k, multinomial=multinomial, fast=fast,
                 )
             g_beff = g_beff + np.asarray(gb)  # host-fetch-ok: per-CHUNK gradient partial at the accepted point, accumulated on host
             sum_r = sum_r + np.asarray(sr)  # host-fetch-ok: per-CHUNK residual-sum partial, accumulated on host

@@ -1,0 +1,248 @@
+#
+# Measured block autotuner (spark_rapids_ml_tpu/ops/autotune.py,
+# docs/performance.md "Kernel autotuner") and the planner it overrides
+# (distance.effective_itemsize / _plan). The acceptance contract:
+#
+#   - the fast path budgets VMEM at the EFFECTIVE on-chip itemsize (bf16
+#     blocks = 2 bytes), never the input dtype's;
+#   - a measured winner persists as JSON beside the compile cache and is
+#     reused ACROSS PROCESSES (simulated here by dropping the in-memory
+#     cache), hit/miss counters pinned;
+#   - every degradation path — disabled, off-TPU, malformed table, stale
+#     version, bad entries, raising timer, unset cache dir — falls back to
+#     the heuristic without raising; a fit never fails in the tuner.
+#
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import core as core_mod
+from spark_rapids_ml_tpu import telemetry
+from spark_rapids_ml_tpu.ops import autotune
+from spark_rapids_ml_tpu.ops.distance import (
+    _plan,
+    effective_itemsize,
+    plan_blocks,
+)
+
+_KEYS = ("compilation_cache_dir", "autotune_enabled", "autotune_repeats")
+
+
+@pytest.fixture
+def tuner(tmp_path):
+    """Isolated tuner: private table directory, clean in-memory cache and
+    counters, config restored exactly (other files' fits must keep seeing
+    the real settings)."""
+    saved = {k: core_mod.config[k] for k in _KEYS}
+    core_mod.config["compilation_cache_dir"] = str(tmp_path)
+    core_mod.config["autotune_enabled"] = True
+    autotune.reset()
+    telemetry.enable()
+    telemetry.registry().reset()
+    yield tmp_path
+    core_mod.config.update(saved)
+    autotune.reset()
+    telemetry.disable()
+    telemetry.registry().reset()
+
+
+def _fake_timer(best=(256, 256)):
+    """Deterministic stand-in for the on-device timer: the chosen winner
+    times fastest, everything else slower by its distance from it."""
+    calls = []
+
+    def timer(br, bk):
+        calls.append((br, bk))
+        return 1.0 + abs(br - best[0]) + abs(bk - best[1])
+
+    timer.calls = calls
+    return timer
+
+
+# ------------------------------------------------------ planner itemsize ----
+
+
+def test_effective_itemsize_pins():
+    assert effective_itemsize(jnp.float32, fast=False) == 4
+    assert effective_itemsize(jnp.float32, fast=True) == 2
+    assert effective_itemsize(jnp.float64, fast=False) == 8
+    # the fast path stages bf16 blocks regardless of the ambient dtype
+    assert effective_itemsize(jnp.float64, fast=True) == 2
+    assert effective_itemsize(jnp.bfloat16, fast=False) == 2
+
+
+def test_fast_plan_budgets_double_elements(tuner):
+    # a VMEM-tight depth: at 4-byte f32 the heuristic must shrink blocks,
+    # at the 2-byte effective itemsize the same shape fits bigger tiles
+    d = 3000
+    full = plan_blocks(4096, 4096, d, effective_itemsize(jnp.float32, False))
+    fast = plan_blocks(4096, 4096, d, effective_itemsize(jnp.float32, True))
+    assert full is not None and fast is not None
+    assert fast[0] * fast[1] > full[0] * full[1]
+    # _plan threads the same effective itemsize (no table entry here)
+    assert _plan(4096, 4096, d, jnp.float32, False) == full
+    assert _plan(4096, 4096, d, jnp.float32, True) == fast
+
+
+def test_shape_class_buckets():
+    # rows/k round UP to powers of two; depth exact; mode spelled out
+    assert autotune.shape_class(1000, 5, 64, jnp.float32, True) == "r1024:k8:d64:float32:fast"
+    assert autotune.shape_class(1024, 8, 64, jnp.float32, True) == "r1024:k8:d64:float32:fast"
+    assert autotune.shape_class(1025, 9, 64, jnp.float64, False) == "r2048:k16:d64:float64:full"
+    # same bucket => same key (one measurement covers the bucket)
+    assert autotune.shape_class(513, 5, 32, jnp.float32, False) == autotune.shape_class(
+        1024, 8, 32, jnp.float32, False
+    )
+
+
+# ------------------------------------------------- measure and persist ------
+
+
+def test_ensure_measures_persists_and_reuses(tuner):
+    timer = _fake_timer(best=(256, 256))
+    won = autotune.ensure(4096, 512, 64, jnp.float32, True, timer=timer)
+    assert won == (256, 256)
+    assert len(timer.calls) >= 2  # a real grid was raced, not a single point
+    # persisted beside the compile cache, schema-versioned
+    path = os.path.join(str(tuner), "srml_autotune.json")
+    with open(path) as f:
+        raw = json.load(f)
+    assert raw["version"] == 1
+    key = autotune.shape_class(4096, 512, 64, jnp.float32, True)
+    assert raw["entries"][key] == [256, 256]
+
+    # "another process": drop the in-memory cache, the file alone must serve
+    autotune.reset()
+    assert autotune.lookup(4096, 512, 64, jnp.float32, True) == (256, 256)
+    stats = autotune.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 0 and stats["entries"] == 1
+    # the planner consumes the tuned winner over its heuristic
+    assert _plan(4096, 512, 64, jnp.float32, True) == (256, 256)
+    # second ensure is a pure table read — no re-measurement
+    n_calls = len(timer.calls)
+    assert autotune.ensure(4096, 512, 64, jnp.float32, True, timer=timer) == (256, 256)
+    assert len(timer.calls) == n_calls
+    assert autotune.stats()["measurements"] == 0  # this process never measured
+
+
+def test_lookup_miss_counts_and_falls_back(tuner):
+    assert autotune.lookup(4096, 512, 64, jnp.float32, False) is None
+    stats = autotune.stats()
+    assert stats["misses"] == 1 and stats["hits"] == 0
+    assert telemetry.registry().snapshot()["counters"]["autotune.misses"] == 1
+    # the planner still plans (heuristic)
+    assert _plan(4096, 512, 64, jnp.float32, False) == plan_blocks(4096, 512, 64, 4)
+
+
+def test_candidates_respect_vmem_and_include_heuristic(tuner):
+    cands = autotune._candidates(4096, 4096, 3000, jnp.float32, False)
+    heuristic = plan_blocks(4096, 4096, 3000, 4)
+    assert cands[0] == heuristic
+    budget = 8 * 1024 * 1024 // 4
+    for br, bk in cands:
+        assert br * 3000 + bk * 3000 + br * bk <= budget
+
+
+# ------------------------------------------------------ degradation ---------
+
+
+def test_malformed_table_degrades_to_heuristic(tuner):
+    path = os.path.join(str(tuner), "srml_autotune.json")
+    with open(path, "w") as f:
+        f.write("{ not json")
+    assert autotune.lookup(4096, 512, 64, jnp.float32, True) is None
+    assert autotune.stats()["table_errors"] == 1
+    assert _plan(4096, 512, 64, jnp.float32, True) is not None  # heuristic lives
+
+
+def test_stale_version_discarded_wholesale(tuner):
+    key = autotune.shape_class(4096, 512, 64, jnp.float32, True)
+    path = os.path.join(str(tuner), "srml_autotune.json")
+    with open(path, "w") as f:
+        json.dump({"version": 0, "entries": {key: [256, 256]}}, f)
+    assert autotune.lookup(4096, 512, 64, jnp.float32, True) is None
+    assert autotune.stats()["table_errors"] == 1
+
+
+def test_bad_entry_shapes_filtered(tuner):
+    good = autotune.shape_class(4096, 512, 64, jnp.float32, True)
+    path = os.path.join(str(tuner), "srml_autotune.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "version": 1,
+                "entries": {
+                    good: [256, 256],
+                    "bad1": [256],          # wrong arity
+                    "bad2": [0, 256],       # non-positive
+                    "bad3": "256x256",      # wrong type
+                },
+            },
+            f,
+        )
+    assert autotune.lookup(4096, 512, 64, jnp.float32, True) == (256, 256)
+    stats = autotune.stats()
+    assert stats["table_errors"] == 3 and stats["entries"] == 1
+
+
+def test_raising_timer_never_fails_the_fit(tuner):
+    def timer(br, bk):
+        raise RuntimeError("exotic part says no")
+
+    assert autotune.ensure(4096, 512, 64, jnp.float32, True, timer=timer) is None
+    assert autotune.stats()["table_errors"] == 1
+    assert not os.path.exists(os.path.join(str(tuner), "srml_autotune.json"))
+
+
+def test_disabled_is_a_noop(tuner):
+    core_mod.config["autotune_enabled"] = False
+    assert autotune.lookup(4096, 512, 64, jnp.float32, True) is None
+    assert autotune.ensure(
+        4096, 512, 64, jnp.float32, True, timer=_fake_timer()
+    ) is None
+    stats = autotune.stats()
+    assert stats == {"hits": 0, "misses": 0, "measurements": 0,
+                     "table_errors": 0, "entries": 0}
+
+
+def test_off_tpu_without_timer_measures_nothing(tuner):
+    # CPU/CI contract: kernel_mode() != "pallas" here, so ensure() without
+    # an injected timer must return None and write nothing
+    assert autotune.ensure(4096, 512, 64, jnp.float32, True) is None
+    assert not os.path.exists(os.path.join(str(tuner), "srml_autotune.json"))
+    assert autotune.stats()["measurements"] == 0
+
+
+def test_no_cache_dir_stays_in_memory(tuner):
+    core_mod.config["compilation_cache_dir"] = None
+    assert autotune.table_path() is None
+    won = autotune.ensure(4096, 512, 64, jnp.float32, True, timer=_fake_timer())
+    assert won == (256, 256)
+    # in-memory table serves this process...
+    assert autotune.lookup(4096, 512, 64, jnp.float32, True) == (256, 256)
+    # ...but a "new process" starts cold (nothing was persisted anywhere)
+    autotune.reset()
+    assert autotune.lookup(4096, 512, 64, jnp.float32, True) is None
+    assert not os.path.exists(os.path.join(str(tuner), "srml_autotune.json"))
+
+
+def test_env_seed_of_autotune_enabled(monkeypatch):
+    # SRML_AUTOTUNE=0 seeds config["autotune_enabled"] False at load; the
+    # seeding helper is pinned directly (config itself loaded long ago)
+    import subprocess
+    import sys
+
+    code = (
+        "from spark_rapids_ml_tpu.core import config; "
+        "print(config['autotune_enabled'])"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "SRML_AUTOTUNE": "0", "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.stdout.strip() == "False", out.stderr

@@ -171,6 +171,51 @@ def test_precision_lowdot_einsum_skips_equation_string():
     assert rule_ids(fs) == ["precision-flow"]
 
 
+def test_precision_lowdot_lax_dot_solver_idiom_pair():
+    # the sanctioned mixed-precision solver cast (docs/performance.md
+    # "Mixed-precision solvers", ops/logistic._dense_ops / streaming._fdot):
+    # bf16 operands + f32 accumulator passes; dropping the accumulator
+    # annotation from the SAME dot is exactly what the rule must catch
+    src = """
+    import jax
+    import jax.numpy as jnp
+    def matvec(x, beta):
+        bad = jax.lax.dot(
+            x.astype(jnp.bfloat16), beta.astype(jnp.bfloat16),
+            precision=jax.lax.Precision.DEFAULT,
+        )
+        good = jax.lax.dot(
+            x.astype(jnp.bfloat16), beta.astype(jnp.bfloat16),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        )
+        return bad, good
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"] and fs[0].line == 5
+    assert "preferred_element_type" in fs[0].message
+
+
+def test_precision_lowdot_einsum_solver_idiom_pair():
+    # the sufficient-stat einsum variant (ops/linalg.weighted_cov fast path):
+    # two bf16 operands with an f32 accumulator pass; without it, fires
+    src = """
+    import jax.numpy as jnp
+    def gram(xw, x):
+        bad = jnp.einsum(
+            "nd,ne->de", xw.astype(jnp.bfloat16), x.astype(jnp.bfloat16)
+        )
+        good = jnp.einsum(
+            "nd,ne->de", xw.astype(jnp.bfloat16), x.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return bad, good
+    """
+    fs = run(src, PrecisionFlowRule)
+    assert rule_ids(fs) == ["precision-flow"] and fs[0].line == 4
+    assert "preferred_element_type" in fs[0].message
+
+
 # --------------------------------------------------------------------------
 # precision-flow: unguarded jnp f64
 # --------------------------------------------------------------------------

@@ -1,0 +1,412 @@
+#
+# Mixed-precision solver contract (docs/performance.md "Mixed-precision
+# solvers"): per-solver bf16==f32 parity at the documented tolerances
+# (dense + padded-ELL, resident + streaming), the `solver_precision`
+# resolution ladder (estimator param > config > "f32" default, invalid
+# values raise, choices are counted), warm starts across precisions,
+# ":bf16" checkpoint keying-apart, and the numcheck acceptance: bf16 fits
+# sweep clean under SRML_NUMCHECK=1 and no solver-STATE stage ever
+# watermarks a bfloat16 — only the dot/einsum INPUTS narrow.
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import checkpoint as ckpt
+from spark_rapids_ml_tpu import core as core_mod
+from spark_rapids_ml_tpu import diagnostics, telemetry
+from spark_rapids_ml_tpu.core import resolve_solver_precision
+from spark_rapids_ml_tpu.models.classification import LogisticRegression
+from spark_rapids_ml_tpu.models.clustering import KMeans
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.models.regression import LinearRegression
+from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit
+from spark_rapids_ml_tpu.ops.linear import linear_fit, linear_fit_ell
+from spark_rapids_ml_tpu.ops.logistic import logistic_fit, logistic_fit_ell
+from spark_rapids_ml_tpu.ops.pca import pca_fit, pca_fit_checkpointed
+from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+from spark_rapids_ml_tpu.utils import numcheck
+
+_KEYS = (
+    "solver_precision", "hbm_budget_bytes", "hbm_headroom_fraction",
+    "stream_chunk_rows", "checkpoint_every_iters",
+)
+
+
+@pytest.fixture
+def prec():
+    """Config + telemetry isolation for precision tests (the test_oocore
+    fixture discipline): solver_precision and the streaming-budget knobs are
+    restored exactly, counters start from zero."""
+    saved = {k: core_mod.config[k] for k in _KEYS}
+    telemetry.enable()
+    telemetry.registry().reset()
+    yield core_mod.config
+    core_mod.config.update(saved)
+    telemetry.disable()
+    telemetry.registry().reset()
+
+
+def _budget(budget, chunk=512):
+    core_mod.config["hbm_budget_bytes"] = budget
+    core_mod.config["stream_chunk_rows"] = chunk if budget else 0
+
+
+def _counters():
+    return telemetry.registry().snapshot()["counters"]
+
+
+def _full_ell(x):
+    """A dense matrix in padded-ELL clothing: every row stores all d values."""
+    n, d = x.shape
+    values = jnp.asarray(x)
+    indices = jnp.asarray(np.tile(np.arange(d, dtype=np.int32), (n, 1)))
+    return values, indices
+
+
+def _blobs(rng, n=1200, d=6, k=4, dtype=np.float64):
+    centers = rng.normal(scale=10.0, size=(k, d))
+    x = centers[rng.integers(0, k, size=n)] + rng.normal(size=(n, d))
+    return x.astype(dtype), centers.astype(dtype)
+
+
+# ------------------------------------------------ resolution ladder ---------
+
+
+def test_resolve_default_is_f32(prec):
+    prec["solver_precision"] = "f32"
+    assert resolve_solver_precision() == "f32"
+    assert resolve_solver_precision({}) == "f32"
+    assert resolve_solver_precision({"solver_precision": None}) == "f32"
+
+
+def test_resolve_config_then_param_override(prec):
+    prec["solver_precision"] = "bf16"
+    assert resolve_solver_precision() == "bf16"
+    # the per-estimator override beats the config-wide default, both ways
+    assert resolve_solver_precision({"solver_precision": "f32"}) == "f32"
+    prec["solver_precision"] = "f32"
+    assert resolve_solver_precision({"solver_precision": "bf16"}) == "bf16"
+    # case-normalized
+    assert resolve_solver_precision({"solver_precision": "BF16"}) == "bf16"
+
+
+def test_resolve_invalid_raises(prec):
+    with pytest.raises(ValueError, match="solver_precision"):
+        resolve_solver_precision({"solver_precision": "fp16"})
+    prec["solver_precision"] = "float64"
+    with pytest.raises(ValueError, match="solver_precision"):
+        resolve_solver_precision()
+
+
+def test_resolve_counts_choices(prec):
+    prec["solver_precision"] = "f32"
+    resolve_solver_precision()
+    resolve_solver_precision({"solver_precision": "bf16"})
+    resolve_solver_precision({"solver_precision": "bf16"})
+    snap = _counters()
+    assert snap["fit.precision_f32"] == 1
+    assert snap["fit.precision_bf16"] == 2
+
+
+# ------------------------------------------- ops-level parity: GLMs ---------
+
+
+def test_linear_dense_bf16_parity(rng):
+    x = rng.normal(size=(500, 8))
+    y = x @ rng.normal(size=8) + 0.5 + 0.01 * rng.normal(size=500)
+    w = np.ones(500)
+    kw = dict(alpha=1e-3, l1_ratio=0.0)
+    full = linear_fit(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), **kw)
+    fast = linear_fit(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), fast=True, **kw)
+    # the cast actually happened: bf16 statistics cannot be bitwise f64 ones
+    assert not np.array_equal(np.asarray(fast["coef_"]), np.asarray(full["coef_"]))
+    np.testing.assert_allclose(
+        np.asarray(fast["coef_"]), np.asarray(full["coef_"]), rtol=5e-3, atol=5e-4
+    )
+    np.testing.assert_allclose(
+        float(fast["intercept_"]), float(full["intercept_"]), atol=5e-3
+    )
+
+
+def test_linear_ell_bf16_parity(rng):
+    x = rng.normal(size=(400, 6))
+    x = np.where(np.abs(x) > 0.6, x, 0.0)  # sparse-ish but stored full-ELL
+    y = x @ rng.normal(size=6) - 0.25 + 0.01 * rng.normal(size=400)
+    w = np.ones(400)
+    values, indices = _full_ell(x)
+    kw = dict(d=6, alpha=1e-3, l1_ratio=0.0)
+    full = linear_fit_ell(values, indices, jnp.asarray(y), jnp.asarray(w), **kw)
+    fast = linear_fit_ell(values, indices, jnp.asarray(y), jnp.asarray(w), fast=True, **kw)
+    np.testing.assert_allclose(
+        np.asarray(fast["coef_"]), np.asarray(full["coef_"]), rtol=5e-3, atol=5e-4
+    )
+
+
+@pytest.mark.parametrize("family_k", [2, 3], ids=["binomial", "multinomial"])
+def test_logistic_dense_bf16_parity(rng, family_k):
+    x = rng.normal(size=(600, 6))
+    if family_k == 2:
+        y = (x @ rng.normal(size=6) > 0).astype(np.int32)
+    else:
+        y = rng.integers(0, family_k, size=600).astype(np.int32)
+    w = np.ones(600)
+    kw = dict(k=family_k, multinomial=family_k > 2, lam_l2=0.01, max_iter=80, tol=1e-9)
+    full = logistic_fit(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), **kw)
+    fast = logistic_fit(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), fast=True, **kw)
+    np.testing.assert_allclose(
+        np.asarray(fast["coef_"]), np.asarray(full["coef_"]), rtol=5e-2, atol=5e-3
+    )
+    np.testing.assert_allclose(
+        float(fast["objective_"]), float(full["objective_"]), rtol=1e-3
+    )
+
+
+def test_logistic_ell_bf16_parity(rng):
+    x = rng.normal(size=(500, 6))
+    x = np.where(np.abs(x) > 0.6, x, 0.0)
+    y = (x @ rng.normal(size=6) > 0).astype(np.int32)
+    w = np.ones(500)
+    values, indices = _full_ell(x)
+    kw = dict(d=6, k=2, multinomial=False, lam_l2=0.01, max_iter=80, tol=1e-9)
+    full = logistic_fit_ell(values, indices, jnp.asarray(y), jnp.asarray(w), **kw)
+    fast = logistic_fit_ell(values, indices, jnp.asarray(y), jnp.asarray(w), fast=True, **kw)
+    np.testing.assert_allclose(
+        np.asarray(fast["coef_"]), np.asarray(full["coef_"]), rtol=5e-2, atol=5e-3
+    )
+
+
+# ----------------------------------------- ops-level parity: PCA/kmeans -----
+
+
+def test_pca_bf16_parity(rng):
+    x = rng.normal(size=(800, 6)) @ np.diag([5.0, 4.0, 3.0, 0.5, 0.2, 0.1])
+    w = np.ones(800)
+    full = pca_fit(jnp.asarray(x), jnp.asarray(w), k=3)
+    fast = pca_fit(jnp.asarray(x), jnp.asarray(w), k=3, fast=True)
+    np.testing.assert_allclose(
+        np.asarray(fast["explained_variance_"]),
+        np.asarray(full["explained_variance_"]),
+        rtol=2e-3,
+    )
+    # sign-tolerant component parity (sign_flip picks the max-abs element's
+    # sign; a bf16-perturbed near-tie may legitimately flip a row)
+    np.testing.assert_allclose(
+        np.abs(np.asarray(fast["components_"])),
+        np.abs(np.asarray(full["components_"])),
+        atol=5e-3,
+    )
+
+
+def test_kmeans_fast_vs_high_parity(rng):
+    x, _ = _blobs(rng, dtype=np.float32)
+    w = np.ones(len(x), dtype=np.float32)
+    init = x[:4].copy()
+    kw = dict(mesh=get_mesh(), max_iter=20, tol=1e-6)
+    full = kmeans_fit(jnp.asarray(x), jnp.asarray(w), jnp.asarray(init),
+                      precision_mode="high", **kw)
+    fast = kmeans_fit(jnp.asarray(x), jnp.asarray(w), jnp.asarray(init),
+                      precision_mode="fast", **kw)
+    np.testing.assert_allclose(
+        np.asarray(fast["cluster_centers_"]),
+        np.asarray(full["cluster_centers_"]),
+        rtol=1e-3, atol=5e-3,
+    )
+    # final inertia always reruns at full precision — close AND finite
+    assert np.isfinite(float(fast["inertia_"]))
+    np.testing.assert_allclose(
+        float(fast["inertia_"]), float(full["inertia_"]), rtol=1e-3
+    )
+
+
+def test_kmeans_fast_gated_to_f32(rng):
+    # f64 inputs disable the bf16 path entirely: "fast" must be bitwise "high"
+    x, _ = _blobs(rng, n=600, dtype=np.float64)
+    w = np.ones(len(x))
+    init = x[:4].copy()
+    kw = dict(mesh=get_mesh(), max_iter=10, tol=1e-6)
+    full = kmeans_fit(jnp.asarray(x), jnp.asarray(w), jnp.asarray(init),
+                      precision_mode="high", **kw)
+    fast = kmeans_fit(jnp.asarray(x), jnp.asarray(w), jnp.asarray(init),
+                      precision_mode="fast", **kw)
+    np.testing.assert_array_equal(
+        np.asarray(fast["cluster_centers_"]), np.asarray(full["cluster_centers_"])
+    )
+
+
+# ------------------------------------------- estimator-level contract -------
+
+
+def test_estimator_param_beats_config(prec, rng):
+    x = rng.normal(size=(400, 5))
+    y = x @ rng.normal(size=5) + 0.1
+    df = pd.DataFrame({"features": list(x), "label": y})
+    prec["solver_precision"] = "bf16"
+    LinearRegression(regParam=1e-3).setFeaturesCol("features").fit(df)
+    assert _counters()["fit.precision_bf16"] == 1
+    # per-estimator f32 override under a bf16 config-wide default
+    LinearRegression(regParam=1e-3, solver_precision="f32").setFeaturesCol("features").fit(df)
+    assert _counters()["fit.precision_f32"] == 1
+
+
+def _assert_streamed(model):
+    adm = model._fit_metrics["admission"]
+    assert adm["verdict"] == "stream"
+
+
+def test_linear_streaming_bf16_matches_resident_bf16(prec, rng):
+    x = rng.normal(size=(2000, 6))
+    y = x @ rng.normal(size=6) + 0.5 + 0.05 * rng.normal(size=2000)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    est = lambda: LinearRegression(  # noqa: E731
+        regParam=1e-3, solver_precision="bf16", float32_inputs=False
+    ).setFeaturesCol("features")
+    _budget(None)
+    res = est().fit(df)
+    _budget(12_000)
+    stream = est().fit(df)
+    _assert_streamed(stream)
+    # both sides round the SAME elements through bf16; only the f64
+    # accumulation order differs between chunked and resident statistics
+    np.testing.assert_allclose(stream.coef_, res.coef_, rtol=1e-6)
+    np.testing.assert_allclose(stream.intercept_, res.intercept_, rtol=1e-6)
+
+
+def test_logistic_streaming_bf16_matches_resident_bf16(prec, rng):
+    x = rng.normal(size=(2000, 6))
+    y = (x @ rng.normal(size=6) > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    est = lambda: LogisticRegression(  # noqa: E731
+        regParam=0.01, solver_precision="bf16", float32_inputs=False
+    ).setFeaturesCol("features")
+    _budget(None)
+    res = est().fit(df)
+    _budget(12_000)
+    stream = est().fit(df)
+    _assert_streamed(stream)
+    np.testing.assert_allclose(
+        np.asarray(stream.coef_), np.asarray(res.coef_), rtol=1e-5, atol=1e-8
+    )
+
+
+def test_pca_streaming_bf16_matches_resident_bf16(prec, rng):
+    df = pd.DataFrame({"features": list(rng.normal(size=(2000, 6)))})
+    est = lambda: PCA(  # noqa: E731
+        k=3, solver_precision="bf16", float32_inputs=False
+    ).setInputCol("features")
+    _budget(None)
+    res = est().fit(df)
+    _budget(12_000)
+    stream = est().fit(df)
+    _assert_streamed(stream)
+    np.testing.assert_allclose(
+        np.asarray(stream.components_), np.asarray(res.components_),
+        rtol=1e-5, atol=1e-8,
+    )
+
+
+def test_kmeans_streaming_bf16_matches_resident_bf16(prec, rng):
+    x, _ = _blobs(rng, n=2000, dtype=np.float64)  # f32 ingest is the default
+    df = pd.DataFrame({"features": list(x)})
+    est = lambda: KMeans(  # noqa: E731
+        k=4, seed=7, maxIter=15, solver_precision="bf16"
+    ).setFeaturesCol("features")
+    _budget(None)
+    res = est().fit(df)
+    _budget(16_000)
+    stream = est().fit(df)
+    _assert_streamed(stream)
+    np.testing.assert_allclose(
+        stream.cluster_centers_, res.cluster_centers_, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_logistic_warm_start_f32_donor_bf16_resume(prec, rng):
+    # a bf16 fit warm-started from an f32 model: the seed crosses precisions
+    # through ORIGINAL coefficient space (never checkpoint state — those are
+    # keyed apart), converges, and lands on the same model
+    x = rng.normal(size=(1500, 6))
+    y = (x @ rng.normal(size=6) > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    cold = LogisticRegression(maxIter=60, regParam=1e-3).setFeaturesCol("features").fit(df)
+    warm = LogisticRegression(
+        maxIter=60, regParam=1e-3, solver_precision="bf16"
+    ).setFeaturesCol("features").fit(df, warm_start_from=cold)
+    assert warm.n_iter_ < cold.n_iter_
+    np.testing.assert_allclose(
+        np.asarray(warm.coef_), np.asarray(cold.coef_), rtol=5e-2, atol=5e-3
+    )
+
+
+# -------------------------------------------------- checkpoint keying -------
+
+
+def test_bf16_checkpoints_key_apart(rng):
+    x = jnp.asarray(rng.normal(size=(500, 6)))
+    w = jnp.ones(500)
+    with ckpt.checkpoint_scope() as store:
+        full = pca_fit_checkpointed(x, w, k=3)
+        fast = pca_fit_checkpointed(x, w, k=3, fast=True)
+        # distinct entries: a bf16 pass can never serve (or be resumed from)
+        # a full-precision statistics checkpoint
+        assert store.peek("pca_stats") is not None
+        assert store.peek("pca_stats:bf16") is not None
+        full_cov = store.peek("pca_stats").state["cov"]
+        fast_cov = store.peek("pca_stats:bf16").state["cov"]
+        assert not np.array_equal(full_cov, fast_cov)
+    assert not np.array_equal(
+        np.asarray(full["explained_variance_"]), np.asarray(fast["explained_variance_"])
+    )
+
+
+# ------------------------------------------------- numcheck acceptance ------
+
+
+@pytest.fixture
+def sanitizer(monkeypatch):
+    monkeypatch.setenv("SRML_NUMCHECK", "1")
+    state = numcheck.snapshot()
+    numcheck.reset()
+    diagnostics.flight_recorder().reset()
+    yield numcheck
+    numcheck.restore(state)
+
+
+def _assert_no_bf16_watermark(nc):
+    assert nc.trips() == []
+    assert nc.checks() > 0
+    for stage, marks in nc.watermarks().items():
+        assert "bfloat16" not in marks, (
+            f"solver state narrowed to bf16 at boundary {stage!r}: {marks}"
+        )
+
+
+def test_numcheck_bf16_resident_fits_sweep_clean(sanitizer, rng):
+    # every bf16 family under the sanitizer: zero trips, and every staged
+    # boundary value — iterates, statistics, chunk partials — watermarks at
+    # full precision (the bf16 narrowing lives INSIDE the dots, never in
+    # state that crosses a check boundary)
+    x, _ = _blobs(rng, n=800, dtype=np.float32)
+    w32 = jnp.ones(len(x), dtype=jnp.float32)
+    kmeans_fit(jnp.asarray(x), w32, jnp.asarray(x[:4].copy()),
+               mesh=get_mesh(), max_iter=8, precision_mode="fast")
+    xd = rng.normal(size=(500, 6))
+    yd = (xd @ rng.normal(size=6) > 0).astype(np.int32)
+    logistic_fit(jnp.asarray(xd), jnp.asarray(yd), jnp.ones(500),
+                 k=2, multinomial=False, lam_l2=0.01, max_iter=30, fast=True)
+    pca_fit(jnp.asarray(xd), jnp.ones(500), k=3, fast=True)
+    _assert_no_bf16_watermark(sanitizer)
+
+
+def test_numcheck_bf16_streaming_sweeps_clean(sanitizer, prec, rng):
+    x, _ = _blobs(rng, n=2000, dtype=np.float64)
+    df = pd.DataFrame({"features": list(x)})
+    _budget(16_000)
+    model = KMeans(
+        k=4, seed=7, maxIter=10, solver_precision="bf16"
+    ).setFeaturesCol("features").fit(df)
+    _assert_streamed(model)
+    _assert_no_bf16_watermark(sanitizer)
+    assert any(s.startswith("kmeans_stream") for s in sanitizer.watermarks())
