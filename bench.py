@@ -585,10 +585,26 @@ def run_child() -> int:
         ),
         "knn": lambda: bench_knn(dense_data()["X"], dense_data()["w"], mesh),
     }
+    from spark_rapids_ml_tpu.ops_plane import efficiency as _eff
+
+    def _eff_totals() -> dict:
+        # process-cumulative attribution totals (all tenants) + the compile
+        # ledger — per-lane deltas of these ride the BENCH record
+        tot = {"execute_s": 0.0, "compile_s": 0.0, "host_s": 0.0, "idle_s": 0.0}
+        for split in _eff.tenant_time_splits().values():
+            for k in tot:
+                tot[k] += float(split.get(k, 0.0))
+        comp = _eff.compile_stats()
+        tot["compile_misses"] = float(comp["misses"])
+        tot["compile_hits"] = float(comp["hits"])
+        tot["compile_wall_s"] = float(comp["wall_s"])
+        return tot
+
     n_fail = 0
     for name in pending:
         _phase(f"lane:{name}:start")
         try:
+            eff_before = _eff_totals()
             out = runners[name]()
             # a lane may return (value, latency_dict[, ops_dict]): latency
             # values ride the @RESULT line into the BENCH record's
@@ -603,6 +619,19 @@ def run_child() -> int:
                 rec["latency"] = latency
             if ops:
                 rec["ops"] = ops
+            # the lane's efficiency delta (execute/compile/host/idle split
+            # plus compile-ledger movement), report-only under `ops` —
+            # regression.py never reads it. MFU rides along when a peak
+            # spec is configured (last attributed scope's gauge).
+            eff_after = _eff_totals()
+            eff_delta = {k: eff_after[k] - eff_before[k] for k in eff_after}
+            if any(v_ > 0 for v_ in eff_delta.values()):
+                if _eff.peak_flops() is not None:
+                    gauges = telemetry.snapshot().get("gauges", {})
+                    for g in ("efficiency.mfu", "efficiency.serve_mfu"):
+                        if g in gauges:
+                            eff_delta[g.split(".", 1)[1]] = gauges[g]
+                rec.setdefault("ops", {})["efficiency"] = eff_delta
             print("@RESULT " + json.dumps(rec), flush=True)
             _phase(f"lane:{name}:end")
         except Exception as e:  # fail-soft: one dead section keeps the rest

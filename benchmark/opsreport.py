@@ -89,6 +89,14 @@ def render(
                 f"{u.get('chip_seconds', 0.0):.3f} chip·s over "
                 f"{int(u.get('reservations', 0))} reservation(s){live}"
             )
+            dt = u.get("device_time")
+            if dt:
+                lines.append(
+                    f"    device time: execute={dt.get('execute_s', 0.0):.3f}s "
+                    f"compile={dt.get('compile_s', 0.0):.3f}s "
+                    f"host={dt.get('host_s', 0.0):.3f}s "
+                    f"idle={dt.get('idle_s', 0.0):.3f}s"
+                )
     decisions = report.get("decisions") or []
     if tenant is not None:
         decisions = [d for d in decisions if d.get("tenant") == tenant]
@@ -117,6 +125,42 @@ def render(
             f"ingest drift: {drift.get('rows', 0)} row(s) over "
             f"{len(drift.get('columns', []))} column(s){psi}"
         )
+    eff = report.get("efficiency") or {}
+    eff_tenants = eff.get("tenants") or {}
+    if eff_tenants:
+        lines.append("efficiency (attributed device time):")
+        for name in sorted(eff_tenants):
+            if tenant is not None and name != tenant:
+                continue
+            t = eff_tenants[name]
+            wall = t.get("wall_s", 0.0)
+            mfu = f", mfu={t['mfu']:.3f}" if t.get("mfu") is not None else ""
+            top = t.get("top_idle_stage")
+            top_s = f", top idle stage: {top}" if top else ""
+            lines.append(
+                f"  {name}: wall={wall:.3f}s "
+                f"execute={t.get('execute_s', 0.0):.3f}s "
+                f"compile={t.get('compile_s', 0.0):.3f}s "
+                f"host={t.get('host_s', 0.0):.3f}s "
+                f"idle={t.get('idle_s', 0.0):.3f}s{mfu}{top_s}"
+            )
+    comp = eff.get("compile") or {}
+    if comp.get("programs"):
+        lines.append(
+            f"compile ledger: {comp.get('programs', 0)} program/shape "
+            f"entr(ies), {comp.get('misses', 0)} miss(es) totalling "
+            f"{comp.get('wall_s', 0.0):.3f}s, {comp.get('hits', 0)} hit(s)"
+        )
+    tune = report.get("autotune") or {}
+    if tune.get("measurements") or tune.get("hits") or tune.get("misses"):
+        path = tune.get("table_path") or "in-memory"
+        lines.append(
+            f"autotune: {tune.get('hits', 0)} hit(s) / "
+            f"{tune.get('misses', 0)} miss(es), "
+            f"{tune.get('measurements', 0)} measurement(s), "
+            f"{tune.get('table_errors', 0)} table error(s), "
+            f"{tune.get('entries', 0)} table entr(ies) @ {path}"
+        )
     return "\n".join(lines)
 
 
@@ -133,6 +177,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--decisions", type=int, default=20, help="decision-log entries rendered")
     p.add_argument("--write", default=None, metavar="PATH",
                    help="also archive the report as a rotating snapshot at PATH")
+    p.add_argument("--write-efficiency", default=None, metavar="PATH",
+                   help="archive just the efficiency section (attribution "
+                        "splits + compile ledger) as JSON at PATH")
     args = p.parse_args(argv)
 
     if args.snapshot is not None:
@@ -150,6 +197,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             from spark_rapids_ml_tpu.ops_plane import export
 
             export.write_snapshot(args.write)
+    if args.write_efficiency:
+        eff_doc = {
+            "t": report.get("t"),
+            "efficiency": report.get("efficiency") or {},
+            "autotune": report.get("autotune") or {},
+        }
+        with open(args.write_efficiency, "w") as f:
+            json.dump(eff_doc, f, indent=2, default=str)
     if args.json:
         print(json.dumps(report, default=str))
     else:

@@ -21,6 +21,7 @@ from .ledger import LedgerBypassRule
 from .memstats import MemStatsRule
 from .numerics import PrecisionFlowRule, PrngDisciplineRule
 from .padrows import PadRowsRule
+from .profiler import ProfilerScopeRule
 from .purity import TracedImpurityRule
 from .registries import ConfigKeyRule, MetricNameRule
 from .serving import ServeDispatchRule
@@ -48,6 +49,7 @@ def default_rules() -> List[RuleBase]:
         ServeDispatchRule(),
         LedgerBypassRule(),
         ExporterScopeRule(),
+        ProfilerScopeRule(),
         ConfigKeyRule(),
         MetricNameRule(),
         # --- whole-program concurrency rules (pass-2 over program.py) ----
@@ -81,6 +83,7 @@ __all__ = [
     "ServeDispatchRule",
     "LedgerBypassRule",
     "ExporterScopeRule",
+    "ProfilerScopeRule",
     "ConfigKeyRule",
     "MetricNameRule",
     "LockOrderRule",

@@ -35,6 +35,7 @@ KNOWN_WAIVER_TAGS = {
     "precision",
     "prng",
     "histogram",
+    "profiler",
 }
 
 

@@ -18,7 +18,8 @@ class PerfCounterRule(RuleBase):
     id = "bare-perf-counter"
     waiver = "telemetry"
     tree_scope = ("spark_rapids_ml_tpu",)
-    exempt_files = frozenset({"telemetry.py"})  # the one clock owner
+    # the clock owners: telemetry spans and the efficiency attribution plane
+    exempt_files = frozenset({"telemetry.py", "efficiency.py"})
     description = "bare time.perf_counter timing outside telemetry.py"
 
     def check_module(self, tree: ast.Module, ctx: FileContext) -> None:

@@ -22,8 +22,9 @@ python -m ci.analysis --json-out "$ARTIFACTS/analysis_verdict.json" --time-budge
 echo "== perf regression gate (report-only against the checked-in BENCH trajectory)"
 python -m benchmark.regression --report-only --out "$ARTIFACTS/regression_verdict.json"
 
-echo "== ops snapshot artifact (SLO verdicts + decision log + tenant accounting)"
-python -m benchmark.opsreport --json --write "$ARTIFACTS/ops_snapshot.json" > /dev/null
+echo "== ops snapshot artifact (SLO verdicts + decision log + tenant accounting + efficiency attribution)"
+python -m benchmark.opsreport --json --write "$ARTIFACTS/ops_snapshot.json" \
+  --write-efficiency "$ARTIFACTS/efficiency_report.json" > /dev/null
 
 echo "== chaos smoke (kill one rank mid-solve; survivors must recover + post-mortem must name it)"
 python ci/chaos_smoke.py

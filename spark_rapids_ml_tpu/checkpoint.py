@@ -285,6 +285,7 @@ def run_segmented_while(
         )
 
     seg_j = jax.jit(_segment)
+    from . import telemetry
     from .parallel import chaos
     from .utils import numcheck
 
@@ -297,8 +298,13 @@ def run_segmented_while(
         seg_end = min(it_now + max(1, every), max_iter)
         state = seg_j(state, jnp.asarray(seg_end, jnp.int32))
         if store is not None:
-            leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(state)]
-            it_after = int(np.asarray(it_of(state)))  # host-fetch-ok: the checkpoint itself — state must land on host to survive the process
+            # the leaf fetch below is the segment's device sync — the
+            # efficiency attributor times it as `execute` (the wait IS the
+            # remaining device work of this segment), and the host-side
+            # checkpoint serialization as `host`; no sync is added
+            with telemetry.device_wait("segment"):
+                leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(state)]
+                it_after = int(np.asarray(it_of(state)))  # host-fetch-ok: the checkpoint itself — state must land on host to survive the process
             if _nc is not None:
                 # a NaN leaf here would poison every later resume of this
                 # trajectory; the bytes are already on host. allow_inf: the
@@ -307,11 +313,12 @@ def run_segmented_while(
                 _nc(f"segment.{solver}", solver=solver, iteration=it_after,
                     allow_inf=True,
                     **{f"leaf{i}": lv for i, lv in enumerate(leaves)})
-            store.save(key, SolverCheckpoint(
-                solver=solver, iteration=it_after,
-                state={"leaves": leaves}, placement_key=placement_key,
-                portable=portable_of(state) if portable_of is not None else {},
-            ))
+            with telemetry.host_section("segment"):
+                store.save(key, SolverCheckpoint(
+                    solver=solver, iteration=it_after,
+                    state={"leaves": leaves}, placement_key=placement_key,
+                    portable=portable_of(state) if portable_of is not None else {},
+                ))
             # mid-solve fault injection point: a `fail:stage=solve` plan
             # entry interrupts AFTER this boundary's checkpoint landed, so
             # the bounded retry exercises the real resume-from-checkpoint

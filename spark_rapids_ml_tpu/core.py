@@ -215,6 +215,14 @@ config: Dict[str, Any] = {
     # timing repeats per candidate tiling when the autotuner measures; the
     # minimum over repeats is scored (robust to one-off scheduling noise)
     "autotune_repeats": 3,
+    # --- efficiency attribution plane (ops_plane/efficiency.py,
+    # docs/observability.md "Efficiency plane") ---------------------------
+    # per-device peak FLOP/s for the roofline/MFU gauges — the peak-spec
+    # grammar is a number with an optional K/M/G/T/P suffix ("14T",
+    # "275e12"). Unset (default) = the `efficiency.mfu` gauges are OMITTED,
+    # never guessed from the device model. Seeded from
+    # SRML_DEVICE_PEAK_FLOPS.
+    "device_peak_flops": os.environ.get("SRML_DEVICE_PEAK_FLOPS") or None,
 }
 
 
@@ -795,6 +803,16 @@ class _TpuCaller(_TpuCommon):
         workspace. Formulas are pinned by tests/test_memory.py."""
         return {}
 
+    def _solver_flop_estimate(
+        self, n_rows: int, n_cols: int
+    ) -> Optional[float]:
+        """Analytic FLOP estimate for ONE solve of this estimator — the
+        `_solver_workspace_terms` sibling feeding the roofline/MFU gauges
+        (ops_plane/efficiency.py): achieved fraction of the configured
+        `config["device_peak_flops"]` peak. None (default) = no model; the
+        MFU gauge is simply omitted for this estimator."""
+        return None
+
     def _build_fit_inputs(self, extracted: ExtractedData, ctx: Any) -> FitInputs:
         """Lay the host blocks out on the mesh (pad-and-mask; SURVEY.md §7).
 
@@ -1163,7 +1181,7 @@ class _TpuCaller(_TpuCommon):
         if profile_dir:
             import jax
 
-            profile_cm = jax.profiler.trace(profile_dir)
+            profile_cm = jax.profiler.trace(profile_dir)  # profiler-ok: the opt-in SRML_PROFILE_DIR xprof hook — this IS the sanctioned whole-fit trace entry point
         from . import diagnostics
         from .parallel import TpuContext
 
@@ -1203,6 +1221,14 @@ class _TpuCaller(_TpuCommon):
             # placement re-reserves on its next cache hit)
             self._adopt_reservation(None)
         self._last_fit_metrics = tele_scope["metrics"]
+        eff = tele_scope.get("efficiency")
+        if eff and isinstance(self._last_fit_metrics, dict):
+            # the fit's device-time attribution (execute/compile/host/idle
+            # split + per-stage detail) and its compile-ledger delta ride the
+            # per-fit metrics, mirroring the admission stamp below
+            self._last_fit_metrics = dict(self._last_fit_metrics)
+            self._last_fit_metrics["efficiency"] = eff
+            self._last_fit_metrics["compile"] = eff.get("compile", {})
         adm = getattr(self, "_last_admission", None)
         if (
             adm is not None
@@ -1377,6 +1403,21 @@ class _TpuCaller(_TpuCommon):
                 # solve time IS the cache working (docs/observability.md)
                 telemetry.registry().gauge("fit.compile_cache_hit", solve_times[0])
             telemetry.record_device_memory()  # HBM watermark after solve
+            if telemetry.enabled():
+                # analytic FLOP estimate (the `_solver_workspace_terms`
+                # sibling hook) feeds the MFU gauge's numerator — per solve,
+                # so a sweep's N param sets scale it N-fold
+                fhook = getattr(self, "_solver_flop_estimate", None)
+                if fhook is not None:
+                    try:
+                        flops = fhook(int(inputs.n_valid), int(inputs.n_cols))
+                    except Exception:
+                        flops = None
+                    if flops:
+                        telemetry.note_flops(
+                            float(flops) * max(1, len(solver_param_sets)),
+                            chips=int(inputs.mesh.devices.size),
+                        )
         return rows
 
     def _dispatch_solves(
@@ -1420,12 +1461,25 @@ class _TpuCaller(_TpuCommon):
                 order.append(gid)
             groups[gid].append(i)
 
+        # compile-ledger shape-class: coarse on purpose — what the jit cache
+        # keys on that the OUTSIDE can see (padded dims, layout, mesh width).
+        # Hyperparameters that re-trace (maxIter grids) are a documented bias
+        # of the ledger, not part of the key (docs/observability.md).
+        shape_class = (
+            f"{inputs.n_valid}x{inputs.n_cols}"
+            f":{'sparse' if inputs.X_sparse is not None else 'dense'}"
+            f":{'stream' if inputs.stream is not None else 'resident'}"
+            f":mesh{int(inputs.mesh.devices.size)}"
+        )
         for gid in order:
             idxs = groups[gid]
             if batched_fn is not None and gid[0] == "batch" and len(idxs) > 1:
                 with telemetry.span(
                     "solve", logger=stage_logger, batched=len(idxs), of=n_sets
-                ) as solve_span:
+                ) as solve_span, telemetry.compile_event(
+                    f"fit.{type(self).__name__}.batched",
+                    f"{shape_class}:n{len(idxs)}",
+                ):
                     out = batched_fn(inputs, [solver_param_sets[i] for i in idxs])
                 if out is not None:
                     if len(out) != len(idxs):  # fail at the contract breach,
@@ -1445,7 +1499,9 @@ class _TpuCaller(_TpuCommon):
             for i in idxs:
                 with telemetry.span(
                     "solve", logger=stage_logger, index=i, of=n_sets
-                ) as solve_span:
+                ) as solve_span, telemetry.compile_event(
+                    f"fit.{type(self).__name__}", shape_class
+                ):
                     rows[i] = fit_func(inputs, solver_param_sets[i])
                 if solve_span.wall_s is not None:
                     solve_times.append(solve_span.wall_s)
@@ -1878,19 +1934,33 @@ class PredictProgram:
     def fetch(self, result: Any, n_valid: int) -> Any:
         """THE device→host sync point: materialize the in-flight result and
         slice every output back to the valid rows."""
-        if isinstance(result, tuple):
-            return tuple(np.asarray(r)[:n_valid] for r in result)
-        return np.asarray(result)[:n_valid]
+        from . import telemetry
+
+        with telemetry.device_wait("predict_fetch"):
+            if isinstance(result, tuple):
+                return tuple(np.asarray(r)[:n_valid] for r in result)
+            return np.asarray(result)[:n_valid]
 
     def prewarm(self, n_cols: int, *, max_rows: Optional[int] = None) -> int:
         """Compile every ladder rung up to `max_rows` rows by dispatching a
         zeros batch per rung and blocking on it (the compile must complete at
         LOAD time, not at the first query). With a persistent compile cache
-        configured the programs come off disk. Returns the rung count."""
+        configured the programs come off disk. Returns the rung count.
+
+        Each rung is one compile-ledger entry (`telemetry.compile_event`):
+        the load-time compile wall lands in `compile.*` instead of hiding in
+        `serve_load`'s span."""
+        from . import telemetry
+
         rungs = self.ladder(max_rows)
         for r in rungs:
-            result, _ = self.dispatch(np.zeros((r, int(n_cols)), dtype=self.dtype))
-            self.fetch(result, 0)
+            with telemetry.compile_event(
+                f"predict.{type(self.model).__name__}", f"{r}x{int(n_cols)}"
+            ):
+                result, _ = self.dispatch(
+                    np.zeros((r, int(n_cols)), dtype=self.dtype)
+                )
+                self.fetch(result, 0)
         return len(rungs)
 
 

@@ -528,6 +528,7 @@ def admit_model_load(
     resident_bytes: int = 0,
     bucket_rows_count: Optional[int] = None,
     devices: Any = None,
+    tenant: Optional[str] = None,
 ) -> AdmissionDecision:
     """Admission verdict for loading a fitted model into the serving plane
     (docs/serving.md): params get a placement estimate and a per-bucket
@@ -553,6 +554,12 @@ def admit_model_load(
 
     if bucket_rows_count is None:
         bucket_rows_count = int(config.get("serve_max_batch_rows", 8192))
+    if tenant is None:
+        # per-model serving tenants ("serving:<name>") so tenant_usage() and
+        # eviction can weigh actual per-model byte-seconds instead of one
+        # undifferentiated "serving" bucket; type name is the fallback when
+        # the caller has no registry name for the model
+        tenant = f"serving:{type(model).__name__}"
     capacity = device_capacity_bytes(devices=devices)
     budget = (
         None if capacity is None else int(capacity * (1.0 - headroom_fraction()))
@@ -564,16 +571,17 @@ def admit_model_load(
         if telemetry.enabled():
             telemetry.registry().gauge("memory.serve_estimate_bytes", est.total())
         if budget is None or est.total() + int(resident_bytes) + held <= budget:
-            # serving residents are shared infrastructure, accounted to the
-            # "serving" tenant (not whichever tenant's thread loaded them)
+            # serving residents are shared infrastructure, accounted to a
+            # per-model "serving:<name>" tenant (not whichever tenant's
+            # thread loaded them)
             reservation = led.reserve(
                 f"serve:{type(model).__name__}", "serve", est.total(),
-                tenant="serving",
+                tenant=tenant,
             )
             led.note_admission(budget)
             _audit.record_decision(
                 "admission", "serving", RESIDENT,
-                subject=type(model).__name__, tenant="serving",
+                subject=type(model).__name__, tenant=tenant,
                 estimate_bytes=est.total(), budget_bytes=budget,
             )
             return AdmissionDecision(
@@ -588,7 +596,7 @@ def admit_model_load(
         name, nbytes = est.largest()
         _audit.record_decision(
             "admission", "serving", "refused",
-            subject=type(model).__name__, tenant="serving",
+            subject=type(model).__name__, tenant=tenant,
             reason="over budget", estimate_bytes=est.total(),
             budget_bytes=budget, largest_term=name,
         )

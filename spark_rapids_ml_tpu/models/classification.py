@@ -311,6 +311,20 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             "lbfgs_history": 2 * mem * n_flat * itemsize,
         }
 
+    def _solver_flop_estimate(self, n_rows: int, n_cols: int) -> Optional[float]:
+        # GLM roofline model (ops_plane/efficiency.py): each L-BFGS
+        # iteration is dominated by the X·B forward matvec and the Xᵀr
+        # gradient matvec, 2·n·d·k_out FLOPs each; pointwise link terms are
+        # O(n·k) and omitted. max_iter is an UPPER bound on iterations, so
+        # MFU from this estimate is an upper bound too (documented bias).
+        try:
+            family = self.getOrDefault("family")
+        except Exception:
+            family = "auto"
+        k_out = 2 if family == "multinomial" else 1
+        iters = int(self._solver_params.get("max_iter", 100))
+        return 4.0 * n_rows * n_cols * k_out * iters
+
     def _fit_streaming(
         self, inputs: FitInputs, params: Dict[str, Any], classes, labels_host,
         alpha: float, l1_ratio: float,
@@ -773,6 +787,12 @@ class LogisticRegressionModel(_LogisticRegressionParams, _TpuModelWithColumns):
         # probability blocks logistic_predict materializes, [bucket, k] each
         k_out = max(2, int(np.asarray(self.coef_).shape[0]))
         return {"logits": 2 * int(bucket_rows_count) * k_out * itemsize}
+
+    def _serve_flop_estimate(self, n_rows, n_cols):
+        # roofline numerator per dispatched bucket: the X @ coef.T matmul
+        # (2*n*d*k) dominates; softmax/sigmoid epilogue omitted (lower bound)
+        k_out = max(1, int(np.asarray(self.coef_).shape[0]))
+        return 2.0 * n_rows * n_cols * k_out
 
     def _raw_prob(self, features) -> tuple:
         """Batched (raw, prob) arrays for a host feature block."""

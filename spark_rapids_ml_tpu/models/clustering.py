@@ -128,6 +128,15 @@ class KMeans(_KMeansParams, _TpuEstimator):
             "predict_tile": predict_rows * k * itemsize,
         }
 
+    def _solver_flop_estimate(self, n_rows: int, n_cols: int) -> Optional[float]:
+        # Lloyd roofline model (ops_plane/efficiency.py): per iteration the
+        # x·cᵀ term of the ‖x−c‖² expansion (2·n·k·d) plus the one-hot
+        # center accumulation (≤ 2·n·k·d). maxIter bounds iterations from
+        # above, so the MFU derived from this is an upper bound.
+        k = int(self._solver_params.get("n_clusters", 8))
+        iters = int(self._solver_params.get("max_iter", 300))
+        return 4.0 * n_rows * k * n_cols * iters
+
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._setDefault(k=2, initMode="k-means||", initSteps=2, maxIter=20, tol=1e-4, seed=1,
@@ -438,6 +447,12 @@ class KMeansModel(_KMeansParams, _TpuModelWithColumns):
         k = int(self.cluster_centers_.shape[0])
         tile = min(tile_rows(), max(1, int(bucket_rows_count)))
         return {"predict_tile": tile * k * itemsize}
+
+    def _serve_flop_estimate(self, n_rows, n_cols):
+        # roofline numerator: the [n, k] squared-distance block (~3*n*k*d for
+        # the expanded |x|^2 - 2 x.c + |c|^2 form); argmin epilogue omitted
+        k = max(1, int(self.cluster_centers_.shape[0]))
+        return 3.0 * n_rows * k * n_cols
 
 
 class _DBSCANParams(HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasIDCol):

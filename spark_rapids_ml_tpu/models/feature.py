@@ -79,6 +79,12 @@ class PCA(_PCAParams, _TpuEstimator):
             "vectors": 2 * n_cols * itemsize,
         }
 
+    def _solver_flop_estimate(self, n_rows: int, n_cols: int) -> Optional[float]:
+        # PCA roofline model (ops_plane/efficiency.py): the covariance
+        # einsum (2·n·d²) dominates; the d×d eigendecomposition (~9·d³) is
+        # negligible at n ≫ d and omitted.
+        return 2.0 * n_rows * n_cols * n_cols
+
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._setDefault(k=1)
@@ -244,3 +250,8 @@ class PCAModel(_PCAParams, _TpuModelWithColumns):
         # projection block
         k = int(np.asarray(self.components_).shape[0])
         return {"proj": int(bucket_rows_count) * k * itemsize}
+
+    def _serve_flop_estimate(self, n_rows, n_cols):
+        # roofline numerator: the (X - mean) @ components.T projection matmul
+        k = max(1, int(np.asarray(self.components_).shape[0]))
+        return 2.0 * n_rows * n_cols * k

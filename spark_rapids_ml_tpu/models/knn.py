@@ -395,6 +395,12 @@ class NearestNeighborsModel(_KNNParams, _TpuModel):
             "topk_carry": 2 * b * min(k, max(1, n_items)) * itemsize,
         }
 
+    def _serve_flop_estimate(self, n_rows, n_cols):
+        # roofline numerator: the full [queries, items] squared-distance
+        # sweep (~3*n*m*d); top-k selection epilogue omitted (lower bound)
+        n_items = int(self._item_extracted.n_rows) if self._item_extracted is not None else 0
+        return 3.0 * n_rows * max(1, n_items) * n_cols
+
     def _serve_program(self, serve_dtype=None, *, cap=None):
         """kNN serving hook: queries route through the PR-10 tiled distance
         core (`ops/distance.topk_tile`) so no `[batch, n_items]` distance

@@ -214,6 +214,13 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
             "vectors": 4 * n_cols * itemsize,
         }
 
+    def _solver_flop_estimate(self, n_rows: int, n_cols: int) -> Optional[float]:
+        # normal-equation roofline model (ops_plane/efficiency.py): the
+        # XᵀX gram accumulation (2·n·d²) plus Xᵀy (2·n·d); the (d,d) solve
+        # and any elastic-net CD sweeps over the gram are O(d²·iters) and
+        # omitted — with n ≫ d this is a tight lower bound on the work.
+        return 2.0 * n_rows * n_cols * (n_cols + 1)
+
     def _get_tpu_fit_func(self, extracted: ExtractedData):
         from .. import checkpoint as _ckpt
         from ..ops.linear import (
@@ -508,3 +515,7 @@ class LinearRegressionModel(_LinearRegressionParams, _TpuModelWithColumns):
         # per-bucket predict workspace (docs/serving.md): one prediction
         # scalar per row
         return {"pred": int(bucket_rows_count) * itemsize}
+
+    def _serve_flop_estimate(self, n_rows, n_cols):
+        # roofline numerator: the X @ coef dot per row (2*n*d)
+        return 2.0 * n_rows * n_cols

@@ -275,10 +275,14 @@ def ensure(
     best: Optional[Tuple[int, int]] = None
     best_t = float("inf")
     try:
-        for br, bk in candidates:
-            t = float(timer(br, bk))
-            if t < best_t:
-                best_t, best = t, (br, bk)
+        # the whole measurement session is one compile-ledger entry: every
+        # candidate run compiles its own kernel variant, and the efficiency
+        # plane should see the session's wall as compile time, not idle
+        with telemetry.compile_event("autotune.measure", key):
+            for br, bk in candidates:
+                t = float(timer(br, bk))
+                if t < best_t:
+                    best_t, best = t, (br, bk)
     except Exception:
         # a failed measurement (kernel error on an exotic part, OOM on a
         # candidate) must not fail the fit — the heuristic keeps planning

@@ -313,7 +313,11 @@ def kmeans_fit(
         centers, inertia, shift = step(centers, fast)
         n_iter += 1
         if prev_shift is not None:
-            shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (documented above) — overlapped with the current step's compute
+            # the deferred shift fetch is Lloyd's per-iteration sync — the
+            # efficiency attributor times the wait as `execute` (this IS the
+            # solver cadence point; no sync added)
+            with telemetry.device_wait("kmeans_shift"):
+                shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (documented above) — overlapped with the current step's compute
             if not math.isfinite(shift_host):
                 _raise_diverged(n_iter - 1, last_good, f"center shift = {shift_host}")
             if _nc is not None:
@@ -333,22 +337,24 @@ def kmeans_fit(
             # documented checkpoint overhead; the float survives the
             # round-trip exactly, so the resumed convergence pipeline sees
             # the same value the uninterrupted run would
-            prev_shift = float(prev_shift)  # host-fetch-ok: checkpoint-cadence boundary (config["checkpoint_every_iters"])
-            centers_host = np.asarray(centers)  # host-fetch-ok: the checkpoint itself — replicated centers must land on host to survive
+            with telemetry.device_wait("kmeans_checkpoint"):
+                prev_shift = float(prev_shift)  # host-fetch-ok: checkpoint-cadence boundary (config["checkpoint_every_iters"])
+                centers_host = np.asarray(centers)  # host-fetch-ok: the checkpoint itself — replicated centers must land on host to survive
             if _nc is not None:
                 # the checkpoint already fetched the full iterate: sweep it
                 # (a non-finite checkpoint would poison every later resume)
                 _nc("kmeans.checkpoint", solver="kmeans", iteration=n_iter,
                     centers=centers_host)
-            ckpt_store.save(ckpt_key, _ckpt.SolverCheckpoint(
-                solver="kmeans", iteration=n_iter,
-                state={
-                    "centers": centers_host,
-                    "prev_shift": prev_shift,
-                    # the divergence-fallback iterate (one step behind)
-                    "last_good": np.asarray(last_good),  # host-fetch-ok: checkpoint payload (one step behind, for divergence fallback)
-                },
-            ))
+            with telemetry.host_section("kmeans_checkpoint"):
+                ckpt_store.save(ckpt_key, _ckpt.SolverCheckpoint(
+                    solver="kmeans", iteration=n_iter,
+                    state={
+                        "centers": centers_host,
+                        "prev_shift": prev_shift,
+                        # the divergence-fallback iterate (one step behind)
+                        "last_good": np.asarray(last_good),  # host-fetch-ok: checkpoint payload (one step behind, for divergence fallback)
+                    },
+                ))
             # mid-solve fault injection points (`fail:stage=solve` and
             # `oom:stage=solve` plans): both fire AFTER the boundary
             # checkpoint landed, so a retried fit — bounded transient retry

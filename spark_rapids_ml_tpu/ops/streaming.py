@@ -173,7 +173,10 @@ def linear_streaming_stats(inputs: Any, fast: bool = False) -> Dict[str, np.ndar
                 blk["val"], blk["idx"], blk["y"], blk["w"], d=d, tile=8192,
                 fast=fast,
             )
-            part = [np.asarray(p) for p in part]
+            # per-chunk partial fetch = the streaming pipeline's existing
+            # sync; the efficiency attributor times the wait as `execute`
+            with telemetry.device_wait("stream_chunk"):
+                part = [np.asarray(p) for p in part]
             if _nc is not None:
                 _nc("linear_stream.chunk", solver="linear_stream",
                     **{n: p for n, p in zip(_STATS_NAMES, part)})
@@ -181,7 +184,8 @@ def linear_streaming_stats(inputs: Any, fast: bool = False) -> Dict[str, np.ndar
     else:
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, extras)):
             part = _stats_jit(blk["X"], blk["y"], blk["w"], fast=fast)
-            part = [np.asarray(p) for p in part]
+            with telemetry.device_wait("stream_chunk"):
+                part = [np.asarray(p) for p in part]
             if _nc is not None:
                 _nc("linear_stream.chunk", solver="linear_stream",
                     **{n: p for n, p in zip(_STATS_NAMES, part)})
@@ -283,7 +287,8 @@ def pca_fit_streaming(inputs: Any, *, k: int, fast: bool = False) -> Dict[str, j
         _nc = numcheck.hook()  # SRML_NUMCHECK=1: sweep per-chunk host partials
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
             b_sw, b_sx, _ = _moments_block(blk["X"], blk["w"])
-            b_sw, b_sx = np.asarray(b_sw), np.asarray(b_sx)  # host-fetch-ok: out-of-core by design — per-CHUNK moment partials accumulate on host (tiny [d]-sized payloads)
+            with telemetry.device_wait("stream_chunk"):
+                b_sw, b_sx = np.asarray(b_sw), np.asarray(b_sx)  # host-fetch-ok: out-of-core by design — per-CHUNK moment partials accumulate on host (tiny [d]-sized payloads)
             if _nc is not None:
                 _nc("pca_stream.chunk", solver="pca_stream", sum_w=b_sw, sum_x=b_sx)
             sw = b_sw if sw is None else sw + b_sw
@@ -293,7 +298,8 @@ def pca_fit_streaming(inputs: Any, *, k: int, fast: bool = False) -> Dict[str, j
         mean_dev = jnp.asarray(mean, dtype)
         cov_sum = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
-            part = np.asarray(_cov_block(blk["X"], blk["w"], mean_dev, fast=fast))  # host-fetch-ok: out-of-core by design — per-CHUNK [d,d] covariance partial accumulates on host
+            with telemetry.device_wait("stream_chunk"):
+                part = np.asarray(_cov_block(blk["X"], blk["w"], mean_dev, fast=fast))  # host-fetch-ok: out-of-core by design — per-CHUNK [d,d] covariance partial accumulates on host
             if _nc is not None:
                 _nc("pca_stream.chunk", solver="pca_stream", cov_partial=part)
             cov_sum = part if cov_sum is None else cov_sum + part
@@ -365,7 +371,8 @@ def kmeans_fit_streaming(
         sums = counts = inertia = None
         for blk in stream_place_blocks(inputs.mesh, _dense_block_iter(inputs, {"w": w})):
             s, n_, i_ = block_assign_accumulate(blk["X"], blk["w"], c, fast=f)
-            s, n_, i_ = np.asarray(s), np.asarray(n_), np.asarray(i_)  # host-fetch-ok: out-of-core by design — per-CHUNK [k,d] assignment partials accumulate on host
+            with telemetry.device_wait("stream_chunk"):
+                s, n_, i_ = np.asarray(s), np.asarray(n_), np.asarray(i_)  # host-fetch-ok: out-of-core by design — per-CHUNK [k,d] assignment partials accumulate on host
             if _nc is not None:
                 _nc("kmeans_stream.chunk", solver="kmeans_stream",
                     sums=s, inertia=i_)
@@ -404,7 +411,8 @@ def kmeans_fit_streaming(
         centers, inertia, shift = step(centers, fast)
         n_iter += 1
         if prev_shift is not None:
-            shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (resident-loop parity) — overlapped with the current step's compute
+            with telemetry.device_wait("kmeans_shift"):
+                shift_host = float(prev_shift)  # host-fetch-ok: the DEFERRED convergence fetch (resident-loop parity) — overlapped with the current step's compute
             if not math.isfinite(shift_host):
                 _raise_diverged(n_iter - 1, last_good, f"center shift = {shift_host}")
             if _nc is not None:

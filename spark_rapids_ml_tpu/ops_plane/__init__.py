@@ -20,7 +20,11 @@
 #   * audit            — the bounded per-tenant decision log (every
 #                        admission/demotion/preemption/eviction verdict);
 #   * drift            — per-column ingest feature stats + PSI-vs-baseline
-#                        (ROADMAP item 5's observability half).
+#                        (ROADMAP item 5's observability half);
+#   * efficiency       — the attribution plane: per-tenant device-time
+#                        splits (execute/compile/host/idle), the jit
+#                        compile ledger, and roofline/MFU gauges
+#                        (docs/observability.md "Efficiency plane").
 #
 # `report()` is the one-call roll-up — live (`ops_plane.report()`), scraped
 # (`GET /snapshot`), or archived (`export.write_snapshot()` ->
@@ -31,12 +35,13 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
-from . import audit, drift, export, slo
+from . import audit, drift, efficiency, export, slo
 from .export import ensure_server, start_server, stop_server, write_snapshot
 
 __all__ = [
     "audit",
     "drift",
+    "efficiency",
     "export",
     "slo",
     "report",
@@ -58,6 +63,7 @@ def report(
     (optionally filtered to one tenant / trace), per-tenant HBM accounting
     from the shared ledger, drift stats, and the registry snapshot."""
     from .. import telemetry
+    from ..ops import autotune as _autotune
     from ..scheduler.ledger import global_ledger
 
     reg = telemetry.registry()
@@ -73,5 +79,7 @@ def report(
         "decision_log": audit.stats(),
         "tenants": global_ledger().tenant_usage(),
         "drift": drift.last_stats(),
+        "efficiency": efficiency.summary(),
+        "autotune": {**_autotune.stats(), "table_path": _autotune.table_path()},
         "telemetry": reg.snapshot(),
     }

@@ -33,6 +33,7 @@
 from __future__ import annotations
 
 import itertools
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -147,7 +148,10 @@ class HbmLedger:
         bytes x wall seconds held) and `chip_seconds` (claimed chips x wall
         seconds), plus live claim state — the tenant cost view
         `ops_plane.report()` and `benchmark/opsreport.py` serve. Live
-        reservations are integrated up to now."""
+        reservations are integrated up to now. When the efficiency plane
+        has attributed device time (ops_plane.efficiency), each tenant row
+        additionally carries a `device_time` split so chip-seconds divide
+        into execute/compile/host/idle."""
         now = _now()
         with self._lock:
             for r in self._by_id.values():
@@ -162,7 +166,25 @@ class HbmLedger:
                 u = out.setdefault(r.tenant, _fresh_usage())
                 u["live_bytes"] = u.get("live_bytes", 0.0) + r.nbytes
                 u["live_reservations"] = u.get("live_reservations", 0.0) + 1
-            return out
+        # outside the ledger lock: the efficiency module has its own lock
+        # (never import it from here — probe, so the accounting plane stays
+        # optional and import-cycle-free)
+        eff = sys.modules.get(
+            (__package__ or "spark_rapids_ml_tpu.scheduler").rsplit(".", 1)[0]
+            + ".ops_plane.efficiency"
+        )
+        if eff is not None:
+            try:
+                for tenant, split in eff.tenant_time_splits().items():
+                    if tenant in out:
+                        out[tenant]["device_time"] = split  # type: ignore[assignment]
+                    else:
+                        u = _fresh_usage()
+                        u["device_time"] = split  # type: ignore[assignment]
+                        out[tenant] = u
+            except Exception:
+                pass
+        return out
 
     # ------------------------------------------------------------ writes ---
     def _accrue_locked(self, r: HbmReservation, now: float) -> None:

@@ -256,55 +256,76 @@ class ScoringEngine:
             for fut in group:
                 reg.observe("serve.queue_wait_s", t0 - fut.t_submit)
         try:
-            entry = self.registry.get(group[0].name)  # use-touch: keeps it MRU
-            program = entry.program
-            if program is None:
-                # evicted between get() and here (_evict_locked nulls the
-                # program — the entry object may still be in a caller's
-                # hands): fail typed like a never-resident model, not with
-                # an AttributeError off the None
-                raise KeyError(
-                    f"model {group[0].name!r} was evicted mid-flight"
-                )
-            sizes = [int(f.features.shape[0]) for f in group]
-            block = (
-                np.concatenate([f.features for f in group], axis=0)
-                if len(group) > 1
-                else group[0].features
-            )
-            n = int(block.shape[0])
-            model = entry.model
-            with dtype_scope(
-                np.float32 if model._float32_inputs else np.float64,
-                model._matmul_precision,
+            # one efficiency attribution window per dispatch group, keyed to
+            # the per-model serving tenant ("serving:<name>") so the split
+            # lands next to the model's HBM byte-seconds in tenant_usage()
+            with telemetry.attribution(
+                "serve_dispatch", tenant=f"serving:{group[0].name}"
             ):
-                in_flight = []
-                # chunk oversized blocks at the program's ladder cap; a
-                # zero-row block still dispatches once (shaped empty outputs)
-                for start in range(0, n, program.cap) if n else (0,):
-                    chunk = block[start : min(start + program.cap, n)]
-                    in_flight.append(program.dispatch(chunk))
-                    if reg is not None and not program.last_dispatch_new_shape:
-                        reg.inc("serve.bucket_hits")
-                # ---- response assembly: THE one blocking point -----------
-                jax.block_until_ready([r for r, _ in in_flight])  # serve-ok: the engine's single response-assembly sync point (docs/serving.md async contract)
-                outs = [program.fetch(r, nv) for r, nv in in_flight]
-            if self._numcheck is not None:
-                # response assembly is the serving plane's one host boundary:
-                # the fetched outputs are swept before any tenant sees them.
-                # allow_inf: top-k pads short result rows with inf distances
-                for oi, out in enumerate(outs):
-                    vals = out if isinstance(out, tuple) else (out,)
-                    self._numcheck(
-                        "serving.response", solver=group[0].name, allow_inf=True,
-                        **{f"chunk{oi}_out{j}": v for j, v in enumerate(vals)},
+                entry = self.registry.get(group[0].name)  # use-touch: keeps it MRU
+                program = entry.program
+                if program is None:
+                    # evicted between get() and here (_evict_locked nulls the
+                    # program — the entry object may still be in a caller's
+                    # hands): fail typed like a never-resident model, not with
+                    # an AttributeError off the None
+                    raise KeyError(
+                        f"model {group[0].name!r} was evicted mid-flight"
                     )
-            self._resolve_group(group, sizes, outs)
-            if reg is not None:
-                reg.inc("serve.rows", n)
-                t1 = time.monotonic()
-                for fut in group:
-                    reg.observe("serve.e2e_s", t1 - fut.t_submit)
+                sizes = [int(f.features.shape[0]) for f in group]
+                block = (
+                    np.concatenate([f.features for f in group], axis=0)
+                    if len(group) > 1
+                    else group[0].features
+                )
+                n = int(block.shape[0])
+                model = entry.model
+                if reg is not None and n:
+                    # per-bucket roofline numerator (the `_serve_flop_estimate`
+                    # hook): feeds `efficiency.serve_mfu` when a peak is set
+                    fhook = getattr(model, "_serve_flop_estimate", None)
+                    if fhook is not None:
+                        try:
+                            flops = fhook(n, int(block.shape[1]))
+                        except Exception:
+                            flops = None
+                        if flops:
+                            telemetry.note_flops(
+                                float(flops), chips=program.multiple
+                            )
+                with dtype_scope(
+                    np.float32 if model._float32_inputs else np.float64,
+                    model._matmul_precision,
+                ):
+                    in_flight = []
+                    # chunk oversized blocks at the program's ladder cap; a
+                    # zero-row block still dispatches once (shaped empty outputs)
+                    for start in range(0, n, program.cap) if n else (0,):
+                        chunk = block[start : min(start + program.cap, n)]
+                        in_flight.append(program.dispatch(chunk))
+                        if reg is not None and not program.last_dispatch_new_shape:
+                            reg.inc("serve.bucket_hits")
+                    # ---- response assembly: THE one blocking point -----------
+                    with telemetry.device_wait("serve_assembly"):
+                        jax.block_until_ready([r for r, _ in in_flight])  # serve-ok: the engine's single response-assembly sync point (docs/serving.md async contract)
+                    outs = [program.fetch(r, nv) for r, nv in in_flight]
+                if self._numcheck is not None:
+                    # response assembly is the serving plane's one host boundary:
+                    # the fetched outputs are swept before any tenant sees them.
+                    # allow_inf: top-k pads short result rows with inf distances
+                    for oi, out in enumerate(outs):
+                        vals = out if isinstance(out, tuple) else (out,)
+                        self._numcheck(
+                            "serving.response", solver=group[0].name, allow_inf=True,
+                            **{f"chunk{oi}_out{j}": v for j, v in enumerate(vals)},
+                        )
+                with telemetry.host_section("serve_response"):
+                    self._resolve_group(group, sizes, outs)
+                if reg is not None:
+                    reg.inc("serve.rows", n)
+                    t1 = time.monotonic()
+                    for fut in group:
+                        reg.observe("serve.e2e_s", t1 - fut.t_submit)
         except Exception as e:
             if reg is not None:
                 # the error-rate SLO's numerator, one per failed request

@@ -157,6 +157,7 @@ class ModelRegistry:
                         resident_bytes=0,
                         bucket_rows_count=self._cap,
                         devices=devices,
+                        tenant=f"serving:{name}",
                     )
                     break
                 except HbmBudgetError as e:
@@ -251,7 +252,8 @@ class ModelRegistry:
         # the queryable side of the stamp (ops_plane.audit): why THIS model
         # left residency, without holding a reference to it
         _audit.record_decision(
-            "eviction", "serving", "evicted", subject=name, tenant="serving",
+            "eviction", "serving", "evicted", subject=name,
+            tenant=f"serving:{name}",
             reason=reason, estimate_bytes=entry.resident_bytes,
         )
         # the program (and its device state) are the only HBM pins; the

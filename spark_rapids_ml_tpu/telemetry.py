@@ -841,6 +841,103 @@ def span(name: str, *, logger: Any = None, **attrs: Any):
     return _Span(name, logger, attrs)
 
 
+# ------------------------------------------------------ efficiency hooks ----
+#
+# The profiling hook layer for the efficiency attribution plane
+# (ops_plane/efficiency.py, docs/observability.md "Efficiency plane").
+# Instrumented call sites stay one cheap call away from telemetry — they
+# never import the ops_plane package themselves — and the disabled path is
+# one `_STATE.on` branch returning a shared no-op (the same identity
+# contract `span()` pins). Timers only ever wrap a host fetch the caller
+# already performs; they add no syncs of their own.
+
+
+class _NoopCompileEvent:
+    """Shared do-nothing compile event — what `compile_event()` returns
+    while disabled (`cache_hit` stays False)."""
+
+    __slots__ = ()
+    cache_hit = False
+
+    def __enter__(self) -> "_NoopCompileEvent":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NOOP_COMPILE_EVENT = _NoopCompileEvent()
+
+
+def _efficiency():
+    """sys.modules probe for the efficiency plane (the `_window_params`
+    idiom): attribution scopes are only ever opened through code that
+    imported the module, so an absent module means no scope can be active
+    and the hook can bail without importing anything."""
+    return sys.modules.get(
+        (__package__ or "spark_rapids_ml_tpu") + ".ops_plane.efficiency"
+    )
+
+
+def device_wait(stage: str):
+    """Time a `block_until_ready`/`np.asarray` wait at a boundary that
+    ALREADY host-fetches, attributing the wall to the active attribution
+    scope's `execute` kind under `stage`. Shared no-op when telemetry is
+    disabled or no scope is open on this thread."""
+    if not _STATE.on:
+        return _NOOP_SPAN
+    eff = _efficiency()
+    if eff is None or not eff.active():
+        return _NOOP_SPAN
+    return eff.device_wait_timer(stage)
+
+
+def host_section(stage: str):
+    """Time host-side boundary work (checkpoint serialization, response
+    slicing) into the active scope's `host` kind. Same no-op contract as
+    `device_wait`."""
+    if not _STATE.on:
+        return _NOOP_SPAN
+    eff = _efficiency()
+    if eff is None or not eff.active():
+        return _NOOP_SPAN
+    return eff.host_section_timer(stage)
+
+
+def compile_event(program: str, shape_key: Any):
+    """Ledger one jit entry-point execution keyed (program, shape-class) —
+    first sighting records the body's wall as compile time, later sightings
+    count as cache hits (`cm.cache_hit`). Process-wide: records with or
+    without an attribution scope. Shared no-op when disabled."""
+    if not _STATE.on:
+        return _NOOP_COMPILE_EVENT
+    from .ops_plane import efficiency
+
+    return efficiency.compile_event(program, str(shape_key))
+
+
+def note_flops(flops: float, *, chips: int = 1) -> None:
+    """Record the active attribution scope's analytic FLOP estimate (the
+    `_solver_flop_estimate` hooks) — the roofline/MFU numerator. No-op when
+    disabled or outside a scope."""
+    if not _STATE.on:
+        return
+    eff = _efficiency()
+    if eff is not None and eff.active():
+        eff.note_flops(flops, chips=chips)
+
+
+def attribution(label: str, *, tenant: Any = None):
+    """Open an efficiency attribution window outside the fit path (the
+    serving engine opens one per dispatch group). Shared no-op span when
+    telemetry is disabled; fits get theirs through `fit_scope`."""
+    if not _STATE.on:
+        return _NOOP_SPAN
+    from .ops_plane import efficiency
+
+    return efficiency.attribution_scope(label, tenant=tenant)
+
+
 # ------------------------------------------------------- derived recorders --
 
 
@@ -926,11 +1023,21 @@ def fit_scope(label: str):
         yield scope
         return
     m = _REGISTRY.mark()
+    # the efficiency attribution scope rides the fit scope: one window per
+    # top-level fit (nested fits attribute into the outer window — the
+    # scope itself refuses to nest)
+    from .ops_plane import efficiency
+
+    eff_cm = efficiency.attribution_scope(label)
     try:
-        yield scope
+        with eff_cm:
+            yield scope
     finally:
         delta = _REGISTRY.delta(m)
         scope["metrics"] = delta
+        eff_summary = getattr(eff_cm, "summary", None)
+        if eff_summary:
+            scope["efficiency"] = eff_summary
         _sink_write(
             {
                 "kind": "fit",

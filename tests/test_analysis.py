@@ -32,6 +32,7 @@ from ci.analysis.rules import (  # noqa: E402
     MetricNameRule,
     PadRowsRule,
     PerfCounterRule,
+    ProfilerScopeRule,
     RawDistanceRule,
     LedgerBypassRule,
     ServeDispatchRule,
@@ -65,6 +66,88 @@ def test_perf_counter_true_positive():
 def test_perf_counter_alias_still_caught():
     fs = run("from time import perf_counter as pc\nt = pc()\n", PerfCounterRule)
     assert rule_ids(fs) == ["bare-perf-counter"]
+
+
+def test_profiler_scope_jax_profiler_true_positive():
+    fs = run(
+        """
+        import jax
+        def f(d):
+            with jax.profiler.trace(d):
+                pass
+        """,
+        ProfilerScopeRule,
+    )
+    assert rule_ids(fs) == ["profiler-scope"]
+
+
+def test_profiler_scope_sync_then_clock_true_positive():
+    fs = run(
+        """
+        import time
+        def f(x):
+            t0 = time.perf_counter()
+            x.block_until_ready()
+            return time.perf_counter() - t0
+        """,
+        ProfilerScopeRule,
+    )
+    assert rule_ids(fs) == ["profiler-scope"] * 2
+
+
+def test_profiler_scope_waiver_and_exempt_files():
+    src = """
+    import jax
+    def f(d):
+        with jax.profiler.trace(d):  # profiler-ok: the sanctioned hook
+            pass
+    """
+    assert run(src, ProfilerScopeRule) == []
+    # the attribution owners are exempt wholesale
+    bare = """
+    import time
+    def f(x):
+        t0 = time.perf_counter()
+        x.block_until_ready()
+        return time.perf_counter() - t0
+    """
+    for owner in (
+        "spark_rapids_ml_tpu/telemetry.py",
+        "spark_rapids_ml_tpu/ops_plane/efficiency.py",
+    ):
+        assert run(bare, ProfilerScopeRule, relpath=owner) == []
+
+
+def test_profiler_scope_false_positive_guards():
+    # perf_counter WITHOUT a sync in the same immediate body: not this
+    # rule's finding (PerfCounterRule owns plain perf_counter use)
+    fs = run(
+        "import time\ndef f():\n    return time.perf_counter()\n",
+        ProfilerScopeRule,
+    )
+    assert fs == []
+    # a sync inside a NESTED function doesn't mark the enclosing timer as
+    # device-timing (the autotuner's measurement-closure shape)
+    fs = run(
+        """
+        import time
+        def timer(run):
+            def run_once():
+                run().block_until_ready()
+            t0 = time.perf_counter()
+            run_once()
+            return time.perf_counter() - t0
+        """,
+        ProfilerScopeRule,
+    )
+    assert fs == []
+    # trigger text in comments/docstrings never fires the AST rule
+    fs = run(
+        '"""uses jax.profiler.trace and time.perf_counter"""\n'
+        "# jax.profiler.start_trace idiom\n",
+        ProfilerScopeRule,
+    )
+    assert fs == []
 
 
 def test_blocking_while_true_and_bare_wait():

@@ -618,8 +618,9 @@ def test_chaos_latency_spike_flips_healthz_and_opsreport_names_it(
             # detection is scrape-fresh: within ~one bucket width of the
             # spike landing (generous slack for CI scheduling)
             assert detect_s < 2 * core.config["metrics_bucket_seconds"] + 1.0
-        # the load's admission decision is in the trail, tenant "serving"
-        recs = audit.decisions(tenant="serving", subsystem="serving")
+        # the load's admission decision is in the trail, under the
+        # per-model serving tenant "serving:m"
+        recs = audit.decisions(tenant="serving:m", subsystem="serving")
         assert recs and recs[0]["verdict"] == "resident"
         trace = recs[0].get("trace_id")
         # archive + render: opsreport names the SLO, the tenant, the entries
@@ -627,14 +628,14 @@ def test_chaos_latency_spike_flips_healthz_and_opsreport_names_it(
         assert export.write_snapshot(snap_path) == snap_path
         from benchmark.opsreport import main
 
-        args = [snap_path, "--tenant", "serving"]
+        args = [snap_path, "--tenant", "serving:m"]
         if trace:
             args += ["--trace-id", trace]
         rc = main(args)
         out = capsys.readouterr().out
         assert rc == 1  # an SLO is failing
         assert "FAILING" in out and "serve_p99" in out
-        assert "tenant=serving" in out and "resident" in out
+        assert "tenant=serving:m" in out and "resident" in out
     finally:
         chaos.clear_fault_plan()
         export.stop_server()
