@@ -77,17 +77,20 @@ BASELINES = {
     "kmeans_bf16": 8_333.0,
     "logreg_bf16": 12_500.0,
 }
-# serving runs FIRST: it builds its own small resident model and must not
-# coexist with the ~12 GiB dense protocol block on a single v5e
+# the serving lanes run FIRST: they build their own small resident models
+# and must not coexist with the ~12 GiB dense protocol block on a single
+# v5e. serving_saturation leads — it retunes the telemetry window buckets
+# for its fast closed loop and resets the registry on exit, so running it
+# before every other lane keeps their counters out of the blast radius.
 ALGOS = (
-    "serving", "pca", "logreg", "logreg_bf16", "kmeans", "kmeans_bf16",
-    "kmeans_scale", "knn",
+    "serving_saturation", "serving", "pca", "logreg", "logreg_bf16",
+    "kmeans", "kmeans_bf16", "kmeans_scale", "knn",
 )
 # lanes that run on ONE local device by construction (the serving plane's
 # registry/engine are single-device): their rows/sec is already per-chip —
 # dividing by the mesh size would underreport them n_chips-fold on
 # multi-chip rounds and false-fail the lane gate vs single-chip history
-SINGLE_DEVICE_LANES = {"serving", "sched_contention"}
+SINGLE_DEVICE_LANES = {"serving", "serving_saturation", "sched_contention"}
 KNN_QUERIES = int(os.environ.get("BENCH_KNN_QUERIES", 4096))
 KNN_K = int(os.environ.get("BENCH_KNN_K", 64))
 SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 256))
@@ -507,6 +510,48 @@ def bench_serving_lane() -> tuple:
     }
 
 
+def bench_saturation_lane() -> tuple:
+    """Serving saturation lane (docs/serving.md "Overload & backpressure"):
+    a chaos `burst:stage=serve` plan ramps offered load past the measured
+    plateau and the closed loop — deadline admission, bounded queue, the
+    per-tenant backpressure ladder, adaptive batching — must degrade
+    gracefully. The runner's hard gates (zero over-deadline dispatches,
+    deadline-bounded served p99, goodput within a factor of the plateau,
+    every ladder transition audited) raise here, so a graceful-overload
+    regression is a FAILED lane, not a slower number. Lane value: rows/sec
+    of goodput sustained UNDER the burst; the served p99 rides the record's
+    `latency_lanes` embed (lower-is-better gate)."""
+    from benchmark.bench_saturation import run_saturation_bench
+
+    out = run_saturation_bench()
+    _log(
+        f"serving_saturation: plateau {out['plateau_rows_per_sec']:,.0f} rows/s, "
+        f"burst offered {out['burst_offered_rows_per_sec']:,.0f} -> served "
+        f"{out['burst_rows_per_sec']:,.0f} rows/s (p99 {out['burst_p99_ms']:.0f}ms, "
+        f"deadline {out['deadline_ms']:.0f}ms), recovered to "
+        f"{out['recover_rows_per_sec']:,.0f} rows/s at level "
+        f"{out['final_level']!r} in {out['recover_wait_s']:.1f}s; "
+        f"{int(out['shed_requests'])} shed / {int(out['throttled_requests'])} "
+        f"throttled / {int(out['rejected_requests'])} rejected / "
+        f"{int(out['expired_requests'])} expired, {int(out['transitions'])} "
+        f"audited transition(s) [{', '.join(out['audited_verdicts'])}]"
+    )
+    failed = [n for n, g in out["gates"].items() if not g["ok"]]
+    if failed:
+        raise RuntimeError(
+            "serving_saturation gates failed: "
+            + "; ".join(f"{n}: {out['gates'][n]['detail']}" for n in failed)
+        )
+    return out["burst_rows_per_sec"], {
+        "saturation_p99_ms": round(out["burst_p99_ms"], 3),
+    }, {
+        # report-only ops embed: the gate verdicts + ladder evidence
+        "gates": {n: g["ok"] for n, g in out["gates"].items()},
+        "audited_verdicts": out["audited_verdicts"],
+        "transitions": out["transitions"],
+    }
+
+
 def _phase(name: str) -> None:
     """Structured heartbeat to the parent watchdog: `@PHASE <name>` on stdout.
     Any phase line counts as PROGRESS — the parent only kills a child whose
@@ -568,6 +613,7 @@ def run_child() -> int:
         CV_ALGO: lambda: bench_cv_lane(),
         OOCORE_ALGO: lambda: bench_oocore_lane(),
         SCHED_ALGO: lambda: bench_scheduler_lane(),
+        "serving_saturation": lambda: bench_saturation_lane(),
         "serving": lambda: bench_serving_lane(),
         "pca": lambda: bench_pca(dense_data()["X"], dense_data()["w"], mesh),
         "logreg": lambda: bench_logreg(

@@ -116,6 +116,24 @@ def render(
             f"tenant={d.get('tenant')} {d.get('subject')}: "
             f"{d.get('verdict')}{reason}{tid}"
         )
+    serving = (report.get("serving") or {}).get("tenants") or {}
+    if serving:
+        lines.append("serving overload (backpressure ladder):")
+        for name in sorted(serving):
+            if tenant is not None and name != tenant:
+                continue
+            s = serving[name]
+            burn = s.get("burn")
+            burn_s = f", burn={burn:.2f}" if burn is not None else ""
+            p99 = s.get("e2e_p99_s")
+            p99_s = f", e2e p99={p99 * 1e3:.1f}ms" if p99 is not None else ""
+            lines.append(
+                f"  {name}: level={s.get('level')}{burn_s}{p99_s} — "
+                f"shed={int(s.get('shed_requests', 0))} "
+                f"throttled={int(s.get('throttled_requests', 0))} "
+                f"degraded={int(s.get('degraded_requests', 0))} over "
+                f"{int(s.get('transitions', 0))} transition(s)"
+            )
     drift = report.get("drift")
     if drift:
         psi = (

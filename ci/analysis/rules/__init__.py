@@ -28,6 +28,7 @@ from .serving import ServeDispatchRule
 from .sleeps import SleepRule
 from .spmd import SpmdDivergenceRule
 from .timing import PerfCounterRule
+from .wallclock import WallclockDeadlineRule
 
 
 def default_rules() -> List[RuleBase]:
@@ -38,6 +39,7 @@ def default_rules() -> List[RuleBase]:
         BlockingRule(),
         JsonlRule(),
         SleepRule(),
+        WallclockDeadlineRule(),
         MemStatsRule(),
         PadRowsRule(),
         # --- framework-aware detectors -----------------------------------
@@ -74,6 +76,7 @@ __all__ = [
     "BlockingRule",
     "JsonlRule",
     "SleepRule",
+    "WallclockDeadlineRule",
     "MemStatsRule",
     "PadRowsRule",
     "SpmdDivergenceRule",

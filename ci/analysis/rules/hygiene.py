@@ -36,6 +36,7 @@ KNOWN_WAIVER_TAGS = {
     "prng",
     "histogram",
     "profiler",
+    "wallclock",
 }
 
 

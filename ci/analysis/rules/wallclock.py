@@ -1,0 +1,143 @@
+#
+# Wall-clock deadline rule: `time.time()` feeding deadline/timeout
+# arithmetic in the framework is a finding — `time.monotonic()` is the
+# deadline contract (docs/serving.md "Overload & backpressure"). Wall clocks
+# step (NTP slew, VM migration, leap smearing); a deadline computed from one
+# can expire a request instantly or never. The serving plane's deadline
+# admission (PR 18) made this a framework-wide invariant, so the gate pins
+# it the way bare-sleep/perf_counter are pinned.
+#
+# What fires:
+#   * a Compare with a wall-tainted operand — `if time.time() > deadline`,
+#     `while now - t0 < timeout` where `now = time.time()`;
+#   * a deadline/timeout-named binding assigned a wall-tainted value —
+#     `deadline = time.time() + 5`;
+#   * a deadline/timeout-named call keyword passed a wall-tainted value.
+#
+# What does NOT fire (the timestamping idiom is legal everywhere):
+#   * `{"t": time.time()}` record fields, bare `t = time.time()` stamps,
+#     attribute stamps (`self._w0 = time.time()`) — a reading that never
+#     reaches comparison or deadline arithmetic;
+#   * `time.monotonic()` anything.
+#
+# Taint is function-scoped (module scope counts as one scope): a name
+# assigned from `time.time()` — directly or through +/- arithmetic — is
+# wall-tainted for that scope. Cross-clock comparisons that are genuinely
+# wall-clock (file mtimes) carry `# wallclock-ok: <reason>`.
+#
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Set
+
+from ..engine import FileContext, RuleBase, dotted
+
+_DEADLINE_NAME = re.compile(r"deadline|timeout|expir|t_end|until", re.I)
+
+_SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_scope(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk `root` without descending into nested function scopes (each
+    nested function is analyzed as its own scope)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _SCOPE_TYPES):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+class WallclockDeadlineRule(RuleBase):
+    id = "wallclock-deadline"
+    waiver = "wallclock"
+    tree_scope = ("spark_rapids_ml_tpu",)
+    exempt_files = frozenset()
+    description = "time.time() feeding deadline/timeout arithmetic"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
+        scopes = [tree] + [
+            n for n in ast.walk(tree) if isinstance(n, _SCOPE_TYPES)
+        ]
+        for scope in scopes:
+            self._check_scope(scope, ctx)
+
+    # ------------------------------------------------------------- scope --
+    def _check_scope(self, scope: ast.AST, ctx: FileContext) -> None:
+        tainted: Set[str] = set()
+        # two passes so order of definition doesn't matter for the taint set
+        for _ in range(2):
+            for node in _walk_scope(scope):
+                if isinstance(node, ast.Assign) and self._wall(node.value, ctx, tainted):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    if isinstance(node.target, ast.Name) and self._wall(
+                        node.value, ctx, tainted
+                    ):
+                        tainted.add(node.target.id)
+
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                if any(self._wall(o, ctx, tainted) for o in operands):
+                    ctx.emit(
+                        self,
+                        node,
+                        "wall-clock time.time() in a deadline/timeout "
+                        "comparison — the deadline contract is "
+                        "time.monotonic() (or mark `# wallclock-ok: <reason>`)",
+                    )
+            elif isinstance(node, ast.Assign):
+                if self._wall(node.value, ctx, tainted) and any(
+                    self._deadliney(t) for t in node.targets
+                ):
+                    ctx.emit(
+                        self,
+                        node,
+                        "deadline/timeout bound computed from wall-clock "
+                        "time.time() — use time.monotonic() (or mark "
+                        "`# wallclock-ok: <reason>`)",
+                    )
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (
+                        kw.arg
+                        and _DEADLINE_NAME.search(kw.arg)
+                        and self._wall(kw.value, ctx, tainted)
+                    ):
+                        ctx.emit(
+                            self,
+                            node,
+                            f"wall-clock time.time() passed as {kw.arg!r} — "
+                            "deadline/timeout arguments take monotonic "
+                            "readings (or mark `# wallclock-ok: <reason>`)",
+                        )
+
+    # ----------------------------------------------------------- helpers --
+    def _wall(self, node: ast.AST, ctx: FileContext, tainted: Set[str]) -> bool:
+        """Whether `node` carries a wall-clock reading: a `time.time()` call,
+        a tainted name, or +/- arithmetic over either."""
+        if isinstance(node, ast.Call):
+            return dotted(node.func, ctx.imports) == "time.time"
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Sub)):
+            return self._wall(node.left, ctx, tainted) or self._wall(
+                node.right, ctx, tainted
+            )
+        if isinstance(node, ast.IfExp):
+            return self._wall(node.body, ctx, tainted) or self._wall(
+                node.orelse, ctx, tainted
+            )
+        return False
+
+    @staticmethod
+    def _deadliney(target: ast.AST) -> bool:
+        if isinstance(target, ast.Name):
+            return bool(_DEADLINE_NAME.search(target.id))
+        if isinstance(target, ast.Attribute):
+            return bool(_DEADLINE_NAME.search(target.attr))
+        return False

@@ -16,7 +16,10 @@ from .errors import (  # noqa: F401
     PreemptedError,
     RankFailedError,
     RendezvousTimeoutError,
+    RequestTimeoutError,
     SchedulerSaturatedError,
+    ServeOverloadError,
+    ServingStoppedError,
     SolverDivergedError,
     SrmlError,
 )
@@ -66,6 +69,9 @@ __all__ = [
     "NumericsError",
     "PreemptedError",
     "SchedulerSaturatedError",
+    "RequestTimeoutError",
+    "ServeOverloadError",
+    "ServingStoppedError",
     "device_dataset_scope",
     "FitScheduler",
     "ops_plane",

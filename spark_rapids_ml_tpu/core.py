@@ -160,6 +160,40 @@ config: Dict[str, Any] = {
     # compiled (through the persistent compile cache) AT LOAD TIME, so a
     # resident model's first query is compile-free; 0 disables prewarm
     "serve_prewarm_rows": 4096,
+    # --- serving overload control (docs/serving.md "Overload &
+    # backpressure") ------------------------------------------------------
+    # server-side deadline applied to every submit() that does not pass its
+    # own deadline_ms: an expired request NEVER dispatches (typed
+    # RequestTimeoutError), and admission refuses a request whose deadline
+    # the live queue-wait p99 predicts unmeetable (typed ServeOverloadError).
+    # Monotonic-clock only. 0 disables the default deadline.
+    "serve_default_deadline_ms": 30000.0,
+    # bounded request queue: total rows queued in the ScoringEngine at most;
+    # a submit that would exceed it is refused at admission instead of
+    # growing an unbounded backlog
+    "serve_max_queue_rows": 262144,
+    # adaptive micro-batching: when True the coalesce window/row target
+    # self-tune from the windowed arrival rate and queue-wait p99 (bounded
+    # by the floor/ceiling below) — saturation grows batches instead of
+    # queues. Uncongested traffic (queue-wait p99 at or under the static
+    # window) behaves exactly like the static window, and
+    # serve_coalesce_window_ms=0 still disables coalescing entirely.
+    "serve_adaptive_batching": True,
+    "serve_coalesce_window_floor_ms": 0.5,
+    "serve_coalesce_window_ceiling_ms": 20.0,
+    # backpressure ladder hysteresis: minimum dwell (seconds) between a
+    # tenant's ladder transitions (throttle -> degrade -> shed and every
+    # restore step), so a burn flap cannot flap the ladder
+    "serve_overload_hold_s": 30.0,
+    # per-tenant token-bucket rate while a tenant is at the throttle rung,
+    # in rows/second; 0 = auto (half the tenant's recent admitted row rate)
+    "serve_throttle_rows_per_s": 0.0,
+    # opt-in degraded serving rung: a serve dtype (e.g. "bf16") the registry
+    # builds as a SECOND resident program (its bytes honestly admitted
+    # against the HBM budget) for models whose `_serve_dtypes` allow it —
+    # the backpressure ladder routes a burning tenant's traffic there before
+    # shedding. None disables the rung (the ladder skips degrade).
+    "serve_degraded_dtype": None,
     # --- distributed diagnostics (docs/observability.md) -----------------
     # directory for flight-recorder dumps (`flightrec_rank_<r>.jsonl`) on
     # SrmlError / abort publication; seeded from SRML_FLIGHTREC_DIR. None ->

@@ -45,15 +45,32 @@
 #   │                            is the always-on convergence guard on
 #   │                            scalars the solver fetches anyway; this is
 #   │                            the opt-in sweep of everything else
-#   └── SchedulerSaturatedError  permanent — a submitted job's SMALLEST
-#                                possible footprint (the streaming floor, or
-#                                the resident estimate when the estimator
-#                                has no out-of-core path) exceeds the whole
-#                                HBM budget: no amount of queueing or
-#                                preemption can ever place it. Mirrors
-#                                `HbmBudgetError`: carries the estimate, the
-#                                budget, and the largest term so the refusal
-#                                names WHAT doesn't fit
+#   ├── SchedulerSaturatedError  permanent — a submitted job's SMALLEST
+#   │                            possible footprint (the streaming floor, or
+#   │                            the resident estimate when the estimator
+#   │                            has no out-of-core path) exceeds the whole
+#   │                            HBM budget: no amount of queueing or
+#   │                            preemption can ever place it. Mirrors
+#   │                            `HbmBudgetError`: carries the estimate, the
+#   │                            budget, and the largest term so the refusal
+#   │                            names WHAT doesn't fit
+#   ├── RequestTimeoutError      permanent — a scoring request's server-side
+#   │                            deadline (`submit(deadline_ms=)`) elapsed
+#   │                            before dispatch; the request never touched
+#   │                            the device. Carries the deadline, how long
+#   │                            it waited, and the queue state at failure
+#   ├── ServeOverloadError       permanent (for THIS request) — serving
+#   │                            admission refused the request: queue bound
+#   │                            hit, predicted queue wait exceeds the
+#   │                            deadline, or the tenant's backpressure
+#   │                            ladder is throttling/shedding. Carries the
+#   │                            evidence (queue depth/rows, predicted wait,
+#   │                            deadline, ladder level) so the refusal
+#   │                            names WHY; callers retry with backoff
+#   └── ServingStoppedError      permanent — the scoring engine stopped
+#                                before a queued request dispatched; carries
+#                                the model name and the request's queue
+#                                position at shutdown
 #
 # Multiple inheritance keeps old call sites working: RendezvousTimeoutError
 # IS-A TimeoutError (FileRendezvous raised bare TimeoutError before),
@@ -73,6 +90,9 @@ __all__ = [
     "NumericsError",
     "PreemptedError",
     "SchedulerSaturatedError",
+    "RequestTimeoutError",
+    "ServeOverloadError",
+    "ServingStoppedError",
     "is_transient",
 ]
 
@@ -372,6 +392,132 @@ class SchedulerSaturatedError(SrmlError, MemoryError):
                 )
             )
         super().__init__(" ".join(parts))
+
+
+class RequestTimeoutError(SrmlError, TimeoutError):
+    """A scoring request's server-side deadline elapsed before dispatch
+    (``ScoringEngine.submit(deadline_ms=)``, default
+    ``config["serve_default_deadline_ms"]``; monotonic-clock only,
+    docs/serving.md "Overload & backpressure").
+
+    The request NEVER touched the device: expired requests are dropped at
+    the head of the queue or filtered out of a coalesced group before
+    dispatch, so a caller whose client already gave up does not burn device
+    time. PERMANENT for this request — resubmit with a larger deadline or
+    at lower load. Distinguish from the bare ``TimeoutError`` that
+    ``ScoreFuture.result(timeout)`` raises: that is the CLIENT giving up
+    while the request may still dispatch; this is the SERVER refusing to
+    dispatch stale work."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        model: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        waited_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        queue_rows: Optional[int] = None,
+    ):
+        # attributes BEFORE super().__init__: the flight-recorder hook fires
+        # inside it and records whatever diagnostic fields are already set
+        self.model = model
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.waited_ms = None if waited_ms is None else float(waited_ms)
+        self.queue_depth = None if queue_depth is None else int(queue_depth)
+        self.queue_rows = None if queue_rows is None else int(queue_rows)
+        parts = [message]
+        if deadline_ms is not None:
+            w = (
+                f" after waiting {self.waited_ms:.1f}ms"
+                if waited_ms is not None
+                else ""
+            )
+            parts.append(f"(deadline {self.deadline_ms:.1f}ms elapsed{w})")
+        if queue_depth is not None:
+            parts.append(
+                f"[queue: {self.queue_depth} requests"
+                + (
+                    f", {self.queue_rows} rows]"
+                    if queue_rows is not None
+                    else "]"
+                )
+            )
+        super().__init__(" ".join(parts))
+
+
+class ServeOverloadError(SrmlError, RuntimeError):
+    """Serving admission refused this request (docs/serving.md "Overload &
+    backpressure"): the bounded queue is full
+    (``config["serve_max_queue_rows"]``), the live windowed queue-wait p99
+    predicts the deadline cannot be met, or the tenant's backpressure
+    ladder is throttling (token bucket empty) or shedding (sustained SLO
+    burn). PERMANENT for this request, by design cheap and synchronous at
+    ``submit()`` — the closed loop's refusal, raised BEFORE any queueing so
+    callers can back off while the evidence (queue depth, predicted wait,
+    deadline, ladder level) names why."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        model: Optional[str] = None,
+        tenant: Optional[str] = None,
+        level: Optional[str] = None,
+        queue_depth: Optional[int] = None,
+        queue_rows: Optional[int] = None,
+        predicted_wait_ms: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
+    ):
+        # attributes BEFORE super().__init__ (flight-recorder contract above)
+        self.model = model
+        self.tenant = tenant
+        self.level = level
+        self.queue_depth = None if queue_depth is None else int(queue_depth)
+        self.queue_rows = None if queue_rows is None else int(queue_rows)
+        self.predicted_wait_ms = (
+            None if predicted_wait_ms is None else float(predicted_wait_ms)
+        )
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        parts = [message]
+        if predicted_wait_ms is not None and deadline_ms is not None:
+            parts.append(
+                f"(predicted wait {self.predicted_wait_ms:.1f}ms against a "
+                f"{self.deadline_ms:.1f}ms deadline)"
+            )
+        if queue_depth is not None or queue_rows is not None:
+            parts.append(
+                f"[queue: {self.queue_depth or 0} requests, "
+                f"{self.queue_rows or 0} rows]"
+            )
+        if level is not None:
+            parts.append(f"[tenant {tenant!r} at ladder level {level!r}]")
+        super().__init__(" ".join(parts))
+
+
+class ServingStoppedError(SrmlError, RuntimeError):
+    """The scoring engine stopped before this queued request dispatched
+    (``ScoringEngine.stop()`` drain deadline elapsed, or the engine was
+    never going to run it). Carries the model name and the request's
+    position in the queue at shutdown, so a caller distinguishing "my
+    request was slow" from "the service went away under me" has the
+    evidence in the exception, not in a log."""
+
+    def __init__(self, model: str, *, queue_position: Optional[int] = None):
+        # attributes BEFORE super().__init__ (flight-recorder contract above)
+        self.model = model
+        self.queue_position = (
+            None if queue_position is None else int(queue_position)
+        )
+        at = (
+            f" (queue position {self.queue_position})"
+            if queue_position is not None
+            else ""
+        )
+        super().__init__(
+            f"scoring engine stopped before request for model {model!r} "
+            f"dispatched{at}"
+        )
 
 
 def is_transient(exc: BaseException) -> bool:

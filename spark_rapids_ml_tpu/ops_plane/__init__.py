@@ -52,6 +52,18 @@ __all__ = [
 ]
 
 
+def _serving_section() -> Dict[str, Any]:
+    """The serving plane's per-tenant overload view (backpressure ladder
+    levels, refusal counters, tenant latency summaries) — every live
+    ScoringEngine's controller, via `serving.overload.serving_report`."""
+    try:
+        from ..serving.overload import serving_report
+
+        return serving_report()
+    except Exception:  # pragma: no cover - the report never fails a scrape
+        return {"tenants": {}}
+
+
 def report(
     *,
     tenant: Optional[str] = None,
@@ -79,6 +91,7 @@ def report(
         "decision_log": audit.stats(),
         "tenants": global_ledger().tenant_usage(),
         "drift": drift.last_stats(),
+        "serving": _serving_section(),
         "efficiency": efficiency.summary(),
         "autotune": {**_autotune.stats(), "table_path": _autotune.table_path()},
         "telemetry": reg.snapshot(),

@@ -44,7 +44,15 @@ from typing import Any, Dict, List, Optional
 
 from ..utils import lockcheck
 
-__all__ = ["evaluate", "maybe_evaluate", "health", "last_verdicts", "reset"]
+__all__ = [
+    "evaluate",
+    "maybe_evaluate",
+    "health",
+    "last_verdicts",
+    "reset",
+    "burn_rate",
+    "serving_latency_spec",
+]
 
 DEFAULT_FAST_WINDOW_S = 60.0
 DEFAULT_SLOW_WINDOW_S = 3600.0
@@ -199,6 +207,52 @@ def maybe_evaluate() -> None:
         evaluate(force=False)
     except Exception:  # pragma: no cover - monitors never fail the hot path
         pass
+
+
+def burn_rate(
+    histogram: str,
+    *,
+    threshold_s: float,
+    objective: float,
+    window_s: Optional[float] = None,
+) -> Optional[float]:
+    """Point burn rate of ONE latency surface over ONE window: the observed
+    fraction of samples over `threshold_s` divided by the error budget
+    (1 - objective). None when the window holds no samples (no traffic is
+    not a burn — the same vacuous-health rule `evaluate` applies).
+
+    The public seam the serving backpressure ladder uses to compute
+    PER-TENANT burn from the per-tenant histogram siblings
+    (``telemetry.tenant_metric("serve.e2e_s", tenant)``) of a configured
+    spec's surface — same arithmetic as `_eval_one`'s fast/slow burns, one
+    window at a time."""
+    from .. import telemetry
+
+    reg = telemetry.registry()
+    w = min(
+        float(window_s) if window_s is not None else DEFAULT_FAST_WINDOW_S,
+        reg.window_horizon_s(),
+    )
+    got = reg.window_fraction_over(histogram, float(threshold_s), w)
+    if got is None:
+        return None
+    frac, _count = got
+    budget = 1.0 - float(objective)
+    return frac / budget if budget > 0 else (float("inf") if frac else 0.0)
+
+
+def serving_latency_spec() -> Optional[Dict[str, Any]]:
+    """The first configured latency SLO spec over a serving histogram
+    (``serve.*``) — the objective the backpressure ladder closes its loop
+    on. None when no such spec is configured (the ladder stays inert;
+    deadlines and the queue bound do not need a spec)."""
+    for spec in _specs():
+        if (
+            str(spec.get("kind", "")) == "latency"
+            and str(spec.get("histogram", "")).startswith("serve.")
+        ):
+            return dict(spec)
+    return None
 
 
 def last_verdicts() -> List[Dict[str, Any]]:

@@ -27,6 +27,15 @@
 #                                  the fit-entry demotion ladder (RESIDENT ->
 #                                  STREAM -> HbmBudgetError) testable without a
 #                                  real TPU
+#   burst:stage=serve:rows=4096:seconds=2   offered-load burst: the harness
+#                                  driving the stage (the serving saturation
+#                                  bench/tests) consults `maybe_burst_stage`
+#                                  and, when an un-spent entry matches, ramps
+#                                  offered load to `rows` rows/s for
+#                                  `seconds` — the overload ladder's
+#                                  healthy -> shed -> recover scenario
+#                                  testable on CPU CI (docs/serving.md
+#                                  "Overload & backpressure")
 #   oom:stage=solve:round=2        simulated ALLOCATION FAILURE: raise a
 #                                  RESOURCE_EXHAUSTED-shaped RuntimeError at
 #                                  the named stage — `placement` fires before
@@ -65,12 +74,13 @@ __all__ = [
     "active_plan",
     "maybe_fail_stage",
     "maybe_delay_stage",
+    "maybe_burst_stage",
     "maybe_fail_oom",
     "injected_hbm_budget",
     "ChaosRendezvous",
 ]
 
-_KINDS = {"kill", "abort", "delay", "drop", "fail", "oom"}
+_KINDS = {"kill", "abort", "delay", "drop", "fail", "oom", "burst"}
 
 
 @dataclass
@@ -91,6 +101,9 @@ class Fault:
     # `oom` faults: injected per-device HBM budget in bytes (0 = this entry is
     # a simulated allocation failure at stage/round instead)
     budget: int = 0
+    # `burst` faults: offered-load ramp in rows/second the consulting harness
+    # drives at the named stage for `seconds`
+    rows: int = 0
     fired: int = field(default=0)
 
     def spent(self) -> bool:
@@ -133,6 +146,8 @@ def parse_fault_plan(spec: str) -> List[Fault]:
                 fault.respawn = int(v)
             elif k == "budget":
                 fault.budget = int(v)
+            elif k == "rows":
+                fault.rows = int(v)
             else:
                 raise ValueError(f"unknown fault field {k!r} in plan entry {entry!r}")
         if fault.kind == "fail":
@@ -142,6 +157,16 @@ def parse_fault_plan(spec: str) -> List[Fault]:
             if fault.budget <= 0 and fault.stage is None:
                 raise ValueError(
                     f"oom fault needs budget=<bytes> or stage=<name>: {entry!r}"
+                )
+        elif fault.kind == "burst":
+            # offered-load burst at an instrumented stage (the serving
+            # saturation scenario): all three fields are load-shape, so all
+            # three are required — a burst with no rows or no duration is a
+            # typo, not a plan
+            if fault.stage is None or fault.rows <= 0 or fault.seconds <= 0:
+                raise ValueError(
+                    f"burst fault needs stage=<name>, rows=<rows/s> and "
+                    f"seconds=<s>: {entry!r}"
                 )
         elif fault.kind == "delay" and fault.stage is not None:
             # stage-scoped latency injection (`delay:stage=serve:seconds=`):
@@ -264,6 +289,34 @@ def maybe_delay_stage(stage: str) -> None:
         time.sleep(f.seconds)  # sleep-ok: plan-bounded injected stage delay
 
 
+def maybe_burst_stage(stage: str) -> Optional[Fault]:
+    """Offered-load burst injection: an un-spent `burst:stage=<s>` fault
+    matching `stage` is consumed (one firing) and returned — the consulting
+    harness (the serving saturation bench/tests) then ramps offered load to
+    `fault.rows` rows/second for `fault.seconds`. None when no entry
+    matches. Unlike the other stage hooks this one injects nothing itself:
+    the BURST is caller-generated traffic, so the fault entry is the load
+    shape, and the chaos plan stays the single place a scenario's faults
+    are declared (docs/serving.md "Overload & backpressure")."""
+    from .. import diagnostics
+
+    for f in active_plan():
+        if (
+            f.kind != "burst"
+            or f.stage != stage
+            or f.spent()
+            or not _rank_matches(f)
+        ):
+            continue
+        f.fired += 1
+        diagnostics.record_event(
+            "chaos_injection", fault="burst", stage=stage,
+            rows=f.rows, seconds=f.seconds,
+        )
+        return f
+    return None
+
+
 def maybe_fail_stage(stage: str, attempt: int) -> None:
     """Hook consulted by `core.retryable_stage` at the start of every attempt:
     a matching un-spent `fail` fault raises a transient RendezvousTimeoutError
@@ -309,7 +362,7 @@ class ChaosRendezvous(Rendezvous):
             # same round of the recovery attempt — a second loss that
             # exhausts the budget (found by the kill-at-every-round sweep).
             if (
-                f.kind in ("fail", "oom")  # stage/budget hooks, not rdv rounds
+                f.kind in ("fail", "oom", "burst")  # stage/budget hooks, not rdv rounds
                 or f.spent()
                 or f.rank != self.orig_rank
                 or f.round != round_index

@@ -647,7 +647,7 @@ class FileRendezvous(Rendezvous):
                 for entry in [dirpath] + [os.path.join(dirpath, f) for f in filenames]:
                     with contextlib.suppress(OSError):
                         newest = max(newest, os.path.getmtime(entry))
-            if now - newest > bound:
+            if now - newest > bound:  # wallclock-ok: compared against file mtimes, which are wall-clock — monotonic would be the wrong clock here
                 shutil.rmtree(tree, ignore_errors=True)
 
     # -- file layout -------------------------------------------------------

@@ -24,6 +24,7 @@
 # outside the engine's one response-assembly point (`# serve-ok: <reason>`).
 #
 from .engine import ScoreFuture, ScoringEngine  # noqa: F401
+from .overload import OverloadController  # noqa: F401
 from .registry import ModelRegistry, ResidentModel  # noqa: F401
 
 __all__ = [
@@ -31,4 +32,5 @@ __all__ = [
     "ResidentModel",
     "ScoringEngine",
     "ScoreFuture",
+    "OverloadController",
 ]

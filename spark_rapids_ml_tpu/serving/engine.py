@@ -17,7 +17,17 @@
 #
 # Telemetry (docs/observability.md "Serving plane"): serve.requests/rows/
 # batches, serve.coalesced_batches/coalesced_requests, serve.bucket_hits,
-# and the serve.queue_wait_s / serve.e2e_s latency histograms.
+# and the serve.queue_wait_s / serve.e2e_s latency histograms (plus their
+# per-tenant siblings via `telemetry.tenant_metric`).
+#
+# Overload control (docs/serving.md "Overload & backpressure"): every
+# request carries a server-side monotonic deadline (submit(deadline_ms=),
+# default `config["serve_default_deadline_ms"]`) — expired requests NEVER
+# dispatch (typed RequestTimeoutError) — and admission is the closed loop's
+# refusal point: the bounded queue, the deadline-feasibility check against
+# the live queue-wait p99, and the per-tenant backpressure ladder all live
+# in `serving.overload.OverloadController` and raise typed
+# ServeOverloadError BEFORE the request queues.
 #
 # The async contract is CI-enforced (ci/analysis `serve-dispatch`): no
 # direct jit/block_until_ready in this package outside the waived assembly
@@ -33,22 +43,45 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import telemetry
+from ..errors import RequestTimeoutError, ServingStoppedError
 from ..utils import get_logger, lockcheck, numcheck
+from .overload import OverloadController, plan_target_rows, plan_window
 from .registry import ModelRegistry
 
 
 class ScoreFuture:
     """Handle for one in-flight scoring request."""
 
-    __slots__ = ("name", "features", "_event", "_result", "_error", "t_submit")
+    __slots__ = (
+        "name", "features", "_event", "_result", "_error", "t_submit",
+        "t_done", "rows", "tenant", "deadline", "degraded",
+    )
 
-    def __init__(self, name: str, features: np.ndarray, t_submit: float) -> None:
+    def __init__(
+        self,
+        name: str,
+        features: np.ndarray,
+        t_submit: float,
+        *,
+        tenant: str = "default",
+        deadline: Optional[float] = None,
+        degraded: bool = False,
+    ) -> None:
         self.name = name
         self.features = features
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
         self.t_submit = t_submit
+        self.t_done: Optional[float] = None  # set at resolution
+        self.rows = int(features.shape[0])
+        self.tenant = tenant
+        # server-side deadline, ABSOLUTE monotonic seconds (None = no
+        # deadline): the engine refuses to dispatch past it
+        self.deadline = deadline
+        # the backpressure ladder routed this request to the degraded
+        # (serve_degraded_dtype) resident program
+        self.degraded = degraded
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -57,7 +90,16 @@ class ScoreFuture:
         """Block until the response is assembled. Returns the per-algo predict
         output for THIS request's rows (array, or tuple of arrays for
         multi-output models). Raises the scoring error if the dispatch
-        failed, TimeoutError if the deadline elapses first."""
+        failed, bare TimeoutError if `timeout` elapses first.
+
+        A client timeout here does NOT cancel the request: it stays queued
+        (or in flight) server-side and still resolves this future when it
+        completes — only the SERVER-side deadline (``submit(deadline_ms=)``,
+        default ``config["serve_default_deadline_ms"]``) stops undispatched
+        work, failing the future with the typed `RequestTimeoutError`
+        instead. A caller that gives up should therefore pass a matching
+        ``deadline_ms`` at submit so its abandoned request cannot burn
+        device time (docs/serving.md "Overload & backpressure")."""
         if not self._event.wait(timeout):
             raise TimeoutError(
                 f"scoring request for model {self.name!r} did not complete "
@@ -70,6 +112,9 @@ class ScoreFuture:
     def _resolve(self, result: Any = None, error: Optional[BaseException] = None) -> None:
         self._result = result
         self._error = error
+        # monotonic resolution time: harnesses reading a future AFTER the
+        # fact (the saturation lane's drain) still see the true e2e
+        self.t_done = time.monotonic()
         self._event.set()
 
 
@@ -95,15 +140,21 @@ class ScoringEngine:
         from ..core import config
 
         self.registry = registry
+        # an EXPLICIT constructor window is a static override: the adaptive
+        # planner never touches it (docs/serving.md "Adaptive batching")
+        self._window_overridden = coalesce_window_s is not None
         if coalesce_window_s is None:
             coalesce_window_s = float(config.get("serve_coalesce_window_ms", 2.0)) / 1e3
         self._window_s = max(0.0, float(coalesce_window_s))
         self._max_rows = int(max_batch_rows or config.get("serve_max_batch_rows", 8192))
         self._cond = lockcheck.make_condition("serving.engine.ScoringEngine._cond")
         self._queue: "deque[ScoreFuture]" = deque()
+        self._queued_rows = 0  # guarded-by: _cond
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._logger = get_logger(type(self))
+        # deadline admission + the per-tenant backpressure ladder
+        self._overload = OverloadController()
         # runtime numerics sanitizer (SRML_NUMCHECK=1): resolved once per
         # engine; disabled = a None attribute, one test per dispatch group
         self._numcheck = numcheck.hook()
@@ -127,7 +178,10 @@ class ScoringEngine:
 
     def stop(self, timeout: float = 30.0) -> None:
         """Drain the queue, then stop the worker. Requests still queued when
-        the drain deadline elapses fail with RuntimeError."""
+        the drain deadline elapses fail with the typed `ServingStoppedError`
+        (carrying the model name and the request's queue position at
+        shutdown), so callers can tell "service went away" from a scoring
+        failure."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
@@ -135,10 +189,15 @@ class ScoringEngine:
         if t is not None:
             t.join(timeout)
         with self._cond:
+            position = 0
             while self._queue:
-                self._queue.popleft()._resolve(
-                    error=RuntimeError("scoring engine stopped before dispatch")
+                fut = self._queue.popleft()
+                self._queued_rows -= fut.rows
+                fut._resolve(
+                    error=ServingStoppedError(fut.name, queue_position=position)
                 )
+                position += 1
+            self._queued_rows = 0
             self._thread = None
 
     def __enter__(self) -> "ScoringEngine":
@@ -148,10 +207,28 @@ class ScoringEngine:
         self.stop()
 
     # ------------------------------------------------------------ requests --
-    def submit(self, name: str, features: Any) -> ScoreFuture:
+    def submit(
+        self,
+        name: str,
+        features: Any,
+        *,
+        deadline_ms: Optional[float] = None,
+        tenant: str = "default",
+    ) -> ScoreFuture:
         """Enqueue one scoring request against resident model `name`.
         Validates residency and feature width AT SUBMIT so the caller gets
-        the error synchronously, not inside a future."""
+        the error synchronously, not inside a future.
+
+        `deadline_ms` is the SERVER-side deadline (monotonic clock, default
+        ``config["serve_default_deadline_ms"]``; <= 0 disables): the engine
+        never dispatches an expired request (typed `RequestTimeoutError` on
+        the future), and admission refuses synchronously — typed
+        `ServeOverloadError` — when the bounded queue is full, the live
+        queue-wait p99 predicts the deadline cannot be met, or `tenant`'s
+        backpressure ladder is throttling/shedding (docs/serving.md
+        "Overload & backpressure")."""
+        from ..core import config
+
         entry = self.registry.get(name)  # KeyError for unknown/evicted models
         feats = np.asarray(features)
         if hasattr(features, "todense"):
@@ -166,11 +243,39 @@ class ScoringEngine:
                 f"model {name!r} expects {entry.n_cols} features; got "
                 f"{feats.shape[1]}"
             )
-        fut = ScoreFuture(name, feats, time.monotonic())
+        now = time.monotonic()
+        if deadline_ms is None:
+            deadline_ms = float(config.get("serve_default_deadline_ms", 30000.0))
+        deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
+        # the ladder must ALSO advance on the admission path: a fully-shed
+        # tenant generates no dispatches, so without this hook its burn
+        # would never be re-read and a shed would be permanent (throttled
+        # to one pass per metrics bucket, same as the dispatch-path hook)
+        self._overload.maybe_evaluate(now)
+        # admission: the typed refusal point (queue bound, deadline
+        # feasibility, the tenant's ladder) — BEFORE anything queues. The
+        # depth/rows snapshot is taken under the lock, then admission runs
+        # outside it (admit touches the controller's own lock and telemetry).
+        with self._cond:
+            q_depth, q_rows = len(self._queue), self._queued_rows
+        degraded = self._overload.admit(
+            model=name, tenant=tenant, rows=int(feats.shape[0]),
+            deadline_s=deadline, now=now,
+            queue_depth=q_depth, queue_rows=q_rows,
+        )
+        fut = ScoreFuture(
+            name, feats, now, tenant=tenant, deadline=deadline,
+            degraded=degraded and entry.degraded_program is not None,
+        )
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("scoring engine is not running (call start())")
             self._queue.append(fut)
+            self._queued_rows += fut.rows
+            if telemetry.enabled():
+                reg = telemetry.registry()
+                reg.gauge("serve.queue_depth", float(len(self._queue)))
+                reg.gauge("serve.queue_rows", float(self._queued_rows))
             self._cond.notify_all()
         return fut
 
@@ -182,14 +287,45 @@ class ScoringEngine:
         """Latency-centric view of the serve.* telemetry (p50/p99 via
         `telemetry.summarize_histogram` — the ONE shared extraction, also
         behind `FitScheduler.stats`; None while telemetry is off or nothing
-        has been served)."""
+        has been served), plus the live queue depth, the overload counters,
+        and the per-tenant view: each tenant's queue-wait/e2e p50/p99 (the
+        `telemetry.tenant_metric` histogram siblings) and its backpressure
+        ladder state."""
         qw = telemetry.summarize_histogram("serve.queue_wait_s")
         e2e = telemetry.summarize_histogram("serve.e2e_s")
+        counters: Dict[str, float] = {}
+        if telemetry.enabled():
+            counters = telemetry.registry().snapshot()["counters"]
+        with self._cond:
+            q_depth, q_rows = len(self._queue), self._queued_rows
+        tenants: Dict[str, Any] = {}
+        for tenant, view in self._overload.stats().items():
+            tqw = telemetry.summarize_histogram(
+                telemetry.tenant_metric("serve.queue_wait_s", tenant)
+            )
+            te2e = telemetry.summarize_histogram(
+                telemetry.tenant_metric("serve.e2e_s", tenant)
+            )
+            tenants[tenant] = {
+                **view,
+                "queue_wait_p50_s": tqw["p50"],
+                "queue_wait_p99_s": tqw["p99"],
+                "e2e_p50_s": te2e["p50"],
+                "e2e_p99_s": te2e["p99"],
+            }
         return {
             "queue_wait_p50_s": qw["p50"],
             "queue_wait_p99_s": qw["p99"],
             "e2e_p50_s": e2e["p50"],
             "e2e_p99_s": e2e["p99"],
+            "queue_depth": q_depth,
+            "queue_rows": q_rows,
+            "expired_requests": int(counters.get("serve.expired_requests", 0)),
+            "rejected_requests": int(counters.get("serve.rejected_requests", 0)),
+            "shed_requests": int(counters.get("serve.shed_requests", 0)),
+            "throttled_requests": int(counters.get("serve.throttled_requests", 0)),
+            "degraded_requests": int(counters.get("serve.degraded_requests", 0)),
+            "tenants": tenants,
         }
 
     # -------------------------------------------------------------- worker --
@@ -203,28 +339,101 @@ class ScoringEngine:
                         return
                     continue
                 first = self._queue.popleft()
+                self._queued_rows -= first.rows
+            # the deadline contract: an expired request NEVER dispatches —
+            # it fails fast here (typed), before any coalescing or device work
+            if first.deadline is not None and time.monotonic() > first.deadline:
+                self._expire(first)
+                continue
             group = self._coalesce(first)
             self._dispatch_group(group)
 
+    def _expire(self, fut: ScoreFuture) -> None:
+        """Fail one expired request with the typed `RequestTimeoutError`
+        (counter: serve.expired_requests). The request never touched the
+        device — this IS the fail-fast path."""
+        now = time.monotonic()
+        if telemetry.enabled():
+            telemetry.registry().inc("serve.expired_requests")
+        with self._cond:
+            q_depth, q_rows = len(self._queue), self._queued_rows
+        fut._resolve(
+            error=RequestTimeoutError(
+                f"scoring request for model {fut.name!r} expired before "
+                "dispatch",
+                model=fut.name,
+                deadline_ms=(fut.deadline - fut.t_submit) * 1e3,
+                waited_ms=(now - fut.t_submit) * 1e3,
+                queue_depth=q_depth,
+                queue_rows=q_rows,
+            )
+        )
+
+    def _plan_batch(self) -> tuple:
+        """The micro-batch plan for the NEXT coalesce: (window_s,
+        target_rows). Static (`serve_adaptive_batching` off, or an explicit
+        constructor window) returns the configured window and the row cap;
+        adaptive delegates to the pure planners in `serving.overload`,
+        feeding them the windowed arrival rate and queue-wait p99 —
+        uncongested traffic still gets EXACTLY the static values."""
+        from ..core import config
+
+        base = self._window_s
+        if (
+            self._window_overridden
+            or not bool(config.get("serve_adaptive_batching", True))
+            or not telemetry.enabled()
+        ):
+            return base, self._max_rows
+        reg = telemetry.registry()
+        fast_w = reg.bucket_seconds() * 3.0
+        rate = reg.rate("serve.rows", fast_w)
+        p99 = reg.window_quantile("serve.queue_wait_s", 0.99, fast_w)
+        with self._cond:
+            q_rows = self._queued_rows
+        window_s = plan_window(
+            base,
+            floor_s=float(config.get("serve_coalesce_window_floor_ms", 0.5)) / 1e3,
+            ceiling_s=float(config.get("serve_coalesce_window_ceiling_ms", 20.0)) / 1e3,
+            arrival_rows_per_s=rate,
+            queue_rows=q_rows,
+            queue_wait_p99_s=p99,
+            max_rows=self._max_rows,
+        )
+        target_rows = plan_target_rows(
+            min_rows=int(config.get("transform_bucket_min_rows", 8)),
+            max_rows=self._max_rows,
+            queue_rows=q_rows,
+            arrival_rows_per_s=rate,
+            window_s=window_s,
+            congested=bool(p99 is not None and base > 0.0 and p99 > base),
+        )
+        reg.gauge("serve.adaptive_window_ms", window_s * 1e3)
+        return window_s, target_rows
+
     def _coalesce(self, first: ScoreFuture) -> List[ScoreFuture]:
-        """Grow a micro-batch from `first`: same-model requests already
-        queued (or arriving inside the bounded coalesce window) merge until
-        the batch reaches the row cap. Other models' requests stay queued
-        in order for the next batch. A zero window disables coalescing
-        entirely (pure latency mode, docs/serving.md) — even already-queued
-        same-model requests dispatch solo."""
-        if self._window_s <= 0.0:
+        """Grow a micro-batch from `first`: same-model (and same
+        degraded-rung) requests already queued (or arriving inside the
+        coalesce window) merge until the batch reaches the row target.
+        Other models' requests stay queued in order for the next batch. A
+        zero window disables coalescing entirely (pure latency mode,
+        docs/serving.md) — even already-queued same-model requests dispatch
+        solo. The window and target come from `_plan_batch` (adaptive under
+        congestion, static otherwise)."""
+        window_s, target_rows = self._plan_batch()
+        if window_s <= 0.0:
             return [first]
         group = [first]
         rows = int(first.features.shape[0])
-        deadline = time.monotonic() + self._window_s
-        while rows < self._max_rows:
+        deadline = time.monotonic() + window_s
+        while rows < target_rows:
             with self._cond:
                 took = None
                 for i, fut in enumerate(self._queue):
-                    if fut.name == first.name:
+                    if fut.name == first.name and fut.degraded == first.degraded:
                         took = fut
                         del self._queue[i]
+                        self._queued_rows -= fut.rows
                         break
                 if took is None:
                     remaining = deadline - time.monotonic()
@@ -247,14 +456,46 @@ class ScoringEngine:
         maybe_delay_stage("serve")
         t0 = time.monotonic()
         reg = telemetry.registry() if telemetry.enabled() else None
+        # members whose deadline passed while the batch formed (or during an
+        # injected delay) fail typed HERE, before any device work — the
+        # zero-over-deadline-dispatches invariant the saturation lane gates
+        live: List[ScoreFuture] = []
+        for fut in group:
+            if fut.deadline is not None and t0 > fut.deadline:
+                self._expire(fut)
+            else:
+                live.append(fut)
+        group = live
+        if not group:
+            return
         if reg is not None:
+            # tripwire, expected to stay 0 forever: a request past its
+            # deadline reaching THIS point means the filter above regressed.
+            # Measured at t0 — the same instant the filter decided at — so a
+            # deadline expiring DURING this bookkeeping can't false-trip it
+            late = sum(
+                1 for f in group
+                if f.deadline is not None and t0 > f.deadline
+            )
+            if late:
+                reg.inc("serve.overdeadline_dispatches", late)
             reg.inc("serve.requests", len(group))
             reg.inc("serve.batches")
             if len(group) > 1:
                 reg.inc("serve.coalesced_batches")
                 reg.inc("serve.coalesced_requests", len(group))
+            if group[0].degraded:
+                reg.inc("serve.degraded_requests", len(group))
+                reg.inc(
+                    "serve.degraded_rows", sum(f.rows for f in group)
+                )
             for fut in group:
-                reg.observe("serve.queue_wait_s", t0 - fut.t_submit)
+                wait = t0 - fut.t_submit
+                reg.observe("serve.queue_wait_s", wait)
+                reg.observe(
+                    telemetry.tenant_metric("serve.queue_wait_s", fut.tenant),
+                    wait,
+                )
         try:
             # one efficiency attribution window per dispatch group, keyed to
             # the per-model serving tenant ("serving:<name>") so the split
@@ -263,7 +504,15 @@ class ScoringEngine:
                 "serve_dispatch", tenant=f"serving:{group[0].name}"
             ):
                 entry = self.registry.get(group[0].name)  # use-touch: keeps it MRU
-                program = entry.program
+                # the degrade rung: the ladder routed this group to the
+                # registry's serve_degraded_dtype sibling program; a rung
+                # evicted mid-flight falls back to the primary (degrade is
+                # an optimization, never a failure)
+                program = (
+                    entry.degraded_program
+                    if group[0].degraded and entry.degraded_program is not None
+                    else entry.program
+                )
                 if program is None:
                     # evicted between get() and here (_evict_locked nulls the
                     # program — the entry object may still be in a caller's
@@ -324,8 +573,21 @@ class ScoringEngine:
                 if reg is not None:
                     reg.inc("serve.rows", n)
                     t1 = time.monotonic()
+                    tenant_rows: Dict[str, int] = {}
                     for fut in group:
-                        reg.observe("serve.e2e_s", t1 - fut.t_submit)
+                        e2e = t1 - fut.t_submit
+                        reg.observe("serve.e2e_s", e2e)
+                        reg.observe(
+                            telemetry.tenant_metric("serve.e2e_s", fut.tenant),
+                            e2e,
+                        )
+                        tenant_rows[fut.tenant] = (
+                            tenant_rows.get(fut.tenant, 0) + fut.rows
+                        )
+                    for tenant, t_rows in tenant_rows.items():
+                        reg.inc(
+                            telemetry.tenant_metric("serve.rows", tenant), t_rows
+                        )
         except Exception as e:
             if reg is not None:
                 # the error-rate SLO's numerator, one per failed request
@@ -341,6 +603,9 @@ class ScoringEngine:
         from ..ops_plane import slo as _slo
 
         _slo.maybe_evaluate()
+        # ... and the backpressure ladder's, reading those verdicts plus the
+        # per-tenant burns (same throttling; inert without a serving spec)
+        self._overload.maybe_evaluate()
 
     @staticmethod
     def _resolve_group(

@@ -53,10 +53,20 @@ class ResidentModel:
     n_cols: int = 0
     prewarmed_rungs: int = 0
     stats: Dict[str, float] = field(default_factory=dict)
+    # the opt-in degraded serving rung (config["serve_degraded_dtype"], e.g.
+    # "bf16"): a SECOND resident program the backpressure ladder routes a
+    # burning tenant's traffic to before shedding — its bytes honestly
+    # admitted (degraded_admission) and released with the entry
+    degraded_program: Any = None
+    degraded_admission: Any = None
+    degraded_dtype: Optional[str] = None
 
     @property
     def resident_bytes(self) -> int:
-        return int(self.admission.estimate.total())
+        total = int(self.admission.estimate.total())
+        if self.degraded_admission is not None:
+            total += int(self.degraded_admission.estimate.total())
+        return total
 
 
 class ModelRegistry:
@@ -180,6 +190,35 @@ class ModelRegistry:
                         "resident %r (%s)", name, victim, e,
                     )
                     self._evict_locked(victim, reason=f"pressure from load of {name!r}")
+        # ---- the opt-in degraded rung: a SECOND admission, no eviction ----
+        # pressure (the rung is an optimization — refusing the PRIMARY load
+        # because the degrade copy doesn't fit would be backwards); a
+        # refusal just means the ladder skips degrade -> shed for this model
+        from ..core import config
+
+        degraded_dtype = config.get("serve_degraded_dtype")
+        degraded_adm = None
+        if (
+            degraded_dtype is not None
+            and degraded_dtype != serve_dtype
+            and degraded_dtype in getattr(model, "_serve_dtypes", ())
+        ):
+            try:
+                degraded_adm = memory.admit_model_load(  # ledger-ok: the degrade rung's honest byte claim, released with the entry
+                    model,
+                    resident_bytes=0,
+                    bucket_rows_count=self._cap,
+                    devices=devices,
+                    tenant=f"serving:{name}",
+                )
+            except HbmBudgetError as e:
+                self._logger.warning(
+                    "degraded rung (%s) for %r refused admission, serving "
+                    "without it: %s", degraded_dtype, name, e,
+                )
+                degraded_dtype = None
+        elif degraded_dtype is not None:
+            degraded_dtype = None  # model can't serve it / primary already is
         # ---- placement + prewarm: NO registry lock held ------------------
         # the admission's ledger reservation is already live, so concurrent
         # loads (and fit admissions) see this build's bytes; a failed build
@@ -193,14 +232,26 @@ class ModelRegistry:
                     program = model._serve_program(serve_dtype, cap=self._cap)
                     n_cols = model._serve_n_cols()
                     rungs = 0
+                    degraded_program = None
                     if do_prewarm:
-                        from ..core import config
-
                         max_rows = int(config.get("serve_prewarm_rows", 4096))
                         if max_rows > 0:
                             rungs = program.prewarm(n_cols, max_rows=max_rows)
+                    if degraded_adm is not None:
+                        degraded_program = model._serve_program(
+                            degraded_dtype, cap=self._cap
+                        )
+                        if do_prewarm:
+                            max_rows = int(config.get("serve_prewarm_rows", 4096))
+                            if max_rows > 0:
+                                # the rung prewarns AT LOAD like the primary:
+                                # compiling mid-overload would spend seconds
+                                # exactly when the ladder needs it
+                                degraded_program.prewarm(n_cols, max_rows=max_rows)
         except BaseException:
             memory.release_admission(adm)
+            if degraded_adm is not None:
+                memory.release_admission(degraded_adm)
             raise
         with self._lock:
             if name in self._entries:  # a concurrent load published first
@@ -213,6 +264,9 @@ class ModelRegistry:
                 serve_dtype=serve_dtype,
                 n_cols=n_cols,
                 prewarmed_rungs=rungs,
+                degraded_program=degraded_program,
+                degraded_admission=degraded_adm,
+                degraded_dtype=degraded_dtype if degraded_adm is not None else None,
             )
             self._entries[name] = entry
             model._serve_metrics["admission"] = adm.stamp()
@@ -260,6 +314,9 @@ class ModelRegistry:
         # shared-ledger claim returns with them (docs/scheduling.md)
         memory.release_admission(entry.admission)
         entry.program = None
+        if entry.degraded_admission is not None:
+            memory.release_admission(entry.degraded_admission)
+            entry.degraded_program = None
         if telemetry.enabled():
             reg = telemetry.registry()
             reg.inc("serve.model_evictions")

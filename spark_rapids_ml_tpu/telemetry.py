@@ -40,6 +40,7 @@ import atexit
 import contextlib
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -63,6 +64,7 @@ __all__ = [
     "record_convergence_point",
     "quantile_of",
     "summarize_histogram",
+    "tenant_metric",
 ]
 
 # Span records kept in-process (the JSONL sink receives every record; the
@@ -689,6 +691,20 @@ def summarize_histogram(name: str, *, window_s: Optional[float] = None) -> Dict[
         out["p99"] = reg.window_quantile(name, 0.99, window_s)
         out["window_count"] = reg.window_count(name, window_s)
     return out
+
+
+def tenant_metric(base: str, tenant: str) -> str:
+    """THE per-tenant metric naming contract: ``<base>.<tenant>`` with the
+    tenant sanitized to the metric-name alphabet (every run of characters
+    outside ``[A-Za-z0-9_.:-]`` collapses to one ``_``). The serving plane
+    records per-tenant siblings of its global surfaces
+    (``serve.queue_wait_s.<tenant>``, ``serve.e2e_s.<tenant>``,
+    ``serve.rows.<tenant>``) through this one helper — the overload
+    controller and the ops report read the SAME names back, so the contract
+    lives here, not duplicated at each call site
+    (docs/observability.md "Serving plane")."""
+    safe = re.sub(r"[^A-Za-z0-9_.:\-]+", "_", tenant) or "_"
+    return f"{base}.{safe}"
 
 
 # ------------------------------------------------------------------- sinks --

@@ -39,6 +39,7 @@ from ci.analysis.rules import (  # noqa: E402
     SleepRule,
     SpmdDivergenceRule,
     TracedImpurityRule,
+    WallclockDeadlineRule,
 )
 
 
@@ -1189,3 +1190,110 @@ def test_exporter_scope_fp_guards():
     assert run(clean, ExporterScopeRule) == []
     # "TYPE" without the exposition marker form is not Prometheus assembly
     assert run('KIND = "TYPE: counter"\n', ExporterScopeRule) == []
+
+
+# --------------------------------------------------------------------------
+# wallclock-deadline: time.time() feeding deadline/timeout arithmetic
+# --------------------------------------------------------------------------
+
+
+def test_wallclock_deadline_direct_compare_true_positive():
+    fs = run(
+        """
+        import time
+        def wait(deadline):
+            if time.time() > deadline:
+                raise TimeoutError
+        """,
+        WallclockDeadlineRule,
+    )
+    assert rule_ids(fs) == ["wallclock-deadline"]
+
+
+def test_wallclock_deadline_tainted_name_compare_true_positive():
+    # name assigned from time.time() carries the taint into the compare,
+    # including through +/- arithmetic
+    fs = run(
+        """
+        import time
+        def wait(t0, timeout_s):
+            now = time.time()
+            while now - t0 < timeout_s:
+                now = time.time()
+        """,
+        WallclockDeadlineRule,
+    )
+    assert rule_ids(fs) == ["wallclock-deadline"]
+
+
+def test_wallclock_deadline_bound_assign_true_positive():
+    fs = run(
+        "import time\ndeadline = time.time() + 5.0\n",
+        WallclockDeadlineRule,
+    )
+    assert rule_ids(fs) == ["wallclock-deadline"]
+    assert fs[0].line == 2
+
+
+def test_wallclock_deadline_keyword_true_positive():
+    fs = run(
+        """
+        import time
+        def f(fut):
+            fut.result(timeout=time.time() + 1.0)
+        """,
+        WallclockDeadlineRule,
+    )
+    assert rule_ids(fs) == ["wallclock-deadline"]
+
+
+def test_wallclock_deadline_alias_still_caught():
+    fs = run(
+        "from time import time as now\nexpires = now() + 3\n",
+        WallclockDeadlineRule,
+    )
+    assert rule_ids(fs) == ["wallclock-deadline"]
+
+
+def test_wallclock_deadline_fp_guards():
+    # the timestamping idiom stays legal: record fields, bare stamps,
+    # attribute stamps, and ALL monotonic-clock arithmetic
+    clean = """
+    import time
+    class T:
+        def stamp(self):
+            self._w0 = time.time()
+            return {"t": time.time(), "host": "x"}
+    def wait(t0, timeout_s):
+        while time.monotonic() - t0 < timeout_s:
+            pass
+    def unrelated():
+        n = len("abc")
+        return n > 2
+    """
+    assert run(clean, WallclockDeadlineRule) == []
+
+
+def test_wallclock_deadline_taint_is_scope_local():
+    # a tainted name in one function must not poison a same-named
+    # monotonic reading in another
+    clean = """
+    import time
+    def a():
+        now = time.time()
+        return {"t": now}
+    def b(deadline):
+        now = time.monotonic()
+        return now > deadline
+    """
+    assert run(clean, WallclockDeadlineRule) == []
+
+
+def test_wallclock_deadline_waiver():
+    waived = (
+        "import time\n"
+        "now = time.time()\n"
+        "if now - mtime > 60:  # wallclock-ok: compared against file mtimes\n"
+        "    pass\n"
+    )
+    assert run(waived, WallclockDeadlineRule) == []

@@ -86,6 +86,44 @@ def test_fault_plan_rejects_malformed(bad):
         chaos.parse_fault_plan(bad)
 
 
+def test_fault_plan_parses_burst():
+    plan = chaos.parse_fault_plan("burst:stage=serve:rows=4096:seconds=2")
+    assert [f.kind for f in plan] == ["burst"]
+    assert plan[0].stage == "serve"
+    assert plan[0].rows == 4096
+    assert plan[0].seconds == 2.0
+    assert plan[0].times == 1
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "burst:rows=4096:seconds=2",  # missing stage
+        "burst:stage=serve:seconds=2",  # missing rows
+        "burst:stage=serve:rows=4096",  # missing seconds
+        "burst:stage=serve:rows=0:seconds=2",  # zero load is a typo
+        "burst:stage=serve:rows=4096:seconds=0",  # zero duration is a typo
+    ],
+)
+def test_fault_plan_rejects_malformed_burst(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_fault_plan(bad)
+
+
+def test_maybe_burst_stage_consumes_one_firing():
+    chaos.set_fault_plan("burst:stage=serve:rows=128:seconds=1")
+    try:
+        # wrong stage leaves the entry un-spent
+        assert chaos.maybe_burst_stage("fit") is None
+        fault = chaos.maybe_burst_stage("serve")
+        assert fault is not None
+        assert fault.rows == 128 and fault.seconds == 1.0
+        # the firing was consumed: the same entry never fires twice
+        assert chaos.maybe_burst_stage("serve") is None
+    finally:
+        chaos.clear_fault_plan()
+
+
 # ------------------------------------------------------- LocalRendezvous ----
 
 

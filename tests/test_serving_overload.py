@@ -1,0 +1,530 @@
+#
+# Overload-resilient serving tests (docs/serving.md "Overload &
+# backpressure"): server-side deadlines (expired requests NEVER dispatch),
+# deadline-aware admission with its typed evidence-carrying refusals, the
+# hysteresis-guarded backpressure ladder (no flapping), the degraded bf16
+# rung's parity, adaptive batching's zero-window escape hatch, and the
+# end-to-end burst scenario: healthy -> refusals -> recovery, every ladder
+# verdict audited and zero over-deadline dispatches.
+#
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import core, telemetry
+from spark_rapids_ml_tpu.errors import (
+    RequestTimeoutError,
+    ServeOverloadError,
+    ServingStoppedError,
+)
+from spark_rapids_ml_tpu.models.clustering import KMeansModel
+from spark_rapids_ml_tpu.ops_plane import audit as ops_audit
+from spark_rapids_ml_tpu.ops_plane import slo as ops_slo
+from spark_rapids_ml_tpu.parallel import chaos
+from spark_rapids_ml_tpu.serving import ModelRegistry, ScoringEngine
+from spark_rapids_ml_tpu.serving.overload import (
+    LEVEL_DEGRADE,
+    LEVEL_HEALTHY,
+    LEVEL_SHED,
+    LEVEL_THROTTLE,
+    LEVELS,
+    OverloadController,
+    plan_target_rows,
+    plan_window,
+)
+
+
+@pytest.fixture
+def tele():
+    """Enable telemetry with a fresh registry; restore after."""
+    telemetry.registry().reset()
+    telemetry.enable()
+    yield telemetry.registry()
+    telemetry.disable()
+    telemetry.registry().reset()
+
+
+@pytest.fixture
+def overload_cfg():
+    """Small ladder + overload knobs saved/restored around each test."""
+    keys = (
+        "transform_bucket_min_rows",
+        "serve_prewarm_rows",
+        "serve_max_batch_rows",
+        "serve_coalesce_window_ms",
+        "serve_default_deadline_ms",
+        "serve_max_queue_rows",
+        "serve_adaptive_batching",
+        "serve_overload_hold_s",
+        "serve_throttle_rows_per_s",
+        "serve_degraded_dtype",
+        "slo",
+        "metrics_bucket_seconds",
+        "metrics_bucket_count",
+    )
+    saved = {k: core.config[k] for k in keys}
+    core.config["transform_bucket_min_rows"] = 8
+    core.config["serve_prewarm_rows"] = 64
+    core.config["serve_max_batch_rows"] = 256
+    core.config["serve_coalesce_window_ms"] = 5.0
+    core.config["slo"] = []
+    yield
+    core.config.update(saved)
+    ops_slo.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    yield
+    chaos.clear_fault_plan()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _kmeans_model(rng, k=4, d=8, scale=10.0):
+    centers = (rng.standard_normal((k, d)) * scale).astype(np.float32)
+    return KMeansModel(cluster_centers_=centers, n_cols=d, dtype="float32")
+
+
+def _feats(rng, n, d=8):
+    return rng.standard_normal((n, d)).astype(np.float32)
+
+
+# ------------------------------------------------- the batching planners ----
+
+
+def test_plan_window_zero_base_disables_coalescing():
+    # an explicit zero window means NO coalescing, adaptive or not
+    assert plan_window(
+        0.0, floor_s=0.001, ceiling_s=0.02, arrival_rows_per_s=1e6,
+        queue_rows=10_000, queue_wait_p99_s=10.0, max_rows=256,
+    ) == 0.0
+
+
+def test_plan_window_uncongested_is_exactly_static():
+    # static values are overrides, not hints: no congestion evidence (p99
+    # absent, or at/under the static window) returns base EXACTLY
+    for p99 in (None, 0.0, 0.002):
+        assert plan_window(
+            0.002, floor_s=0.0005, ceiling_s=0.02, arrival_rows_per_s=500.0,
+            queue_rows=10, queue_wait_p99_s=p99, max_rows=256,
+        ) == 0.002
+
+
+def test_plan_window_congested_full_queue_hits_floor():
+    # a queue already holding a full batch gains nothing from waiting
+    assert plan_window(
+        0.002, floor_s=0.0005, ceiling_s=0.02, arrival_rows_per_s=500.0,
+        queue_rows=256, queue_wait_p99_s=1.0, max_rows=256,
+    ) == 0.0005
+
+
+def test_plan_window_congested_grows_to_fill_time_clamped():
+    # congested, queue half full: window = time to fill the batch at the
+    # observed arrival rate, clamped to [base, ceiling]
+    w = plan_window(
+        0.002, floor_s=0.0005, ceiling_s=0.02, arrival_rows_per_s=12_800.0,
+        queue_rows=128, queue_wait_p99_s=1.0, max_rows=256,
+    )
+    assert w == pytest.approx(128 / 12_800.0)  # 10ms, inside [2ms, 20ms]
+    # slow arrivals clamp at the ceiling
+    assert plan_window(
+        0.002, floor_s=0.0005, ceiling_s=0.02, arrival_rows_per_s=100.0,
+        queue_rows=0, queue_wait_p99_s=1.0, max_rows=256,
+    ) == 0.02
+
+
+def test_plan_target_rows_rungs():
+    # uncongested: the window, not the target, bounds the batch
+    assert plan_target_rows(
+        min_rows=8, max_rows=256, queue_rows=10, arrival_rows_per_s=None,
+        window_s=0.002, congested=False,
+    ) == 256
+    # congested: the geometric rung covering backlog + one window's arrivals
+    assert plan_target_rows(
+        min_rows=8, max_rows=256, queue_rows=20, arrival_rows_per_s=1000.0,
+        window_s=0.01, congested=True,
+    ) == 32  # 20 + 10 = 30 -> rung 32
+    assert plan_target_rows(
+        min_rows=8, max_rows=256, queue_rows=10_000, arrival_rows_per_s=None,
+        window_s=0.01, congested=True,
+    ) == 256
+
+
+# ----------------------------------------------------- deadline semantics ---
+
+
+def test_expired_deadline_fails_fast_and_never_dispatches(tele, overload_cfg, rng):
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    # window 0: no coalescing, so the delayed first request cannot absorb
+    # the short-deadline second one
+    chaos.set_fault_plan("delay:stage=serve:seconds=0.25:times=1")
+    with ScoringEngine(registry, coalesce_window_s=0.0) as engine:
+        a = engine.submit("km", _feats(rng, 4))
+        b = engine.submit("km", _feats(rng, 4), deadline_ms=100.0)
+        assert a.result(timeout=10.0) is not None
+        with pytest.raises(RequestTimeoutError) as ei:
+            b.result(timeout=10.0)
+    err = ei.value
+    assert err.model == "km"
+    assert err.deadline_ms == pytest.approx(100.0, rel=0.05)
+    assert err.waited_ms >= err.deadline_ms
+    snap = tele.snapshot()["counters"]
+    assert snap["serve.expired_requests"] == 1
+    # only the healthy request dispatched, and the tripwire stayed silent
+    assert snap["serve.batches"] == 1
+    assert snap.get("serve.overdeadline_dispatches", 0) == 0
+
+
+def test_deadline_defaults_and_zero_disables(tele, overload_cfg, rng):
+    core.config["serve_default_deadline_ms"] = 5000.0
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    with ScoringEngine(registry) as engine:
+        t0 = time.monotonic()
+        fut = engine.submit("km", _feats(rng, 2))
+        assert fut.deadline is not None
+        assert fut.deadline - t0 == pytest.approx(5.0, abs=0.5)
+        # deadline_ms <= 0 disables the server-side deadline entirely
+        assert engine.submit("km", _feats(rng, 2), deadline_ms=0).deadline is None
+
+
+def test_admission_rejects_infeasible_deadline_with_evidence(tele, overload_cfg, rng):
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    # seed the windowed queue-wait p99 far above the request's deadline:
+    # admission must refuse synchronously, with the prediction as evidence
+    for _ in range(8):
+        tele.observe("serve.queue_wait_s", 5.0)
+    with ScoringEngine(registry) as engine:
+        with pytest.raises(ServeOverloadError) as ei:
+            engine.submit("km", _feats(rng, 4), deadline_ms=100.0)
+    err = ei.value
+    assert err.model == "km"
+    assert err.level == "healthy"  # refused by prediction, not the ladder
+    assert err.predicted_wait_ms is not None and err.predicted_wait_ms > 100.0
+    assert err.deadline_ms == pytest.approx(100.0, rel=0.05)
+    assert tele.snapshot()["counters"]["serve.rejected_requests"] == 1
+
+
+def test_admission_bounded_queue_refuses(tele, overload_cfg, rng):
+    core.config["serve_max_queue_rows"] = 4
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    with ScoringEngine(registry) as engine:
+        with pytest.raises(ServeOverloadError) as ei:
+            engine.submit("km", _feats(rng, 8))
+    assert "queue is full" in str(ei.value)
+    assert ei.value.queue_rows == 0
+    assert tele.snapshot()["counters"]["serve.rejected_requests"] == 1
+
+
+# ------------------------------------------------------------- the ladder ---
+
+
+def _spec(**over):
+    spec = {
+        "name": "serving_p99", "kind": "latency", "histogram": "serve.e2e_s",
+        "threshold_s": 0.1, "objective": 0.5, "fast_window_s": 1.0,
+        "fast_burn": 1.0,
+    }
+    spec.update(over)
+    return spec
+
+
+def test_ladder_hysteresis_one_rung_per_hold_no_flap(overload_cfg):
+    core.config["serve_overload_hold_s"] = 10.0
+    ops_slo.reset()
+    ctl = OverloadController()
+    # create the tenant through the public admission path
+    ctl.admit(
+        model="m", tenant="acme", rows=1, deadline_s=None, now=0.0,
+        queue_depth=0, queue_rows=0,
+    )
+    burn = {"v": 5.0}
+    ctl._tenant_burn = lambda tenant, spec: burn["v"]  # the scripting seam
+    audited_before = len(ops_audit.decisions(kind="backpressure", tenant="acme"))
+    spec = _spec()
+
+    def level():
+        return ctl.level("acme")
+
+    ctl.evaluate(spec, now=0.0)
+    assert level() == LEVEL_THROTTLE  # healthy escalates without dwell
+    ctl.evaluate(spec, now=5.0)
+    assert level() == LEVEL_THROTTLE  # still burning, but inside the hold
+    ctl.evaluate(spec, now=11.0)
+    assert level() == LEVEL_DEGRADE  # one rung per dwell
+    burn["v"] = 0.0
+    ctl.evaluate(spec, now=15.0)
+    assert level() == LEVEL_DEGRADE  # clear, but inside the hold: no flap
+    ctl.evaluate(spec, now=22.0)
+    assert level() == LEVEL_THROTTLE  # restore one rung per dwell
+    ctl.evaluate(spec, now=23.0)
+    assert level() == LEVEL_THROTTLE  # no flap on the way down either
+    ctl.evaluate(spec, now=33.0)
+    assert level() == LEVEL_HEALTHY
+    # every transition audited, in order, with the restore verdicts
+    events = ops_audit.decisions(kind="backpressure", tenant="acme")
+    new = events[audited_before:]
+    assert [e["verdict"] for e in new] == [
+        "throttle", "degrade", "restore", "restore",
+    ]
+    assert ctl.stats()["acme"]["transitions"] == 4
+
+
+def test_ladder_empty_burn_window_is_not_burning(overload_cfg):
+    # no traffic in the fast window -> burn None -> never escalates (an
+    # idle tenant is not an overloaded tenant)
+    core.config["serve_overload_hold_s"] = 0.0
+    ops_slo.reset()
+    ctl = OverloadController()
+    ctl.admit(
+        model="m", tenant="idle", rows=1, deadline_s=None, now=0.0,
+        queue_depth=0, queue_rows=0,
+    )
+    ctl._tenant_burn = lambda tenant, spec: None
+    ctl.evaluate(_spec(), now=1.0)
+    assert ctl.level("idle") == LEVEL_HEALTHY
+
+
+def test_throttle_token_bucket_meters_and_refills(overload_cfg):
+    core.config["serve_throttle_rows_per_s"] = 100.0
+    ctl = OverloadController()
+    ctl.admit(
+        model="m", tenant="t", rows=1, deadline_s=None, now=0.0,
+        queue_depth=0, queue_rows=0,
+    )
+    ctl.force_level("t", LEVEL_THROTTLE)
+
+    def admit(rows, now):
+        return ctl.admit(
+            model="m", tenant="t", rows=rows, deadline_s=None, now=now,
+            queue_depth=0, queue_rows=0,
+        )
+
+    # first fill is one second of rate (100 rows): two 40-row takes pass,
+    # the third finds 20 tokens and is refused with the typed evidence
+    admit(40, 1.0)
+    admit(40, 1.0)
+    with pytest.raises(ServeOverloadError) as ei:
+        admit(40, 1.0)
+    assert ei.value.level == "throttle"
+    assert ei.value.tenant == "t"
+    # half a second refills 50 tokens: the same request now passes
+    admit(40, 1.5)
+    assert ctl.stats()["t"]["throttled_requests"] == 1
+
+
+def test_degraded_rung_routes_to_bf16_with_parity(tele, overload_cfg, rng):
+    # well-separated centers: bf16 rounding cannot flip assignments, so the
+    # degraded rung's output must MATCH a reference engine serving bf16 as
+    # its primary dtype
+    core.config["serve_degraded_dtype"] = "bf16"
+    # degrade sits ABOVE throttle on the ladder, so its admissions are
+    # still token-metered; a generous rate keeps this a pure parity test
+    core.config["serve_throttle_rows_per_s"] = 1e9
+    model = _kmeans_model(rng, scale=50.0)
+    feats = _feats(rng, 32)
+    registry = ModelRegistry()
+    entry = registry.load("km", model)
+    assert entry.degraded_program is not None
+    ref_registry = ModelRegistry()
+    ref_registry.load("km16", model, serve_dtype="bf16")
+    with ScoringEngine(ref_registry) as ref_engine:
+        expect = ref_engine.score("km16", feats)
+    with ScoringEngine(registry) as engine:
+        engine._overload.force_level("default", LEVEL_DEGRADE)
+        got = engine.score("km", feats)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expect))
+    snap = tele.snapshot()["counters"]
+    assert snap["serve.degraded_requests"] >= 1
+    assert snap["serve.degraded_rows"] >= 32
+
+
+def test_shed_refuses_outright(tele, overload_cfg, rng):
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    with ScoringEngine(registry) as engine:
+        engine._overload.force_level("default", LEVEL_SHED)
+        with pytest.raises(ServeOverloadError) as ei:
+            engine.submit("km", _feats(rng, 4))
+    assert ei.value.level == "shed"
+    assert tele.snapshot()["counters"]["serve.shed_requests"] == 1
+
+
+# ------------------------------------------------------- adaptive batching --
+
+
+def test_zero_window_disables_coalescing_under_adaptive(tele, overload_cfg, rng):
+    core.config["serve_coalesce_window_ms"] = 0.0
+    core.config["serve_adaptive_batching"] = True
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    # a per-dispatch delay queues the later requests behind the first:
+    # WITH coalescing they would merge; the zero window must dispatch solo
+    chaos.set_fault_plan("delay:stage=serve:seconds=0.05:times=4")
+    with ScoringEngine(registry) as engine:
+        futs = [engine.submit("km", _feats(rng, 4)) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=10.0)
+    snap = tele.snapshot()["counters"]
+    assert snap["serve.batches"] == 4
+    assert snap.get("serve.coalesced_batches", 0) == 0
+
+
+# ----------------------------------------------- stop() + stats + report ----
+
+
+def test_stop_fails_pending_futures_typed(overload_cfg, rng):
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    # every dispatch sleeps 0.5s; window 0 so the queued requests cannot
+    # merge into the in-flight batch
+    chaos.set_fault_plan("delay:stage=serve:seconds=0.5:times=1000")
+    engine = ScoringEngine(registry, coalesce_window_s=0.0).start()
+    worker = engine._thread
+    try:
+        engine.submit("km", _feats(rng, 4))
+        b = engine.submit("km", _feats(rng, 4))
+        c = engine.submit("km", _feats(rng, 4))
+        engine.stop(timeout=0.05)  # drain deadline elapses mid-dispatch
+        for pos, fut in ((0, b), (1, c)):
+            with pytest.raises(ServingStoppedError) as ei:
+                fut.result(timeout=1.0)
+            assert ei.value.model == "km"
+            assert ei.value.queue_position == pos
+    finally:
+        chaos.clear_fault_plan()
+        if worker is not None:
+            worker.join(5.0)  # let the in-flight dispatch finish
+
+
+def test_stats_and_ops_report_surface_tenants(tele, overload_cfg, rng):
+    from spark_rapids_ml_tpu import ops_plane
+    from benchmark import opsreport
+
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    with ScoringEngine(registry) as engine:
+        for _ in range(3):
+            engine.submit("km", _feats(rng, 8), tenant="acme").result(timeout=10.0)
+        stats = engine.stats()
+    assert stats["queue_depth"] == 0 and stats["queue_rows"] == 0
+    acme = stats["tenants"]["acme"]
+    assert acme["level"] == "healthy"
+    assert acme["queue_wait_p99_s"] is not None
+    assert acme["e2e_p50_s"] is not None
+    for key in ("shed_requests", "throttled_requests", "degraded_requests"):
+        assert acme[key] == 0
+    report = ops_plane.report()
+    assert "acme" in report["serving"]["tenants"]
+    assert report["serving"]["tenants"]["acme"]["level"] == "healthy"
+    rendered = opsreport.render(report)
+    assert "backpressure ladder" in rendered
+    assert "acme" in rendered
+
+
+# ------------------------------------------------------------ e2e burst -----
+
+
+def test_burst_escalates_audits_and_recovers(tele, overload_cfg, rng):
+    """The saturation story end to end, at test scale: a chaos-planned
+    burst drives a healthy tenant into refusals, every ladder verdict lands
+    in the audit log, no expired request ever dispatches, and clearing the
+    load restores the tenant to healthy through the submit-path hook (a
+    fully-refused tenant generates no dispatches)."""
+    core.config["metrics_bucket_seconds"] = 0.2
+    core.config["metrics_bucket_count"] = 20
+    telemetry.registry().reset()  # window params rebind at first record
+    core.config["serve_max_batch_rows"] = 16
+    core.config["serve_coalesce_window_ms"] = 2.0
+    core.config["serve_overload_hold_s"] = 0.25
+    core.config["serve_default_deadline_ms"] = 600.0
+    core.config["slo"] = [_spec(threshold_s=0.25, fast_window_s=0.6)]
+    model = _kmeans_model(rng)
+    registry = ModelRegistry()
+    registry.load("km", model)
+    audited_before = len(ops_audit.decisions(kind="backpressure"))
+    # service pinned at 20ms/dispatch (capacity ~800 rows/s at 16-row
+    # batches); the chaos plan declares the burst's load shape
+    chaos.set_fault_plan(
+        "delay:stage=serve:seconds=0.02:times=100000;"
+        "burst:stage=serve:rows=2000:seconds=1"
+    )
+    fault = chaos.maybe_burst_stage("serve")
+    assert fault is not None
+    refusals = []
+    futs = []
+    with ScoringEngine(registry) as engine:
+        req_rows = 16
+        t_next = time.monotonic()
+        t_end = t_next + fault.seconds
+        while time.monotonic() < t_end:
+            try:
+                futs.append(engine.submit("km", _feats(rng, req_rows)))
+            except ServeOverloadError as e:
+                refusals.append(e)
+            t_next += req_rows / fault.rows
+            delay = t_next - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        outcomes = {"ok": 0, "expired": 0}
+        for f in futs:
+            try:
+                f.result(timeout=10.0)
+                outcomes["ok"] += 1
+            except RequestTimeoutError:
+                outcomes["expired"] += 1
+        # recovery: lift the injected service delay and offer light load;
+        # even a fully-shed tenant must walk back down (admission refusals
+        # still advance the ladder via the submit-path hook)
+        chaos.clear_fault_plan()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            try:
+                engine.submit(
+                    "km", _feats(rng, 4), deadline_ms=5000.0
+                ).result(timeout=10.0)
+            except ServeOverloadError as e:
+                refusals.append(e)
+            if engine.stats()["tenants"]["default"]["level"] == "healthy":
+                break
+            time.sleep(0.05)
+        final = engine.stats()
+    snap = tele.snapshot()["counters"]
+    # the ladder engaged: transitions happened and at least one request was
+    # refused or expired while the burst ran
+    transitions = int(snap["serve.backpressure_transitions"])
+    assert transitions >= 2  # at least one escalation and one restore
+    pressure = (
+        len(refusals)
+        + outcomes["expired"]
+        + int(snap.get("serve.rejected_requests", 0))
+    )
+    assert pressure > 0
+    assert outcomes["ok"] > 0  # the burst did not collapse service entirely
+    for e in refusals:
+        assert e.level in LEVELS
+    # the deadline contract held under saturation
+    assert snap.get("serve.overdeadline_dispatches", 0) == 0
+    # every verdict audited: the decision log grew by exactly the
+    # transition count
+    audited = ops_audit.decisions(kind="backpressure")[audited_before:]
+    assert len(audited) == transitions
+    assert {a["verdict"] for a in audited} <= set(LEVELS[1:]) | {"restore"}
+    # ...and the tenant walked back to healthy
+    assert final["tenants"]["default"]["level"] == "healthy"
