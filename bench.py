@@ -139,6 +139,16 @@ SCHED_TENANTS = int(os.environ.get("BENCH_SCHED_TENANTS", 4))
 SCHED_ROWS = int(os.environ.get("BENCH_SCHED_ROWS", 60_000))
 SCHED_COLS = int(os.environ.get("BENCH_SCHED_COLS", 32))
 
+# Co-admission utilization lane (rides BENCH_SCHED=1): the same two
+# half-mesh fits co-admitted onto disjoint chip windows by the 2-D ledger
+# vs time-sliced (benchmark/bench_scheduler.run_coadmission_bench,
+# docs/scheduling.md "2-D placement") — reports the aggregate rows/sec
+# ratio and the chip-occupancy integral of both phases. Own @RESULT line;
+# NOT part of the headline geomean until the lane history stabilizes (no
+# BASELINES entry — the PR-10 per-lane trajectory gate picks it up).
+SCHED_COADMIT_ALGO = "sched_coadmit"
+SCHED_COADMIT_ROWS = int(os.environ.get("BENCH_SCHED_COADMIT_ROWS", 40_000))
+
 
 def bench_algos() -> tuple:
     extra: tuple = ()
@@ -157,7 +167,7 @@ def bench_algos() -> tuple:
     if os.environ.get("BENCH_SCHED"):
         # contention lane ahead of the dense block for the same HBM reason
         # (its per-tenant datasets are freed when the scheduler drains)
-        extra += (SCHED_ALGO,)
+        extra += (SCHED_ALGO, SCHED_COADMIT_ALGO)
     return extra + ALGOS
 
 # Parent retry policy (override for tests): attempts x per-attempt timeout,
@@ -466,6 +476,45 @@ def bench_scheduler_lane() -> float:
     }
 
 
+def bench_sched_coadmit_lane() -> tuple:
+    """Co-admission utilization lane (docs/scheduling.md "2-D placement"):
+    two half-mesh fits co-admitted onto disjoint chip windows vs the same
+    fits time-sliced. The lane metric is concurrent aggregate fit rows/sec;
+    the rows/sec ratio and the chip-occupancy integrals ride the record's
+    report-only `ops` embed. Cross-placement result divergence is a
+    correctness failure, not a slow lane."""
+    from benchmark.bench_scheduler import run_coadmission_bench
+
+    out = run_coadmission_bench(SCHED_COADMIT_ROWS, SCHED_COLS)
+    _log(
+        f"sched_coadmit: {out['wall_concurrent_s']:.2f}s concurrent vs "
+        f"{out['wall_sliced_s']:.2f}s time-sliced "
+        f"(rows/s ratio {out['rows_per_sec_ratio']:.2f}, occupancy "
+        f"{out['avg_chips_concurrent']:.1f} vs {out['avg_chips_sliced']:.1f} "
+        f"avg chips of {int(out['pool_chips'])}, "
+        f"max_abs_diff {out['max_abs_diff']:.1e})"
+    )
+    if out["max_abs_diff"] != 0.0:
+        raise RuntimeError(
+            "sched_coadmit lane: co-admitted results differ from time-sliced "
+            f"(max_abs_diff={out['max_abs_diff']})"
+        )
+    return out["rows_per_sec_concurrent"], None, {
+        "rows_per_sec_ratio": round(out["rows_per_sec_ratio"], 3),
+        "rows_per_sec_sliced": round(out["rows_per_sec_sliced"], 1),
+        "occupancy": {
+            "pool_chips": out["pool_chips"],
+            "avg_chips_concurrent": round(out["avg_chips_concurrent"], 2),
+            "avg_chips_sliced": round(out["avg_chips_sliced"], 2),
+            "peak_chips_concurrent": out["peak_chips_concurrent"],
+            "peak_chips_sliced": out["peak_chips_sliced"],
+            "chip_seconds_concurrent": round(out["chip_seconds_concurrent"], 3),
+            "chip_seconds_sliced": round(out["chip_seconds_sliced"], 3),
+            "ratio": round(out["occupancy_ratio"], 3),
+        },
+    }
+
+
 def bench_serving_lane() -> tuple:
     """Serving-plane lane (docs/serving.md): mixed-size concurrent predict
     requests against a resident k=SERVE_K model at the protocol width through
@@ -613,6 +662,7 @@ def run_child() -> int:
         CV_ALGO: lambda: bench_cv_lane(),
         OOCORE_ALGO: lambda: bench_oocore_lane(),
         SCHED_ALGO: lambda: bench_scheduler_lane(),
+        SCHED_COADMIT_ALGO: lambda: bench_sched_coadmit_lane(),
         "serving_saturation": lambda: bench_saturation_lane(),
         "serving": lambda: bench_serving_lane(),
         "pca": lambda: bench_pca(dense_data()["X"], dense_data()["w"], mesh),

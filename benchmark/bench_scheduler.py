@@ -196,6 +196,128 @@ def run_scheduler_bench(
         ops_slo.reset()
 
 
+def _occupancy_integral(samples: List, t_end: float) -> Dict[str, float]:
+    """Step-integral of a (timestamp, chips-held) poll trace: chip-seconds,
+    time-averaged chips, and peak — the occupancy dimension of the 2-D book
+    (docs/scheduling.md "2-D placement")."""
+    if not samples:
+        return {"chip_seconds": 0.0, "avg_chips": 0.0, "peak_chips": 0.0}
+    area, peak = 0.0, 0.0
+    closed = samples + [(t_end, samples[-1][1])]
+    for (t0, v), (t1, _) in zip(closed, closed[1:]):
+        area += v * max(0.0, t1 - t0)
+        peak = max(peak, float(v))
+    span = max(1e-9, t_end - samples[0][0])
+    return {
+        "chip_seconds": area,
+        "avg_chips": area / span,
+        "peak_chips": peak,
+    }
+
+
+def run_coadmission_bench(
+    n_rows: int = 40_000,
+    n_cols: int = 32,
+    *,
+    k: int = 8,
+    max_iter: int = 12,
+    seed: int = 0,
+    poll_interval_s: float = 0.002,
+) -> Dict[str, float]:
+    """Co-admission utilization lane (docs/scheduling.md "2-D placement"):
+    the SAME two half-mesh-wide KMeans fits run (a) co-admitted by the 2-D
+    ledger onto disjoint contiguous chip windows and (b) time-sliced
+    (`max_concurrent=1`) — the only difference is placement. Reports the
+    aggregate rows/sec ratio and the chip-occupancy integral of both phases
+    (concurrent should hold ~the whole pool, sliced ~half), plus the
+    placement bit-identity check (max |Δcenters| across phases must be 0 —
+    WHERE a fit runs must not bend its math). Report-only `@RESULT` lane in
+    bench.py until its trajectory starts (PR-10 per-lane gating)."""
+    import threading
+
+    from spark_rapids_ml_tpu import telemetry
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.parallel import get_mesh
+    from spark_rapids_ml_tpu.scheduler import FitScheduler, reset_global_ledger
+    from spark_rapids_ml_tpu.scheduler.ledger import global_ledger
+
+    telemetry.enable()
+    rng = np.random.default_rng(seed)
+    df = {"features": rng.standard_normal((n_rows, n_cols), dtype=np.float32)}
+    pool = int(get_mesh().devices.size)
+    width = max(1, pool // 2)
+
+    def mk():
+        est = KMeans(k=k, maxIter=max_iter, tol=0.0, seed=7)
+        est.num_workers = width
+        return est
+
+    def phase(max_concurrent: int):
+        reset_global_ledger()
+        sched = FitScheduler(chip_placement=True, max_concurrent=max_concurrent)
+        samples: List = []
+        stop = threading.Event()
+
+        def poll():
+            while not stop.is_set():
+                samples.append(
+                    (time.perf_counter(), len(global_ledger().occupied_chips()))
+                )
+                stop.wait(poll_interval_s)
+
+        sampler = threading.Thread(target=poll, daemon=True)
+        t0 = time.perf_counter()
+        sampler.start()
+        try:
+            jobs = [sched.submit(mk(), df, tenant=f"t{i}") for i in range(2)]
+            models = [j.result(timeout=600) for j in jobs]
+        finally:
+            stop.set()
+            sampler.join(5.0)
+            sched.shutdown(wait=True, timeout=60)
+        wall = time.perf_counter() - t0
+        occ = _occupancy_integral(samples, t0 + wall)
+        return wall, occ, models
+
+    # warm the compile cache outside the timed phases: both placements run
+    # the same `width`-device program shapes, so neither phase should pay
+    # compilation (whichever runs first otherwise eats the whole compile)
+    mk().fit(df)
+
+    wall_c, occ_c, models_c = phase(max_concurrent=2)
+    wall_s, occ_s, models_s = phase(max_concurrent=1)
+
+    # placement bit-identity: disjoint-window concurrent fits vs the
+    # time-sliced whole-queue fits of the same estimator/data/seed
+    ref = models_s[0].cluster_centers_
+    max_abs_diff = max(
+        float(np.max(np.abs(np.asarray(m.cluster_centers_) - np.asarray(ref))))
+        for m in (models_c + models_s)
+    )
+    rows_total = float(2 * n_rows)
+    rps_c = rows_total / wall_c if wall_c else 0.0
+    rps_s = rows_total / wall_s if wall_s else 0.0
+    return {
+        "pool_chips": float(pool),
+        "job_width": float(width),
+        "wall_concurrent_s": wall_c,
+        "wall_sliced_s": wall_s,
+        "rows_per_sec_concurrent": rps_c,
+        "rows_per_sec_sliced": rps_s,
+        "rows_per_sec_ratio": rps_c / rps_s if rps_s else 0.0,
+        "avg_chips_concurrent": occ_c["avg_chips"],
+        "avg_chips_sliced": occ_s["avg_chips"],
+        "peak_chips_concurrent": occ_c["peak_chips"],
+        "peak_chips_sliced": occ_s["peak_chips"],
+        "chip_seconds_concurrent": occ_c["chip_seconds"],
+        "chip_seconds_sliced": occ_s["chip_seconds"],
+        "occupancy_ratio": (
+            occ_c["avg_chips"] / occ_s["avg_chips"] if occ_s["avg_chips"] else 0.0
+        ),
+        "max_abs_diff": max_abs_diff,
+    }
+
+
 class BenchmarkScheduler(BenchmarkBase):
     name = "scheduler"
     extra_args = {

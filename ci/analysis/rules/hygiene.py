@@ -21,6 +21,7 @@ KNOWN_WAIVER_TAGS = {
     "hbm",
     "bucket",
     "spmd",
+    "submesh",
     "host-fetch",
     "traced",
     "config",

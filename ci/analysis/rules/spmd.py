@@ -11,6 +11,14 @@
 # RendezvousTimeoutError. Rank-dependent PAYLOADS are fine (every rank still
 # calls the collective); rank-dependent REACHABILITY is the bug.
 #
+# PR 19 adds the PLACEMENT spelling of the same hang: code running under a
+# carved sub-mesh (`with submesh(...)` / `with chip_scope(...)`) executes on
+# only SOME of the pool, but the control-plane collectives above span the
+# FULL rendezvous clique — a full-mesh `allgather` reachable from
+# sub-mesh-scoped code strands the ranks outside the carve exactly like a
+# rank-conditional does. Waive deliberate full-group rounds (e.g. a sweep
+# shard reporting back to the whole clique) with `# submesh-ok: <reason>`.
+#
 from __future__ import annotations
 
 import ast
@@ -21,6 +29,7 @@ from ..engine import FileContext, RuleBase, dotted
 RANK_IDENTIFIERS = {"rank", "orig_rank", "process_index"}
 COLLECTIVE_ATTRS = {"allgather", "barrier", "reform"}
 COLLECTIVE_NAMES = {"allgather_concat"}
+SUBMESH_SCOPE_NAMES = {"submesh", "chip_scope"}
 
 
 def _mentions_rank(test: ast.AST) -> Optional[str]:
@@ -39,6 +48,19 @@ def _collective_name(node: ast.Call, imports) -> Optional[str]:
         return func.attr
     name = dotted(func, imports)
     if name is not None and name.split(".")[-1] in COLLECTIVE_NAMES:
+        return name.split(".")[-1]
+    return None
+
+
+def _submesh_scope_name(expr: ast.AST, imports) -> Optional[str]:
+    """The sub-mesh carving helper a `with` item enters, if any."""
+    if not isinstance(expr, ast.Call):
+        return None
+    func = expr.func
+    if isinstance(func, ast.Attribute) and func.attr in SUBMESH_SCOPE_NAMES:
+        return func.attr
+    name = dotted(func, imports)
+    if name is not None and name.split(".")[-1] in SUBMESH_SCOPE_NAMES:
         return name.split(".")[-1]
     return None
 
@@ -91,13 +113,16 @@ class SpmdDivergenceRule(RuleBase):
     id = "spmd-divergence"
     waiver = "spmd"
     tree_scope = ("spark_rapids_ml_tpu",)
-    description = "collectives reachable by only some ranks (rank-conditional or except-handler)"
+    description = (
+        "collectives reachable by only some ranks (rank-conditional, "
+        "except-handler, or full-mesh collective under a sub-mesh scope)"
+    )
 
     def check_module(self, tree: ast.Module, ctx: FileContext) -> None:
         self._visit_block(tree.body, ctx, [])
 
     def _visit_block(
-        self, stmts, ctx: FileContext, stack: List[Tuple[str, int]]
+        self, stmts, ctx: FileContext, stack: List[Tuple[str, int, str]]
     ) -> None:
         """Visit a statement SEQUENCE: a rank-guarded early exit
         (`if rank != 0: return`) makes every later statement in the block
@@ -117,10 +142,13 @@ class SpmdDivergenceRule(RuleBase):
                             f"rank-identity conditional on `{rank_id}` with an "
                             "early exit",
                             stmt.lineno,
+                            "spmd",
                         )
                     )
 
-    def _visit(self, node: ast.AST, ctx: FileContext, stack: List[Tuple[str, int]]) -> None:
+    def _visit(
+        self, node: ast.AST, ctx: FileContext, stack: List[Tuple[str, int, str]]
+    ) -> None:
         # a nested function body does not execute under the enclosing
         # conditional — it executes wherever it is CALLED — so the
         # divergence context resets at every function boundary
@@ -133,19 +161,40 @@ class SpmdDivergenceRule(RuleBase):
         if isinstance(node, ast.Call):
             name = _collective_name(node, ctx.imports)
             if name is not None and stack:
-                kind, line = stack[-1]
-                ctx.emit(
-                    self,
-                    node,
-                    f"collective `{name}` reachable by only some ranks — "
-                    f"{kind} (line {line}) lets ranks skip it, hanging peers "
-                    "in the round until the rendezvous deadline; hoist the "
-                    "collective so every rank reaches it (keep the payload "
-                    "rank-dependent instead) or mark `# spmd-ok: <reason>`",
-                )
+                kind, line, tag = stack[-1]
+                if tag == "submesh":
+                    # different failure, different waiver: the collective's
+                    # clique is the FULL process group, but the enclosing
+                    # scope carved the pool — ranks outside the carve never
+                    # enter the round
+                    if not ctx.waived("submesh", node):
+                        ctx.emit_at(
+                            self.id,
+                            node.lineno,
+                            node.col_offset + 1,
+                            f"full-mesh collective `{name}` under {kind} "
+                            f"(line {line}): the rendezvous round spans the "
+                            "whole clique but only the carved sub-mesh's "
+                            "ranks reach it, stranding the rest until the "
+                            "round deadline; run the round on the sub-mesh's "
+                            "own group, hoist it out of the carve, or mark "
+                            "`# submesh-ok: <reason>`",
+                        )
+                else:
+                    ctx.emit(
+                        self,
+                        node,
+                        f"collective `{name}` reachable by only some ranks — "
+                        f"{kind} (line {line}) lets ranks skip it, hanging peers "
+                        "in the round until the rendezvous deadline; hoist the "
+                        "collective so every rank reaches it (keep the payload "
+                        "rank-dependent instead) or mark `# spmd-ok: <reason>`",
+                    )
         if isinstance(node, (ast.If, ast.While)):
             rank_id = _mentions_rank(node.test)
-            frame = (f"rank-identity conditional on `{rank_id}`", node.lineno)
+            frame = (
+                f"rank-identity conditional on `{rank_id}`", node.lineno, "spmd"
+            )
             self._visit(node.test, ctx, stack)
             inner = stack + [frame] if rank_id else stack
             if rank_id and isinstance(node, ast.If) and node.orelse:
@@ -168,15 +217,21 @@ class SpmdDivergenceRule(RuleBase):
         if isinstance(node, ast.Try):
             self._visit_block(node.body, ctx, stack)
             for handler in node.handlers:
-                frame = ("except handler", handler.lineno)
+                frame = ("except handler", handler.lineno, "spmd")
                 self._visit_block(handler.body, ctx, stack + [frame])
             self._visit_block(node.orelse, ctx, stack)
             self._visit_block(node.finalbody, ctx, stack)
             return
         if isinstance(node, ast.With):
+            inner = stack
             for item in node.items:
                 self._visit(item.context_expr, ctx, stack)
-            self._visit_block(node.body, ctx, stack)
+                scope = _submesh_scope_name(item.context_expr, ctx.imports)
+                if scope is not None:
+                    inner = inner + [
+                        (f"sub-mesh scope `{scope}(...)`", node.lineno, "submesh")
+                    ]
+            self._visit_block(node.body, ctx, inner)
             return
         for child in ast.iter_child_nodes(node):
             self._visit(child, ctx, stack)

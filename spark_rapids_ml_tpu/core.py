@@ -148,6 +148,18 @@ config: Dict[str, Any] = {
     # bin-pack into the ledger — bounds worker threads and per-job compile
     # pressure (a fairness/safety knob, docs/scheduling.md)
     "sched_max_concurrent": 4,
+    # 2-D placement mode (docs/scheduling.md "2-D placement"): scheduler
+    # claims name WHICH chips (contiguous first-fit runs over the pool) and
+    # each job runs pinned to its claimed set via parallel.mesh.chip_scope,
+    # so jobs of disjoint widths co-admit onto disjoint chip sets and run
+    # concurrently instead of time-slicing the whole mesh. False keeps the
+    # 1-D bytes-only book.
+    "sched_chip_placement": False,
+    # hierarchical mesh topology for parallel.mesh.build_mesh: None = flat
+    # 1-D `rows` mesh; a dict like {"dcn": 2, "rows": 4} composes a DCN
+    # (cross-process) axis with an ICI (in-process) axis — either axis may
+    # be 0/absent to auto-derive from the process grouping
+    "mesh_topology": None,
     # --- serving plane (docs/serving.md) ---------------------------------
     # how long the ScoringEngine holds a dispatched request open for
     # same-model coalescing (micro-batching up the bucket ladder): the
@@ -409,11 +421,23 @@ class FitInputs:
         exactly the fit over the mask's rows — this is how CrossValidator
         realizes a fold without re-ingesting or re-laying-out anything
         (one HBM placement serves every fold). The placed X/y are shared
-        untouched; only the tiny weight vector is re-derived per fold."""
+        untouched; only the tiny weight vector is re-derived per fold.
+
+        Under multi-process SPMD the mask names THIS RANK's local valid
+        rows (`n_valid` is the global sum): each rank masks its own slice
+        and `put_rows` pads it out to the rendezvous-agreed local target,
+        so one fold is the union of every rank's local train rows."""
         import dataclasses
 
         m = np.ascontiguousarray(np.asarray(mask), dtype=self.dtype)
-        if m.shape[0] != self.n_valid:
+        spmd_local = self.local_rows_target is not None and m.shape[0] != self.n_valid
+        if spmd_local:
+            if m.shape[0] > int(self.local_rows_target):
+                raise ValueError(
+                    f"row mask has {m.shape[0]} entries for a local row "
+                    f"target of {int(self.local_rows_target)}"
+                )
+        elif m.shape[0] != self.n_valid:
             raise ValueError(
                 f"row mask has {m.shape[0]} entries for {self.n_valid} rows"
             )
@@ -1097,7 +1121,7 @@ class _TpuCaller(_TpuCommon):
         from . import telemetry
 
         scope = _DDS_SCOPE.get()
-        if scope is None or ctx.is_spmd or force_stream:
+        if scope is None or force_stream:
             with telemetry.span("ingest", logger=stage_logger):
                 extracted = self._pre_process_data(
                     dataset, for_fit=True, defer_validation=True
@@ -1106,10 +1130,29 @@ class _TpuCaller(_TpuCommon):
                 extracted, ctx, stage_logger, force_stream, attempt=attempt
             )
         key = self._device_dataset_key(dataset, ctx)
+        allow_hit = True
+        if ctx.is_spmd:
+            # placement-fingerprint agreement, ONE rendezvous round: the
+            # cache-hit branch below runs no collectives while the miss
+            # branch runs the layout allgather, so hit/miss MUST be
+            # symmetric across ranks. Every rank votes its have-bit; the
+            # cache is used only when ALL ranks hold the exact entry —
+            # otherwise every rank takes the rebuild branch together (a
+            # rank that does hold the entry re-lands on the host-retained
+            # path: same identity, ingest skipped, symmetric layout).
+            with scope.lock:
+                have = key in scope.cache
+            votes = ctx.rendezvous.allgather(f"dds-have:{int(have)}")
+            allow_hit = all(v == "dds-have:1" for v in votes)
+            telemetry.registry().inc("fit.device_dataset_spmd_rounds")
+            # a rank that holds the entry while others miss takes the
+            # host-retained path below (`same_ingest_identity` is reflexive):
+            # its ingest is skipped but admission + layout re-run, keeping
+            # every rank's collective schedule identical
         # one builder per scope: a cache-miss build is never duplicated by a
         # concurrent fit sharing the scope
-        with scope.lock:  # held-ok: the only rendezvous reachable below (partition build allgather) is SPMD-only, and SPMD fits returned above this lock; the scope is context-local besides
-            dds = scope.cache.get(key)
+        with scope.lock:  # held-ok: the scope (and its lock) is context-local — each SPMD rank holds only its own — and the partition-build allgather below is symmetric across ranks: the pre-lock fingerprint round guarantees every rank enters the same branch
+            dds = scope.cache.get(key) if allow_hit else None
             if dds is not None:
                 scope.cache[key] = scope.cache.pop(key)  # LRU: move to newest
                 telemetry.registry().inc("fit.device_dataset_reuses")
@@ -1391,11 +1434,9 @@ class _TpuCaller(_TpuCommon):
             extracted, inputs = dds.extracted, dds.inputs
             fit_func = self._get_tpu_fit_func(extracted)
             if row_mask is not None:
-                if ctx.is_spmd:
-                    raise NotImplementedError(
-                        "row-masked fits (CrossValidator fold reuse) are "
-                        "single-controller only for now"
-                    )
+                # under SPMD each rank passes its LOCAL fold mask; the fold
+                # is the union of per-rank train rows (with_row_mask pads to
+                # the agreed local target, so shapes stay symmetric)
                 inputs = inputs.with_row_mask(row_mask)
             logger.info(
                 "fit: %d rows x %d cols on %d-device mesh (%s)%s",

@@ -19,6 +19,10 @@
 #   │                            callers can resume/diagnose
 #   ├── IngestValidationError    permanent — NaN/Inf found in an input column
 #   │                            (config["validate_ingest"]); names the column
+#   ├── MeshTopologyError        permanent — a requested mesh/sub-mesh shape
+#   │                            cannot be built over the visible devices
+#   │                            (worker count does not divide the pool, or a
+#   │                            topology axis product disagrees with it)
 #   ├── HbmBudgetError           permanent — the fit's working set cannot fit
 #   │                            device memory even on the out-of-core
 #   │                            streaming path (or a real backend OOM was
@@ -86,6 +90,7 @@ __all__ = [
     "RankFailedError",
     "SolverDivergedError",
     "IngestValidationError",
+    "MeshTopologyError",
     "HbmBudgetError",
     "NumericsError",
     "PreemptedError",
@@ -217,6 +222,39 @@ class IngestValidationError(SrmlError, ValueError):
             f"input column {column!r} contains {kind} values{at}; "
             "clean the data or disable config['validate_ingest']"
         )
+
+
+class MeshTopologyError(SrmlError, ValueError):
+    """A requested mesh shape cannot be built over the visible devices: the
+    worker count does not divide (or exceeds) the device pool, a topology's
+    axis product disagrees with the pool size, or a sub-mesh carve asks for
+    more chips than the parent mesh holds. PERMANENT — a config error, not a
+    runtime fault. Carries both sides of the mismatch so the message names
+    the requested shape AND the pool it was checked against (before this
+    error, an uneven split surfaced as an opaque numpy reshape failure)."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        requested: Optional[int] = None,
+        available: Optional[int] = None,
+        topology: Optional[Dict[str, int]] = None,
+    ):
+        # attributes BEFORE super().__init__ (flight-recorder contract above)
+        self.requested = None if requested is None else int(requested)
+        self.available = None if available is None else int(available)
+        self.topology: Dict[str, int] = dict(topology) if topology else {}
+        parts = [message]
+        if requested is not None and available is not None:
+            parts.append(
+                f"(requested {self.requested} against {self.available} "
+                "visible devices)"
+            )
+        if self.topology:
+            shape = " x ".join(f"{k}={v}" for k, v in self.topology.items())
+            parts.append(f"[topology: {shape}]")
+        super().__init__(" ".join(parts))
 
 
 class HbmBudgetError(SrmlError, MemoryError):

@@ -301,6 +301,21 @@ def _configured_chunk_rows() -> int:
         return 0
 
 
+def _claimed_chips(job_res: Any = None) -> Optional[Tuple[int, ...]]:
+    """The chip set this admission is scoped to, if any: the enclosing
+    scheduler job's PLACED reservation (2-D co-admission), else the ambient
+    `parallel.mesh.chip_scope` pin (a sweep shard or test carving a
+    sub-mesh by hand). None means the legacy whole-pool contract."""
+    if job_res is not None and getattr(job_res, "chip_ids", None) is not None:
+        return tuple(job_res.chip_ids)
+    from .parallel.mesh import current_chip_scope
+
+    scoped = current_chip_scope()
+    if scoped is None:
+        return None
+    return tuple(int(getattr(d, "id", i)) for i, d in enumerate(scoped))
+
+
 def admit_fit(
     estimator: Any,
     extracted: Any,
@@ -346,13 +361,27 @@ def admit_fit(
     if sched_demoted:
         force_stream = True
     job_res = getattr(job, "reservation", None) if job is not None else None
+    my_chips = _claimed_chips(job_res)
 
     with led.admission():
-        held = led.reserved_bytes(exclude=job_res) if budget is not None else 0
+        if budget is None:
+            held = 0
+        elif my_chips:
+            # 2-D placement: a chip-scoped fit budgets against ITS chips'
+            # byte book — bytes held by a co-admitted job on DISJOINT chips
+            # must not shrink this fit's budget, while whole-pool claims
+            # (chip_ids=None) still count everywhere
+            held = max(
+                led.reserved_bytes_on(c, exclude=job_res) for c in my_chips
+            )
+        else:
+            held = led.reserved_bytes(exclude=job_res)
         avail = None if budget is None else max(0, budget - held)
         held_note = (
             f" ({held} bytes/device already reserved in the shared ledger "
-            "by other fits/serving models)"
+            "by other fits/serving models"
+            + (" on this fit's chip set" if my_chips else "")
+            + ")"
             if held
             else ""
         )
@@ -366,7 +395,7 @@ def admit_fit(
             else:
                 reservation = led.reserve(
                     f"fit:{type(estimator).__name__}", "fit", est_obj.total(),
-                    chips=n_devices,
+                    chips=n_devices, chip_ids=my_chips,
                 )
             led.note_admission(budget)
             # one audit-trail record per admission verdict — the queryable
@@ -529,6 +558,7 @@ def admit_model_load(
     bucket_rows_count: Optional[int] = None,
     devices: Any = None,
     tenant: Optional[str] = None,
+    chip_ids: Any = None,
 ) -> AdmissionDecision:
     """Admission verdict for loading a fitted model into the serving plane
     (docs/serving.md): params get a placement estimate and a per-bucket
@@ -546,7 +576,14 @@ def admit_model_load(
     estimate there (kind "serve", released by the registry on eviction).
     `resident_bytes` remains for callers outside the registry that account
     residents themselves; the registry passes 0 (its residents already hold
-    ledger reservations)."""
+    ledger reservations).
+
+    `chip_ids` places the replica on an explicit chip set (2-D book,
+    docs/scheduling.md "2-D placement"): the byte check runs against those
+    chips' book only, and the reservation claims them EXCLUSIVELY — a
+    4-chip serving replica co-admits beside a 4-chip fit on the other half
+    of the mesh instead of serializing against it. Defaults to the ambient
+    `chip_scope` pin when one is active, else the legacy whole-pool claim."""
     from . import telemetry
     from .core import config
     from .ops_plane import audit as _audit
@@ -565,8 +602,17 @@ def admit_model_load(
         None if capacity is None else int(capacity * (1.0 - headroom_fraction()))
     )
     led = global_ledger()
+    if chip_ids is None:
+        chip_ids = _claimed_chips()
+    else:
+        chip_ids = tuple(int(c) for c in chip_ids)
     with led.admission():
-        held = led.reserved_bytes() if budget is not None else 0
+        if budget is None:
+            held = 0
+        elif chip_ids:
+            held = max(led.reserved_bytes_on(c) for c in chip_ids)
+        else:
+            held = led.reserved_bytes()
         est = model_serve_estimate(model, bucket_rows_count)
         if telemetry.enabled():
             telemetry.registry().gauge("memory.serve_estimate_bytes", est.total())
@@ -576,7 +622,7 @@ def admit_model_load(
             # thread loaded them)
             reservation = led.reserve(
                 f"serve:{type(model).__name__}", "serve", est.total(),
-                tenant=tenant,
+                tenant=tenant, chip_ids=chip_ids,
             )
             led.note_admission(budget)
             _audit.record_decision(
@@ -641,7 +687,8 @@ def rereserve_admission(adm: AdmissionDecision, owner: str = "fit:cache-hit"):
         led.resize(job_res, adm.estimate.total())
         return None
     return led.reserve(
-        owner, "fit", adm.estimate.total(), chips=getattr(adm, "chips", 1)
+        owner, "fit", adm.estimate.total(), chips=getattr(adm, "chips", 1),
+        chip_ids=_claimed_chips(),
     )
 
 

@@ -11,9 +11,13 @@
 # single-controller mode.
 #
 from .mesh import (  # noqa: F401
+    DCN_AXIS,
     ROWS_AXIS,
     bucket_rows,
     bucket_size,
+    build_mesh,
+    chip_scope,
+    current_chip_scope,
     default_devices,
     ensure_compilation_cache,
     get_mesh,
@@ -25,6 +29,7 @@ from .mesh import (  # noqa: F401
     row_sharding,
     set_devices,
     shard_row_slices,
+    submesh,
     survivor_mesh,
 )
 from .partition import PartitionDescriptor  # noqa: F401

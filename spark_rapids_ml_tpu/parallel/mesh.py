@@ -11,7 +11,8 @@
 from __future__ import annotations
 
 import contextlib
-from typing import Optional, Tuple, Union
+import contextvars
+from typing import Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -19,6 +20,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from ..errors import MeshTopologyError
 
 try:  # jax >= 0.6 exports shard_map at the top level
     from jax import shard_map  # type: ignore[attr-defined]
@@ -26,6 +28,9 @@ except ImportError:  # jax 0.4.x: experimental home, same keyword signature
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
 ROWS_AXIS = "rows"
+# outer axis of a hierarchical mesh: one step per jax.distributed process
+# group (DCN hops cross process boundaries; ICI stays inside one group)
+DCN_AXIS = "dcn"
 
 
 def pcast_varying(t, axis_name: str):
@@ -55,9 +60,41 @@ def set_devices(devices_or_platform: Union[str, list, None]) -> None:
         _DEVICE_OVERRIDE = list(devices_or_platform)
 
 
+# Context-local chip pinning: the sub-mesh placement engine runs co-admitted
+# jobs on DISJOINT chip sets concurrently, so the pin must be per
+# thread/task — `set_devices` is process-global and would race. The scope is
+# consulted FIRST by `default_devices()`: a job inside `chip_scope(chips)`
+# sees only its claimed chips, so every downstream mesh/placement/capacity
+# call lands on the claimed sub-mesh without threading a device list.
+_CHIP_SCOPE: "contextvars.ContextVar[Optional[tuple]]" = contextvars.ContextVar(
+    "srml_chip_scope", default=None
+)
+
+
+@contextlib.contextmanager
+def chip_scope(devices: Sequence):
+    """Pin `default_devices()` to an explicit chip set for the duration of
+    the with-block, context-locally (threads/tasks see their own pin). The
+    scheduler wraps each co-admitted job's fit in the job's claimed chip
+    set; tests use it to emulate a carved sub-mesh."""
+    token = _CHIP_SCOPE.set(tuple(devices))
+    try:
+        yield
+    finally:
+        _CHIP_SCOPE.reset(token)
+
+
+def current_chip_scope() -> Optional[Tuple]:
+    """The enclosing `chip_scope` pin, or None (whole pool)."""
+    return _CHIP_SCOPE.get()
+
+
 def default_devices() -> list:
     import os
 
+    scoped = _CHIP_SCOPE.get()
+    if scoped is not None:
+        return list(scoped)
     if _DEVICE_OVERRIDE is not None:
         return _DEVICE_OVERRIDE
     platform = os.environ.get("SRML_PLATFORM")
@@ -87,26 +124,187 @@ def get_mesh(num_workers: Optional[int] = None, devices=None) -> Mesh:
         devices = default_devices()
     if num_workers is None:
         num_workers = len(devices)
+    num_workers = int(num_workers)
+    if num_workers <= 0:
+        raise MeshTopologyError(
+            f"num_workers={num_workers} must be positive",
+            requested=num_workers, available=len(devices),
+        )
     if num_workers > len(devices):
-        raise ValueError(
-            f"num_workers={num_workers} exceeds visible devices ({len(devices)}); "
-            "set num_workers or start more processes"
+        raise MeshTopologyError(
+            f"num_workers={num_workers} exceeds visible devices "
+            f"({len(devices)}); set num_workers or start more processes",
+            requested=num_workers, available=len(devices),
+        )
+    if len(devices) % num_workers != 0:
+        # an uneven split used to surface as an opaque numpy reshape error
+        # deep inside row padding; refuse typed at mesh construction instead
+        raise MeshTopologyError(
+            f"num_workers={num_workers} does not divide the "
+            f"{len(devices)}-device pool evenly; pick a worker count that "
+            "divides the device count (or carve an explicit sub-mesh with "
+            "submesh()/chip_scope())",
+            requested=num_workers, available=len(devices),
         )
     return Mesh(np.asarray(devices[:num_workers]), (ROWS_AXIS,))
 
 
+def build_mesh(
+    topology: Optional[Dict[str, int]] = None, devices=None
+) -> Mesh:
+    """Build the framework mesh, hierarchically when asked.
+
+    ``topology=None`` (default) is the flat 1-D `rows` mesh over every
+    visible device — exactly `get_mesh()`. A topology dict composes an ICI
+    axis with a DCN axis: ``{"dcn": D, "rows": R}`` builds a 2-D
+    ``(dcn, rows)`` `jax.sharding.Mesh` whose outer axis steps across
+    `jax.distributed` process groups (devices are stably grouped by
+    `process_index`, so each DCN row is one host's ICI-connected chips) and
+    whose inner axis is the per-group chip count. Either axis may be 0/absent
+    ("auto"): `dcn` defaults to the process-group count, `rows` to the
+    remaining factor. The axis product must cover the pool exactly — a
+    mismatch raises the typed `MeshTopologyError` naming both sides.
+
+    Fold grids vmap under `shard_map` over the inner `rows` axis of the
+    result (or of a `submesh()` carved from it); collectives along `dcn`
+    cross the data-center network and stay in the control plane."""
+    if topology is None:
+        # the config knob is the deployment-wide default; an explicit
+        # argument (even {}) wins
+        from ..core import config
+
+        topology = config.get("mesh_topology")
+    if devices is None:
+        devices = default_devices()
+    devices = list(devices)
+    if not topology:
+        return get_mesh(len(devices), devices)
+    unknown = set(topology) - {DCN_AXIS, ROWS_AXIS}
+    if unknown:
+        raise MeshTopologyError(
+            f"unknown topology axes {sorted(unknown)}; expected "
+            f"{DCN_AXIS!r} and/or {ROWS_AXIS!r}",
+            topology={k: int(v) for k, v in topology.items()},
+        )
+    # stable process grouping: jax.devices() is process-ordered already, but
+    # an explicit device list may not be — sort stably so each DCN row holds
+    # one process group's ICI-connected chips
+    devices.sort(key=lambda d: int(getattr(d, "process_index", 0)))
+    n_groups = len({int(getattr(d, "process_index", 0)) for d in devices})
+    dcn = int(topology.get(DCN_AXIS) or 0)
+    rows = int(topology.get(ROWS_AXIS) or 0)
+    if dcn <= 0 and rows <= 0:
+        dcn = max(1, n_groups)
+    if dcn <= 0:
+        dcn = len(devices) // rows if rows and len(devices) % rows == 0 else 0
+    if rows <= 0:
+        rows = len(devices) // dcn if dcn and len(devices) % dcn == 0 else 0
+    if dcn <= 0 or rows <= 0 or dcn * rows != len(devices):
+        raise MeshTopologyError(
+            "topology axis product must cover the device pool exactly",
+            requested=(dcn * rows) if dcn > 0 and rows > 0 else None,
+            available=len(devices),
+            topology={DCN_AXIS: dcn, ROWS_AXIS: rows},
+        )
+    if telemetry.enabled():
+        telemetry.registry().inc("mesh.hierarchical_builds")
+    grid = np.empty((dcn, rows), dtype=object)
+    for i, d in enumerate(devices):
+        grid[i // rows, i % rows] = d
+    return Mesh(grid, (DCN_AXIS, ROWS_AXIS))
+
+
+def submesh(mesh: Mesh, chips: Union[int, Sequence]) -> Mesh:
+    """Carve a CONTIGUOUS chip subset out of `mesh` as a 1-D `rows`
+    sub-mesh — the unit the 2-D scheduler places fits, serving replicas,
+    and sweep shards on, so disjoint carves own disjoint chips concurrently.
+
+    `chips` is an int (the first N chips in mesh order) or an explicit
+    sequence of mesh-order indices / device objects. Contiguity (in the
+    parent's flattened order, i.e. ICI-neighbor runs within a DCN row) is
+    enforced: a gapped carve raises `MeshTopologyError` — scattered chips
+    would silently route ICI collectives over DCN."""
+    flat = list(mesh.devices.flatten())
+    if isinstance(chips, (int, np.integer)):
+        n = int(chips)
+        if n <= 0 or n > len(flat):
+            raise MeshTopologyError(
+                f"submesh: cannot carve {n} chips from a "
+                f"{len(flat)}-chip mesh",
+                requested=n, available=len(flat),
+            )
+        picked = flat[:n]
+    else:
+        by_id = {id(d): i for i, d in enumerate(flat)}
+        idx = []
+        for c in chips:
+            if isinstance(c, (int, np.integer)):
+                i = int(c)
+                if i < 0 or i >= len(flat):
+                    raise MeshTopologyError(
+                        f"submesh: chip index {i} out of range",
+                        requested=i, available=len(flat),
+                    )
+            else:
+                if id(c) not in by_id:
+                    raise MeshTopologyError(
+                        f"submesh: device {c} is not part of the parent mesh",
+                        available=len(flat),
+                    )
+                i = by_id[id(c)]
+            idx.append(i)
+        if not idx:
+            raise MeshTopologyError(
+                "submesh: empty chip set", requested=0, available=len(flat)
+            )
+        idx.sort()
+        if len(set(idx)) != len(idx) or idx[-1] - idx[0] + 1 != len(idx):
+            raise MeshTopologyError(
+                f"submesh: chip set {idx} is not a contiguous run in the "
+                "parent mesh order",
+                requested=len(idx), available=len(flat),
+            )
+        picked = [flat[i] for i in idx]
+    if telemetry.enabled():
+        telemetry.registry().inc("mesh.submesh_carves")
+    return Mesh(np.asarray(picked), (ROWS_AXIS,))
+
+
 def survivor_mesh(mesh: Mesh, dead_process_indices) -> Mesh:
-    """Rebuild a 1-D `rows` mesh over the devices NOT owned by the dead
-    processes — the re-sharding half of elastic recovery: under GSPMD a rank
-    loss is a mesh + placement change, not a solver rewrite
-    (docs/robustness.md "Elastic recovery"). Raises when no devices survive."""
+    """Rebuild a mesh over the devices NOT owned by the dead processes — the
+    re-sharding half of elastic recovery: under GSPMD a rank loss is a mesh +
+    placement change, not a solver rewrite (docs/robustness.md "Elastic
+    recovery"). Raises when no devices survive.
+
+    Composes with the hierarchical/sub-mesh substrate: a 1-D mesh (whole
+    pool OR a `submesh()` carve — a sweep shard that loses a host re-meshes
+    its own sub-mesh, not the whole pool) survives as a 1-D `rows` mesh over
+    the remaining chips; a 2-D `(dcn, rows)` mesh keeps its hierarchy when
+    whole DCN rows die, and degrades to the flat 1-D survivors otherwise
+    (a ragged 2-D grid is not a mesh)."""
     dead = {int(p) for p in dead_process_indices}
     devices = [d for d in mesh.devices.flatten() if int(d.process_index) not in dead]
     if not devices:
-        raise ValueError("survivor_mesh: no devices remain after excluding "
-                         f"processes {sorted(dead)}")
+        raise MeshTopologyError(
+            "survivor_mesh: no devices remain after excluding processes "
+            f"{sorted(dead)}",
+            requested=0, available=0,
+        )
     if telemetry.enabled():
         telemetry.registry().inc("recovery.mesh_rebuilds")
+    if mesh.devices.ndim == 2:
+        rows = [
+            list(row)
+            for row in mesh.devices
+            if all(int(d.process_index) not in dead for d in row)
+        ]
+        if rows and len(rows) * len(rows[0]) == len(devices):
+            # only whole DCN rows died: the hierarchy survives intact
+            grid = np.empty((len(rows), len(rows[0])), dtype=object)
+            for i, row in enumerate(rows):
+                for j, d in enumerate(row):
+                    grid[i, j] = d
+            return Mesh(grid, mesh.axis_names)
     return Mesh(np.asarray(devices), (ROWS_AXIS,))
 
 

@@ -37,7 +37,7 @@ import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..utils import lockcheck
 
@@ -72,7 +72,15 @@ class HbmReservation:
     `tenant` and `chips` feed the per-tenant accounting (docs/observability.md
     "Ops plane"): the ledger integrates ``nbytes x seconds-held`` (HBM
     byte-seconds) and ``chips x seconds-held`` (chip-seconds) per tenant —
-    `t0`/`mark` are the integration anchors (monotonic clock)."""
+    `t0`/`mark` are the integration anchors (monotonic clock).
+
+    `chip_ids` is the PLACEMENT half of the 2-D book (docs/scheduling.md
+    "2-D placement"): when set, the claim owns exactly those chips — byte
+    budgeting applies per claimed chip, and occupancy is EXCLUSIVE (a second
+    chip-scoped claim overlapping any of them is refused even with byte
+    headroom, because two SPMD programs cannot time-share a chip without
+    serializing). None keeps the 1-D contract: bytes span the whole pool,
+    `chips` stays a pure accounting multiplier."""
 
     owner: str
     kind: str  # "fit" | "serve" | "job"
@@ -81,6 +89,7 @@ class HbmReservation:
     active: bool = True
     tenant: str = "default"
     chips: int = 1
+    chip_ids: Optional[Tuple[int, ...]] = None
     t0: float = 0.0
     mark: float = 0.0  # last byte-seconds integration point
 
@@ -102,6 +111,10 @@ class HbmLedger:
         self._ids = itertools.count(1)
         self.high_watermark: int = 0
         self.last_budget: Optional[int] = None
+        # chip pool size for the occupancy half of the 2-D book (None until
+        # a scheduler/test announces it via note_chip_pool) — the
+        # denominator of chip-weighted utilization and the chips_idle gauge
+        self.total_chips: Optional[int] = None
         self.admission_hooks: List[Callable[[int, Optional[int]], None]] = []
         # per-tenant integrated usage (byte-seconds / chip-seconds across
         # released AND resized claims; tenant_usage() adds the live ones)
@@ -135,12 +148,63 @@ class HbmLedger:
         with self._lock:
             return [r for r in self._by_id.values() if r.active]
 
+    def reserved_bytes_on(
+        self, chip: int, *, exclude: Optional[HbmReservation] = None
+    ) -> int:
+        """Active reserved bytes charged against ONE chip: chip-scoped
+        claims count where they placed; legacy (chip_ids=None) claims span
+        the whole pool, so they count on every chip — the conservative
+        reading that keeps 1-D and 2-D claims honest against each other."""
+        with self._lock:
+            return sum(
+                r.nbytes
+                for r in self._by_id.values()
+                if r.active
+                and r is not exclude
+                and (r.chip_ids is None or int(chip) in r.chip_ids)
+            )
+
+    def occupied_chips(
+        self, *, exclude: Optional[HbmReservation] = None
+    ) -> Set[int]:
+        """Chip ids exclusively claimed by active chip-scoped reservations —
+        the occupancy half of the 2-D book. Legacy claims (chip_ids=None)
+        do not occupy: they budget bytes only, the pre-placement contract."""
+        with self._lock:
+            out: Set[int] = set()
+            for r in self._by_id.values():
+                if r.active and r is not exclude and r.chip_ids is not None:
+                    out.update(r.chip_ids)
+            return out
+
+    def note_chip_pool(self, total_chips: Optional[int]) -> None:
+        """Announce the chip pool size (scheduler passes do; tests may).
+        Feeds chip-weighted utilization and the chips_idle gauge."""
+        with self._lock:
+            self.total_chips = None if total_chips is None else int(total_chips)
+
     def utilization(self) -> Optional[float]:
-        """reserved / last-known budget, or None while no budget was ever
-        observed (CPU without an `hbm_budget_bytes` override)."""
+        """Reserved share of the budget, or None while no budget was ever
+        observed (CPU without an `hbm_budget_bytes` override).
+
+        With a known chip pool this is CHIP-WEIGHTED occupancy:
+        ``sum(nbytes x chips) / (budget x total_chips)`` — a 4-chip fit on
+        an 8-chip mesh reads as half the pool-bytes it actually holds, where
+        the pre-2-D formula read it as whole-mesh utilization (the claim's
+        bytes against one device's budget, chips ignored). Without a pool
+        announcement the legacy per-device reading is kept."""
         with self._lock:
             if not self.last_budget:
                 return None
+            total = self.total_chips
+            if total:
+                weighted = sum(
+                    r.nbytes
+                    * (len(r.chip_ids) if r.chip_ids is not None else min(r.chips, total))
+                    for r in self._by_id.values()
+                    if r.active
+                )
+                return weighted / float(self.last_budget * total)
             return self.reserved_bytes() / float(self.last_budget)
 
     def tenant_usage(self) -> Dict[str, Dict[str, float]]:
@@ -160,12 +224,40 @@ class HbmLedger:
             out: Dict[str, Dict[str, float]] = {}
             for tenant, u in self._tenant_usage.items():
                 out[tenant] = dict(u)
+            busy_union: Set[int] = set()  # chips exclusively claimed pool-wide
+            legacy_span = 0  # widest chips multiplier among unplaced claims
+            per_tenant_chips: Dict[str, Set[int]] = {}
+            per_tenant_legacy: Dict[str, int] = {}
             for r in self._by_id.values():
                 if not r.active:
                     continue
                 u = out.setdefault(r.tenant, _fresh_usage())
                 u["live_bytes"] = u.get("live_bytes", 0.0) + r.nbytes
                 u["live_reservations"] = u.get("live_reservations", 0.0) + 1
+                if r.chip_ids is not None:
+                    busy_union.update(r.chip_ids)
+                    per_tenant_chips.setdefault(r.tenant, set()).update(r.chip_ids)
+                else:
+                    legacy_span = max(legacy_span, r.chips)
+                    per_tenant_legacy[r.tenant] = max(
+                        per_tenant_legacy.get(r.tenant, 0), r.chips
+                    )
+            # chips_busy per tenant: the chips its placed claims own, or the
+            # widest unplaced claim's span (unplaced claims share the pool,
+            # so summing them would double count)
+            for tenant, u in out.items():
+                placed = per_tenant_chips.get(tenant)
+                if placed is not None:
+                    u["chips_busy"] = float(len(placed))
+                elif tenant in per_tenant_legacy:
+                    u["chips_busy"] = float(per_tenant_legacy[tenant])
+            total = self.total_chips
+            pool = out.setdefault("_pool", _fresh_usage())
+            pool_busy = max(len(busy_union), legacy_span)
+            pool["chips_busy"] = float(pool_busy)
+            if total is not None:
+                pool["chips_total"] = float(total)
+                pool["chips_idle"] = float(max(0, total - pool_busy))
         # outside the ledger lock: the efficiency module has its own lock
         # (never import it from here — probe, so the accounting plane stays
         # optional and import-cycle-free)
@@ -207,18 +299,26 @@ class HbmLedger:
         *,
         tenant: Optional[str] = None,
         chips: int = 1,
+        chip_ids: Optional[Sequence[int]] = None,
     ) -> HbmReservation:
         """Unconditional bookkeeping reserve — admission logic (memory.py)
         decides WHETHER; this records THAT. Updates the high watermark and
         the `scheduler.ledger_reserved_bytes` gauge. `tenant` defaults to
         the enclosing scheduler job's tenant (or "default") so standalone
-        fits are accounted too."""
+        fits are accounted too. A `chip_ids` claim places the reservation on
+        exactly those chips (2-D book; `chips` follows the set's size)."""
         if tenant is None:
             tenant = _current_tenant()
         now = _now()
+        placed = (
+            None if chip_ids is None else tuple(sorted(int(c) for c in chip_ids))
+        )
+        if placed is not None:
+            chips = len(placed)
         r = HbmReservation(
             owner=owner, kind=kind, nbytes=max(0, int(nbytes)),
-            tenant=str(tenant), chips=max(1, int(chips)), t0=now, mark=now,
+            tenant=str(tenant), chips=max(1, int(chips)), chip_ids=placed,
+            t0=now, mark=now,
         )
         with self._lock:
             r.rid = next(self._ids)
@@ -238,16 +338,37 @@ class HbmLedger:
         exclude: Optional[HbmReservation] = None,
         tenant: Optional[str] = None,
         chips: int = 1,
+        chip_ids: Optional[Sequence[int]] = None,
     ) -> Optional[HbmReservation]:
         """Atomic check-then-reserve: None when ``held + nbytes`` would
         exceed `budget` (a None budget always admits — no capacity
-        information means no budgeting, the pre-ledger contract)."""
+        information means no budgeting, the pre-ledger contract).
+
+        With `chip_ids` the check is 2-D: occupancy first (any requested
+        chip already exclusively claimed -> refused, even with byte
+        headroom everywhere — chips don't time-share), then bytes PER
+        CLAIMED CHIP (held-on-that-chip + nbytes against the per-device
+        budget). Without `chip_ids` the legacy whole-pool byte check is
+        kept — conservative against placed claims, which count on every
+        chip they own and an unplaced claim spans them all."""
         with self._lock:
-            if budget is not None:
+            if chip_ids is not None:
+                want = {int(c) for c in chip_ids}
+                if want & self.occupied_chips(exclude=exclude):
+                    return None
+                if budget is not None:
+                    nb = max(0, int(nbytes))
+                    for chip in want:
+                        if self.reserved_bytes_on(chip, exclude=exclude) + nb > budget:
+                            return None
+            elif budget is not None:
                 held = self.reserved_bytes(exclude=exclude)
                 if held + max(0, int(nbytes)) > budget:
                     return None
-            return self.reserve(owner, kind, nbytes, tenant=tenant, chips=chips)
+            return self.reserve(
+                owner, kind, nbytes,
+                tenant=tenant, chips=chips, chip_ids=chip_ids,
+            )
 
     def resize(self, r: HbmReservation, nbytes: int) -> None:
         """True an existing claim up (or down) to `nbytes` — the scheduler
@@ -258,6 +379,24 @@ class HbmLedger:
         with self._lock:
             self._accrue_locked(r, _now())
             r.nbytes = max(0, int(nbytes))
+            self._note_locked()
+
+    def rebind(
+        self, r: HbmReservation, chip_ids: Optional[Sequence[int]]
+    ) -> None:
+        """Re-point a claim at a different chip set — the sub-mesh resize
+        move (a recovered sweep re-meshing onto survivors, a resumed job
+        landing on a different equal-width run). Like `resize`, bookkeeping
+        only: the caller validated occupancy/bytes under `admission()`. The
+        interval up to now accrues at the OLD width (those were the chips
+        held)."""
+        with self._lock:
+            self._accrue_locked(r, _now())
+            if chip_ids is None:
+                r.chip_ids = None
+            else:
+                r.chip_ids = tuple(sorted(int(c) for c in chip_ids))
+                r.chips = max(1, len(r.chip_ids))
             self._note_locked()
 
     def release(self, r: Optional[HbmReservation]) -> None:
@@ -288,10 +427,26 @@ class HbmLedger:
                 self.last_budget = int(budget)
             reserved = self.reserved_bytes()
             last = self.last_budget
-        if telemetry.enabled() and last:
-            telemetry.registry().gauge(
-                "scheduler.ledger_utilization", reserved / float(last)
-            )
+            total = self.total_chips
+            busy = len(self.occupied_chips())
+            if busy == 0:
+                busy = max(
+                    (r.chips for r in self._by_id.values() if r.active),
+                    default=0,
+                )
+                if total is not None:
+                    busy = min(busy, total)
+        if telemetry.enabled():
+            if last:
+                telemetry.registry().gauge(
+                    "scheduler.ledger_utilization",
+                    self.utilization() or 0.0,
+                )
+            telemetry.registry().gauge("scheduler.chips_busy", busy)
+            if total is not None:
+                telemetry.registry().gauge(
+                    "scheduler.chips_idle", max(0, total - busy)
+                )
         for hook in list(self.admission_hooks):
             hook(reserved, budget)
 

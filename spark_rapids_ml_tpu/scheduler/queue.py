@@ -103,6 +103,12 @@ class FitJob:
         self.resident_estimate: Any = None
         self.stream_floor_estimate: Any = None
         self.chips = 1
+        # 2-D placement (docs/scheduling.md "2-D placement"): which chips
+        # the admitted reservation owns, and the matching device objects the
+        # runner pins via parallel.mesh.chip_scope — None when the scheduler
+        # runs in the legacy bytes-only mode (config["sched_chip_placement"])
+        self.chip_ids: Any = None
+        self.placed_devices: Any = None
         self._preempt = threading.Event()
         self._preempt_reason = ""
         self._done = threading.Event()
@@ -161,6 +167,8 @@ class FitJob:
             "demoted": self.demoted,
             "admitted_bytes": self.admitted_bytes,
             "hbm_share": self.hbm_share,
+            "chips": self.chips,
+            "chip_ids": list(self.chip_ids) if self.chip_ids is not None else None,
         }
 
     def _finish(self, model: Any) -> None:
@@ -191,10 +199,19 @@ class FitScheduler:
         ledger: Optional[HbmLedger] = None,
         max_concurrent: Optional[int] = None,
         max_preemptions: Optional[int] = None,
+        chip_placement: Optional[bool] = None,
     ) -> None:
         from ..core import config
 
         self._ledger = ledger if ledger is not None else global_ledger()
+        # 2-D placement mode: claims name WHICH chips (contiguous first-fit
+        # runs) and jobs run pinned to them via chip_scope, so two half-mesh
+        # fits genuinely overlap instead of time-slicing the whole mesh
+        self._chip_placement = bool(
+            chip_placement
+            if chip_placement is not None
+            else config.get("sched_chip_placement", False)
+        )
         self._max_concurrent = int(
             max_concurrent
             if max_concurrent is not None
@@ -332,11 +349,45 @@ class FitScheduler:
             return int(job.stream_floor_estimate.total())
         return int(resident)
 
+    def _chip_pool(self) -> List[Any]:
+        from ..parallel.mesh import default_devices
+
+        return list(default_devices())
+
+    @staticmethod
+    def _chip_id(device: Any, index: int) -> int:
+        return int(getattr(device, "id", index))
+
+    def _place_job_locked(
+        self, job: FitJob, need: int, budget: Optional[int], pool: List[Any]
+    ) -> Optional[Any]:
+        """2-D admission for one job (caller holds the ledger's admission
+        lock): first-fit over CONTIGUOUS chip runs of the job's width, in
+        pool order. `try_reserve(chip_ids=...)` is the 2-D check — occupancy
+        exclusivity plus per-chip bytes — so a window that fails either
+        dimension just slides right. Returns the reservation (with the
+        chosen chips recorded on the job) or None when no run fits."""
+        width = max(1, min(int(job.chips), len(pool)))
+        for start in range(0, len(pool) - width + 1):
+            window = pool[start:start + width]
+            chip_ids = [self._chip_id(d, start + i) for i, d in enumerate(window)]
+            r = self._ledger.try_reserve(
+                f"job:{job.job_id}:{job.tenant}", "job", need,
+                budget=budget, tenant=job.tenant, chip_ids=chip_ids,
+            )
+            if r is not None:
+                job.chip_ids = tuple(chip_ids)
+                job.placed_devices = list(window)
+                return r
+        return None
+
     def _schedule_locked(self) -> None:
         """One co-admission pass (caller holds `self._lock`): first-fit over
         the priority-ordered queue under the ledger's admission lock, with
         preemption for a blocked higher-priority head and bin-packing
-        backfill otherwise."""
+        backfill otherwise. In 2-D placement mode the first-fit is over
+        contiguous chip runs as well as bytes, so jobs of disjoint widths
+        co-admit onto disjoint chip sets instead of queueing."""
         from .. import telemetry
 
         if self._closed:
@@ -345,15 +396,20 @@ class FitScheduler:
         self._queue.sort(key=lambda j: (-j.priority, j.job_id))  # FIFO tiebreak
         reg = telemetry.registry()
         to_start: List[FitJob] = []
+        pool = self._chip_pool() if self._chip_placement else []
+        self._ledger.note_chip_pool(len(pool) if self._chip_placement else None)
         with self._ledger.admission():
             for job in list(self._queue):
                 if len(self._running) + len(to_start) >= self._max_concurrent:
                     break
                 need = self._need_bytes(job, budget)
-                r = self._ledger.try_reserve(
-                    f"job:{job.job_id}:{job.tenant}", "job", need,
-                    budget=budget, tenant=job.tenant, chips=job.chips,
-                )
+                if self._chip_placement:
+                    r = self._place_job_locked(job, need, budget, pool)
+                else:
+                    r = self._ledger.try_reserve(
+                        f"job:{job.job_id}:{job.tenant}", "job", need,
+                        budget=budget, tenant=job.tenant, chips=job.chips,
+                    )
                 self._ledger.note_admission(budget)
                 if r is not None:
                     job.reservation = r
@@ -436,6 +492,10 @@ class FitScheduler:
         held = self._ledger.reserved_bytes()
         if held - freeable + need > budget:
             return False  # even evicting every lower-priority fit cannot make room
+        if self._chip_placement and not self._chips_freeable_locked(job, victims):
+            return False  # room in bytes but not in chips: non-victim claims
+            # (serving replicas, higher-priority fits) pin every contiguous
+            # run of the job's width, so eviction cannot place it either
         pending = [v for v in victims if v.preempt_requested()]
         if pending:
             return True  # already waiting on a boundary
@@ -459,6 +519,25 @@ class FitScheduler:
         )
         return True
 
+    def _chips_freeable_locked(self, job: FitJob, victims: List[FitJob]) -> bool:
+        """Chip-dimension half of the preemption feasibility check: after
+        evicting every lower-priority victim, does a contiguous run of the
+        job's width open up? Occupancy held by NON-victims (serving
+        replicas, equal/higher-priority fits) stays pinned."""
+        victim_chips = set()
+        for v in victims:
+            if v.reservation is not None and v.reservation.chip_ids is not None:
+                victim_chips.update(v.reservation.chip_ids)
+        pinned = self._ledger.occupied_chips() - victim_chips
+        pool = self._chip_pool()
+        width = max(1, min(int(job.chips), len(pool)))
+        run = 0
+        for i, d in enumerate(pool):
+            run = 0 if self._chip_id(d, i) in pinned else run + 1
+            if run >= width:
+                return True
+        return False
+
     # ----------------------------------------------------------- running --
     def _run_job(self, job: FitJob) -> None:
         """Worker-thread body: the whole fit inside `job_scope` (so
@@ -468,10 +547,22 @@ class FitScheduler:
         from .. import checkpoint as _ckpt
         from .. import telemetry
 
+        import contextlib
+
+        from ..parallel.mesh import chip_scope
+
         reg = telemetry.registry()
         requeue = False
+        # 2-D placement: the fit sees ONLY its claimed chips — every
+        # downstream mesh/placement/capacity call lands on the claimed
+        # sub-mesh, so co-admitted jobs genuinely overlap on disjoint chips
+        pin = (
+            chip_scope(job.placed_devices)
+            if job.placed_devices
+            else contextlib.nullcontext()
+        )
         try:
-            with job_scope(job), _ckpt.checkpoint_scope(store=job.store):
+            with pin, job_scope(job), _ckpt.checkpoint_scope(store=job.store):
                 if job.warm_start_from is not None:
                     model = job.estimator.fit(
                         job.dataset, warm_start_from=job.warm_start_from
@@ -506,6 +597,12 @@ class FitScheduler:
                     job._run_since = None
                 self._ledger.release(job.reservation)
                 job.reservation = None
+                # the claim's chips return to the pool with the bytes; a
+                # resumed job re-places first-fit — possibly on a DIFFERENT
+                # equal-width run (checkpoints are chip-set agnostic:
+                # host-side solver state re-placed at restore)
+                job.chip_ids = None
+                job.placed_devices = None
                 if requeue and not self._closed:
                     job.preemptions += 1
                     job._preempt.clear()
@@ -592,6 +689,8 @@ class FitScheduler:
             "ledger_reserved_bytes": self._ledger.reserved_bytes(),
             "ledger_high_watermark": self._ledger.high_watermark,
             "ledger_utilization": self._ledger.utilization(),
+            "ledger_occupied_chips": sorted(self._ledger.occupied_chips()),
+            "chip_placement": self._chip_placement,
             "tenant_usage": self._ledger.tenant_usage(),
         }
 

@@ -138,17 +138,52 @@ def sweep_ledger(trace_id: str) -> Optional[SweepLedger]:
 
 
 def _engine_eligible(est) -> bool:
-    """Whether the device-resident multi-fit engine can run this tuning job:
-    the estimator supports the fused evaluate path AND we are
-    single-controller (fold weight masks index GLOBAL rows; under
-    multi-process SPMD each rank holds only a local block, so those jobs
-    take the per-fold fitMultiple path instead)."""
+    """Whether the device-resident multi-fit engine can run this tuning job.
+
+    Single-controller: any `_TpuEstimator`. Under multi-process SPMD the
+    engine runs too — fold masks are LOCAL row masks (each rank masks its
+    own block, `FitInputs.with_row_mask` pads to the agreed local target)
+    and held-out scoring allgathers the validation slices so every rank
+    picks the same winner — provided the estimator supports SPMD fits at
+    all, and the ingest is dense (the scoring gather is a dense-block
+    control-plane allgather; sparse sweeps keep the per-fold path)."""
     from .parallel import TpuContext
 
     if not isinstance(est, _TpuEstimator):
         return False
     active = TpuContext.current()
-    return active is None or not active.is_spmd
+    if active is None or not active.is_spmd:
+        return True
+    if not getattr(est, "_supports_multiprocess", False):
+        return False
+    sparse = (
+        est.getOrDefault("enable_sparse_data_optim")
+        if est.hasParam("enable_sparse_data_optim")
+        else False
+    )
+    return not bool(sparse)
+
+
+def _gather_validation(feats, labels):
+    """Held-out blocks for engine scoring, globalized under multi-process
+    SPMD: every rank allgathers every rank's validation slice over the
+    string control plane and scores the SAME rows, so fold metrics — and
+    therefore the winning param map — agree across ranks with no device
+    collective. Identity in single-controller mode."""
+    from .parallel import TpuContext
+
+    active = TpuContext.current()
+    if active is None or not active.is_spmd:
+        return feats, labels
+    from .parallel.context import allgather_ndarray
+
+    feats = np.concatenate(
+        allgather_ndarray(active.rendezvous, np.ascontiguousarray(feats)), axis=0
+    )
+    labels = np.concatenate(
+        allgather_ndarray(active.rendezvous, np.ascontiguousarray(labels)), axis=0
+    )
+    return feats, labels
 
 
 class ParamGridBuilder:
@@ -401,9 +436,11 @@ class CrossValidator(_ValidatorParams):
                     if collect_sub:
                         sub_models[fold_i] = models
                     combined = models[0]._combine(models)
-                    feats = scope.last.extracted.features[valid_idx]
+                    feats, yv = _gather_validation(
+                        scope.last.extracted.features[valid_idx], labels[valid_idx]
+                    )
                     scores = np.asarray(
-                        combined._transform_evaluate_arrays(feats, labels[valid_idx], eva)
+                        combined._transform_evaluate_arrays(feats, yv, eva)
                     )
                     ledger.complete_fold(fold_i, scores, models if collect_sub else None)
                     return scores
@@ -665,9 +702,11 @@ class TrainValidationSplit(_ValidatorParams):
                         )
                     models = est._fit_internal(pdf, list(epm), row_mask=mask)
                     combined = models[0]._combine(models)
-                    feats = scope.last.extracted.features[valid_idx]
+                    feats, yv = _gather_validation(
+                        scope.last.extracted.features[valid_idx], labels[valid_idx]
+                    )
                     metrics = np.asarray(
-                        combined._transform_evaluate_arrays(feats, labels[valid_idx], eva)
+                        combined._transform_evaluate_arrays(feats, yv, eva)
                     )
                     ledger.complete_fold(0, metrics, models if collect_sub else None)
                     return metrics, models

@@ -1,0 +1,102 @@
+#
+# Worker script for the SPMD-batched sweep test (launched as a subprocess by
+# tests/test_multiprocess.py; the `sweep_` prefix keeps pytest from collecting
+# it as a test module).
+#
+# Each process holds a RAGGED local row block and runs ONE CrossValidator
+# sweep through the device-resident multi-fit engine under
+# TpuContext(require_distributed=True): fold masks are local row masks,
+# held-out scoring allgathers every rank's validation slice, and DeviceDataset
+# placement fingerprints are agreed over one rendezvous round per fit. The
+# worker asserts the sweep's data-plane telemetry IN-PROCESS (exactly one
+# ingest and one layout for the whole sweep, per rank) and saves the metric
+# grid + winner so the parent can assert cross-rank agreement.
+#
+import os
+import sys
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nranks = int(sys.argv[2])
+    rdv_dir = sys.argv[3]
+    out_dir = sys.argv[4]
+    run_id = sys.argv[5] if len(sys.argv) > 5 else None
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import telemetry
+    from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+    from spark_rapids_ml_tpu.models.regression import LinearRegression
+    from spark_rapids_ml_tpu.parallel import FileRendezvous, TpuContext
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    X, y = make_dataset()
+    bounds = split_bounds(len(X), nranks)
+    lo, hi = bounds[rank], bounds[rank + 1]
+    df = pd.DataFrame({"features": list(X[lo:hi]), "label": y[lo:hi]})
+
+    telemetry.enable()
+    telemetry.registry().reset()
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(
+        lr.getParam("regParam"), [0.0, 0.1, 1.0]
+    ).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(metricName="rmse"), numFolds=3, seed=1,
+    )
+    rdv = FileRendezvous(rank, nranks, rdv_dir, timeout_s=120.0, run_id=run_id)
+    with TpuContext(rank, nranks, rdv, require_distributed=True):
+        model = cv.fit(df)
+
+    # the acceptance pin, asserted per rank from this rank's own registry:
+    # the WHOLE numFolds x paramMaps sweep performed exactly ONE ingest and
+    # ONE layout — the engine did not fall back to per-fold fits under SPMD
+    snap = telemetry.registry().snapshot()
+    c, s = snap["counters"], snap["spans"]
+    assert c["ingest.datasets"] == 1, c
+    assert s["fit/ingest"]["count"] == 1, s
+    assert s["fit/layout"]["count"] == 1, s
+    assert c["fit.device_dataset_builds"] == 1, c
+    assert c["fit.device_dataset_reuses"] == 3, c  # folds 1-2 + best refit
+    # placement-fingerprint agreement ran one rendezvous round per fit
+    assert c["fit.device_dataset_spmd_rounds"] >= 4, c
+
+    best_reg = float(model.bestModel.getOrDefault("regParam"))
+    np.savez(
+        os.path.join(out_dir, f"rank{rank}.npz"),
+        avg_metrics=np.asarray(model.avgMetrics, dtype=np.float64),
+        best_reg=np.asarray(best_reg),
+        best_coef=np.asarray(model.bestModel.coef_),
+        spmd_rounds=np.asarray(int(c["fit.device_dataset_spmd_rounds"])),
+    )
+
+
+def make_dataset():
+    """Deterministic regression data with a real ridge-path optimum."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    n, d = 150, 5
+    X = rng.normal(size=(n, d))
+    coef = np.array([1.0, -2.0, 0.0, 0.5, 3.0])
+    y = X @ coef + 0.3 * rng.normal(size=n)
+    return X, y
+
+
+def split_bounds(n, nranks):
+    """Deliberately ragged split: rank 0 gets ~60% of the rows."""
+    bounds = [0]
+    big = int(n * 0.6)
+    rest = n - big
+    per = rest // max(1, nranks - 1) if nranks > 1 else 0
+    bounds.append(big if nranks > 1 else n)
+    for r in range(1, nranks):
+        bounds.append(bounds[-1] + (per if r < nranks - 1 else n - bounds[-1]))
+    return bounds
+
+
+if __name__ == "__main__":
+    main()

@@ -612,6 +612,112 @@ def test_spmd_rank_dependent_payload_passes():
     assert fs == []
 
 
+def test_spmd_submesh_scoped_full_mesh_collective_is_flagged():
+    # PR 19: a full-clique control-plane round reachable only from sub-mesh
+    # scoped code strands the ranks outside the carve — placement-induced
+    # divergence, same hang as a rank conditional
+    fs = run(
+        """
+        from spark_rapids_ml_tpu.parallel.mesh import chip_scope
+
+        def f(devs, rdv):
+            with chip_scope(devs):
+                rdv.allgather("x")
+        """,
+        SpmdDivergenceRule,
+    )
+    assert rule_ids(fs) == ["spmd-divergence"]
+    assert "sub-mesh scope `chip_scope(...)`" in fs[0].message
+    assert "# submesh-ok" in fs[0].message
+
+
+def test_spmd_submesh_carve_with_as_binding_is_flagged():
+    fs = run(
+        """
+        from spark_rapids_ml_tpu.parallel import submesh
+
+        def f(mesh, ctx):
+            with submesh(mesh, 4) as sub:
+                ctx.barrier()
+        """,
+        SpmdDivergenceRule,
+    )
+    assert rule_ids(fs) == ["spmd-divergence"]
+    assert "submesh(...)" in fs[0].message
+
+
+def test_spmd_submesh_waiver_suppresses_and_scope_exit_clears():
+    # FP guards: a reasoned `# submesh-ok` waives the deliberate full-group
+    # round, and collectives AFTER the carve (full mesh restored) are clean
+    fs = run(
+        """
+        from spark_rapids_ml_tpu.parallel.mesh import chip_scope
+
+        def f(devs, rdv):
+            with chip_scope(devs):
+                rdv.allgather("done")  # submesh-ok: whole clique joins the report round
+            rdv.barrier()
+        """,
+        SpmdDivergenceRule,
+    )
+    assert fs == []
+
+
+def test_spmd_submesh_waiver_is_tag_specific_and_needs_a_reason():
+    # a `# spmd-ok` reason does NOT waive the sub-mesh finding (different
+    # failure, different tag), and a bare `# submesh-ok` suppresses nothing
+    wrong_tag = """
+        from spark_rapids_ml_tpu.parallel.mesh import chip_scope
+
+        def f(devs, rdv):
+            with chip_scope(devs):
+                rdv.allgather("x")  # spmd-ok: wrong tag for this finding
+        """
+    fs = run(wrong_tag, SpmdDivergenceRule)
+    assert rule_ids(fs) == ["spmd-divergence"]
+    bare = wrong_tag.replace(
+        "# spmd-ok: wrong tag for this finding", "# submesh-ok"
+    )
+    fs = analyze_source(
+        textwrap.dedent(bare),
+        relpath="spark_rapids_ml_tpu/snippet.py",
+        rules=[SpmdDivergenceRule(), HygieneRule()],
+    )
+    assert sorted(rule_ids(fs)) == ["spmd-divergence", "waiver-missing-reason"]
+
+
+def test_spmd_non_carving_with_block_is_not_a_submesh_scope():
+    # FP guard: ordinary context managers (locks, dataset scopes) around a
+    # collective do not make it sub-mesh-scoped
+    fs = run(
+        """
+        def f(lock, rdv):
+            with lock:
+                rdv.allgather("x")
+        """,
+        SpmdDivergenceRule,
+    )
+    assert fs == []
+
+
+def test_spmd_rank_conditional_inside_submesh_scope_keeps_rank_message():
+    # the innermost divergence frame wins: a rank conditional INSIDE the
+    # carve is the rank-reachability bug, reported (and waived) as such
+    fs = run(
+        """
+        from spark_rapids_ml_tpu.parallel.mesh import chip_scope
+
+        def f(devs, rank, rdv):
+            with chip_scope(devs):
+                if rank == 0:
+                    rdv.allgather("x")
+        """,
+        SpmdDivergenceRule,
+    )
+    assert rule_ids(fs) == ["spmd-divergence"]
+    assert "rank-identity conditional" in fs[0].message
+
+
 def test_spmd_nested_function_resets_conditional_context():
     fs = run(
         """

@@ -438,3 +438,111 @@ def test_compile_probe_guarded_after_batching(tele, rng):
     snap = telemetry.snapshot()
     assert snap["counters"]["fit.solves_batched"] == 3
     assert "fit.compile_overhead_s_est" not in snap["gauges"]
+
+
+# --------------------------------------------- SPMD placement agreement -----
+#
+# Under multi-process SPMD the DeviceDataset cache-hit branch runs no
+# collectives while the miss branch runs the layout allgather — so hit/miss
+# must be SYMMETRIC across ranks. `_device_dataset` agrees placement
+# fingerprints over ONE rendezvous round (every rank votes its have-bit;
+# the cache is used only when ALL ranks hold the entry). These tests drive
+# the agreement protocol directly with thread ranks + LocalRendezvous and
+# stubbed ingest/layout (real cross-process XLA is exercised by
+# tests/sweep_worker.py where the backend supports it).
+
+
+def _dds_worker(rank, rendezvous, key, steps, counts, errors):
+    """One thread-rank running the scripted `_device_dataset` sequence."""
+    from types import SimpleNamespace
+
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    try:
+        est = KMeans(k=2)
+        est._pre_process_data = lambda dataset, **kw: (
+            counts[rank].__setitem__("ingest", counts[rank]["ingest"] + 1),
+            SimpleNamespace(n_rows=10),
+        )[1]
+
+        def _layout(extracted, ctx, stage_logger, force_stream=False,
+                    key=None, source=None, attempt=0):
+            counts[rank]["layout"] += 1
+            return core.DeviceDataset(
+                key=key, extracted=extracted, inputs=None, source=source
+            )
+
+        est._admit_and_layout = _layout
+        est._device_dataset_key = lambda dataset, ctx: key
+        ctx = SimpleNamespace(
+            is_spmd=True, rank=rank, nranks=2, rendezvous=rendezvous
+        )
+        with core.device_dataset_scope():
+            scope = core._DDS_SCOPE.get()
+            for step in steps:
+                if step == "fit":
+                    est._device_dataset(object(), ctx, None)
+                elif step == "evict-rank1":
+                    # lockstep mutation: barrier, rank 1 drops its entry,
+                    # barrier — so the next fit sees a split cache state
+                    rendezvous.allgather("sync-a")
+                    if rank == 1:
+                        scope.cache.pop(key)
+                    rendezvous.allgather("sync-b")
+    except BaseException as e:  # surfaced by the parent; threads must not die silently
+        errors[rank] = e
+
+
+def test_spmd_placement_agreement_hits_only_when_all_ranks_have(tele):
+    import threading
+
+    from spark_rapids_ml_tpu.parallel import LocalRendezvous
+
+    key = ("fp", ("features", None, None, None, None), ("float32", False), (0, 1))
+    rvs = LocalRendezvous.create(2, timeout_s=20.0)
+    counts = [
+        {"ingest": 0, "layout": 0},
+        {"ingest": 0, "layout": 0},
+    ]
+    errors = [None, None]
+    steps = ["fit", "fit", "evict-rank1", "fit"]
+    threads = [
+        threading.Thread(
+            target=_dds_worker, args=(r, rvs[r], key, steps, counts, errors)
+        )
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # symmetry is the whole point: an asymmetric hit/miss would deadlock one
+    # rank in the layout allgather — both threads must come back
+    assert not any(t.is_alive() for t in threads)
+    assert errors == [None, None]
+
+    # fit 1: both miss -> both ingest + layout and cache the entry
+    # fit 2: both have -> pure cache hit, NO ingest/layout anywhere
+    # fit 3: rank 1 evicted -> the vote fails, BOTH ranks rebuild together:
+    #        rank 0 still holds the exact entry, so it takes the
+    #        host-retained path (ingest skipped, layout re-run); rank 1
+    #        re-ingests + lays out
+    assert counts[0] == {"ingest": 1, "layout": 2}
+    assert counts[1] == {"ingest": 2, "layout": 2}
+    snap = tele.snapshot()["counters"]
+    assert snap["fit.device_dataset_spmd_rounds"] == 6  # 3 fits x 2 ranks
+    assert snap["fit.device_dataset_reuses"] == 2  # fit 2 only
+    assert snap["fit.device_dataset_builds"] == 3  # fit 1 (x2) + fit 3 rank 1
+    assert snap["recovery.replacements"] == 1  # fit 3 rank 0 host-retained
+
+
+def test_spmd_agreement_skipped_off_spmd(tele, rng):
+    # single-process fits must not pay (or count) any rendezvous round
+    df = _reg_df(rng)
+    lr = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    with core.device_dataset_scope():
+        lr.fit(df)
+        lr.fit(df)
+    snap = tele.snapshot()["counters"]
+    assert "fit.device_dataset_spmd_rounds" not in snap
+    assert snap["fit.device_dataset_reuses"] == 1

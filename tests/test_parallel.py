@@ -283,3 +283,223 @@ def test_allgather_ndarray_chunked(tmp_path):
     np.testing.assert_array_equal(out[0], arr)
     # round counter advanced by more than one round (it actually chunked)
     assert rdv._round > 3
+
+
+# ------------------------------------------------ hierarchical / sub-mesh ---
+#
+# The sub-mesh placement substrate (docs/scheduling.md "2-D placement"):
+# build_mesh composes an ICI `rows` axis with a DCN axis across process
+# groups; submesh carves contiguous chip runs; survivor_mesh composes with
+# both so a sweep shard that loses a host re-meshes its OWN carve.
+
+
+class _FakeDev:
+    """Stand-in device for topology-only mesh math (jax.sharding.Mesh takes
+    any object; no program ever runs on these)."""
+
+    def __init__(self, did, process_index):
+        self.id = did
+        self.process_index = process_index
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"fake(d{self.id}@p{self.process_index})"
+
+
+def _fake_pool(n_procs, per_proc):
+    return [
+        _FakeDev(p * per_proc + i, p) for p in range(n_procs) for i in range(per_proc)
+    ]
+
+
+def test_get_mesh_divisibility_is_typed_and_names_both_sides():
+    from spark_rapids_ml_tpu.errors import MeshTopologyError, SrmlError
+
+    with pytest.raises(MeshTopologyError) as ei:
+        get_mesh(3)  # 8-device pool: 3 does not divide it
+    assert isinstance(ei.value, SrmlError)
+    assert ei.value.requested == 3
+    assert ei.value.available == 8
+    assert "num_workers=3" in str(ei.value) and "8-device" in str(ei.value)
+    with pytest.raises(MeshTopologyError):
+        get_mesh(0)
+    with pytest.raises(MeshTopologyError):
+        get_mesh(16)
+    assert get_mesh(4).devices.size == 4  # divisors still build
+
+
+def test_build_mesh_flat_default_and_2d_topology():
+    from spark_rapids_ml_tpu.parallel import DCN_AXIS, build_mesh
+
+    flat = build_mesh()
+    assert flat.axis_names == (ROWS_AXIS,)
+    assert flat.devices.size == 8
+
+    pool = _fake_pool(n_procs=2, per_proc=4)
+    m = build_mesh({"dcn": 2, "rows": 4}, devices=pool)
+    assert m.axis_names == (DCN_AXIS, ROWS_AXIS)
+    assert m.devices.shape == (2, 4)
+    # each DCN row is ONE process group's ICI-connected chips
+    for row in m.devices:
+        assert len({d.process_index for d in row}) == 1
+
+    # "auto" axes: dcn defaults to the process-group count
+    auto = build_mesh({"dcn": 0}, devices=pool)
+    assert auto.devices.shape == (2, 4)
+    rows_only = build_mesh({"rows": 2}, devices=pool)
+    assert rows_only.devices.shape == (4, 2)
+
+
+def test_build_mesh_rejects_bad_topologies():
+    from spark_rapids_ml_tpu.errors import MeshTopologyError
+    from spark_rapids_ml_tpu.parallel import build_mesh
+
+    pool = _fake_pool(n_procs=2, per_proc=4)
+    with pytest.raises(MeshTopologyError) as ei:
+        build_mesh({"dcn": 3, "rows": 4}, devices=pool)  # 12 != 8
+    assert ei.value.available == 8
+    assert ei.value.topology == {"dcn": 3, "rows": 4}
+    with pytest.raises(MeshTopologyError):
+        build_mesh({"ici": 8}, devices=pool)  # unknown axis name
+
+
+def test_build_mesh_reads_config_topology_knob():
+    from spark_rapids_ml_tpu import core as core_mod
+    from spark_rapids_ml_tpu.parallel import DCN_AXIS, build_mesh
+
+    saved = core_mod.config["mesh_topology"]
+    core_mod.config["mesh_topology"] = {"dcn": 2, "rows": 4}
+    try:
+        m = build_mesh()  # deployment-wide default from config
+        assert m.axis_names == (DCN_AXIS, ROWS_AXIS)
+        assert m.devices.shape == (2, 4)
+        flat = build_mesh({})  # an explicit empty topology wins over config
+        assert flat.axis_names == (ROWS_AXIS,)
+    finally:
+        core_mod.config["mesh_topology"] = saved
+
+
+def test_submesh_carves_contiguous_runs_only():
+    from spark_rapids_ml_tpu.errors import MeshTopologyError
+    from spark_rapids_ml_tpu.parallel import submesh
+
+    mesh = get_mesh(8)
+    flat = list(mesh.devices.flatten())
+
+    first4 = submesh(mesh, 4)
+    assert first4.axis_names == (ROWS_AXIS,)
+    assert list(first4.devices.flatten()) == flat[:4]
+
+    right = submesh(mesh, [4, 5, 6, 7])
+    assert list(right.devices.flatten()) == flat[4:]
+    by_dev = submesh(mesh, flat[2:5])  # device objects work too
+    assert list(by_dev.devices.flatten()) == flat[2:5]
+
+    with pytest.raises(MeshTopologyError):
+        submesh(mesh, [0, 2])  # gapped: ICI run broken
+    with pytest.raises(MeshTopologyError):
+        submesh(mesh, [6, 7, 8])  # out of range
+    with pytest.raises(MeshTopologyError):
+        submesh(mesh, 9)  # wider than the pool
+    with pytest.raises(MeshTopologyError):
+        submesh(mesh, [])  # empty carve
+
+
+def test_submesh_of_hierarchical_mesh_and_survivor_composition():
+    from spark_rapids_ml_tpu.parallel import DCN_AXIS, build_mesh, submesh
+
+    pool = _fake_pool(n_procs=2, per_proc=4)
+    m2d = build_mesh({"dcn": 2, "rows": 4}, devices=pool)
+
+    # carve one DCN row (one host's chips) as a 1-D rows sub-mesh
+    row0 = submesh(m2d, 4)
+    assert row0.axis_names == (ROWS_AXIS,)
+    assert [d.process_index for d in row0.devices.flatten()] == [0] * 4
+
+    # PR-6 recovery composes with the carve: losing a fictional process
+    # keeps the carve; losing the carve's own host raises (nothing left)
+    from spark_rapids_ml_tpu.errors import MeshTopologyError
+    from spark_rapids_ml_tpu.parallel import survivor_mesh
+
+    same = survivor_mesh(row0, {9})
+    assert list(same.devices.flatten()) == list(row0.devices.flatten())
+    with pytest.raises(MeshTopologyError):
+        survivor_mesh(row0, {0})
+
+    # 2-D mesh, whole DCN row dies: hierarchy survives intact
+    kept = survivor_mesh(m2d, {1})
+    assert kept.axis_names == (DCN_AXIS, ROWS_AXIS)
+    assert kept.devices.shape == (1, 4)
+    assert all(d.process_index == 0 for d in kept.devices.flatten())
+
+    # partial row death degrades to the flat 1-D survivors (a ragged 2-D
+    # grid is not a mesh): each DCN row here spans TWO processes, so losing
+    # one process leaves its row half-alive
+    ragged_pool = _fake_pool(n_procs=4, per_proc=2)
+    m24 = build_mesh({"dcn": 2, "rows": 4}, devices=ragged_pool)
+    flatd = survivor_mesh(m24, {3})
+    assert flatd.axis_names == (ROWS_AXIS,)
+    assert flatd.devices.size == 6
+
+
+def test_chip_scope_pins_default_devices_context_locally():
+    from spark_rapids_ml_tpu.parallel import (
+        chip_scope,
+        current_chip_scope,
+        default_devices,
+    )
+
+    pool = default_devices()
+    seen = {}
+
+    def worker():
+        # a sibling thread must NOT see the main thread's pin
+        seen["other"] = list(default_devices())
+
+    with chip_scope(pool[4:]):
+        assert current_chip_scope() == tuple(pool[4:])
+        assert default_devices() == pool[4:]
+        assert get_mesh().devices.size == 4  # downstream mesh calls follow
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=10)
+    assert seen["other"] == pool
+    assert current_chip_scope() is None
+    assert default_devices() == pool
+
+
+def test_shard_map_fold_grid_on_carved_submesh(mesh8):
+    # the SPMD-batched sweep substrate: a vmapped fold grid under shard_map
+    # over a CARVED sub-mesh computes exactly what plain numpy does on the
+    # same rows — folds batch INSIDE the shard body, collectives stay on the
+    # sub-mesh's own `rows` axis
+    from jax.sharding import NamedSharding
+
+    from spark_rapids_ml_tpu.parallel import submesh
+    from spark_rapids_ml_tpu.parallel.mesh import row_sharding, shard_map
+
+    sub = submesh(mesh8, 4)
+    n_rows = sub.devices.size * 2
+    x = np.arange(n_rows * 3, dtype=np.float32).reshape(n_rows, 3)
+    masks = np.stack([
+        np.tile(np.array([1.0, 0.0], np.float32), n_rows // 2),
+        np.tile(np.array([0.0, 1.0], np.float32), n_rows // 2),
+    ])  # (2 folds, n_rows)
+
+    X = jax.device_put(x, row_sharding(sub, 2))
+    M = jax.device_put(masks, NamedSharding(sub, P(None, ROWS_AXIS)))
+
+    def body(xs, ms):
+        def one_fold(m):  # xs: (local_rows, 3), m: (local_rows,)
+            return jax.lax.psum(jnp.sum(xs * m[:, None]), ROWS_AXIS)
+
+        return jax.vmap(one_fold)(ms)
+
+    got = np.asarray(
+        shard_map(
+            body, mesh=sub,
+            in_specs=(P(ROWS_AXIS, None), P(None, ROWS_AXIS)),
+            out_specs=P(),
+        )(X, M)
+    )
+    want = (x[None, :, :] * masks[:, :, None]).sum(axis=(1, 2))
+    np.testing.assert_allclose(got, want, rtol=1e-6)

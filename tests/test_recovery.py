@@ -601,6 +601,35 @@ def test_cv_sweep_resume_metrics_match_clean_run():
     np.testing.assert_allclose(resumed.avgMetrics, clean.avgMetrics)
 
 
+def test_cv_sweep_resumes_inside_carved_chip_scope():
+    # ISSUE 19 composition: the sweep ledger's resume works unchanged when the
+    # WHOLE sweep runs on a carved sub-mesh (the scheduler's chip_scope pin).
+    # The injected rank loss re-meshes within the pinned half-pool, resume
+    # redoes zero completed fits, and the metric grid is bit-identical to a
+    # clean sweep on the same sub-mesh.
+    from spark_rapids_ml_tpu.parallel import chip_scope, default_devices, get_mesh
+
+    pool = default_devices()
+    assert len(pool) == 8
+    half = pool[4:]
+    rng_a = np.random.default_rng(5)
+    cv, pdf, state = _cv_setup(rng_a, fail_at_fit=3)
+    with chip_scope(half):
+        assert get_mesh().devices.size == 4
+        resumed = cv.fit(pdf)
+    snap = _counters()
+    assert snap["sweep.resumes"] == 1
+    assert snap["sweep.fits_completed"] == 6
+    assert snap["sweep.fits_skipped"] == 4
+    # 2 clean + 1 failed + 1 resumed + 1 refit, all on the half-pool
+    assert state["n"] == 5
+    rng_b = np.random.default_rng(5)
+    cv2, pdf2, _ = _cv_setup(rng_b)
+    with chip_scope(half):
+        clean = cv2.fit(pdf2)
+    np.testing.assert_array_equal(resumed.avgMetrics, clean.avgMetrics)
+
+
 def test_cv_sweep_resume_budget_exhaustion():
     rng = np.random.default_rng(6)
     core_mod.config["sweep_max_resumes"] = 0

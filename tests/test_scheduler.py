@@ -578,3 +578,281 @@ def test_package_level_fitscheduler_is_the_real_class():
     sched = pkg.FitScheduler(ledger=HbmLedger())  # kwargs AND the class API
     assert isinstance(sched, pkg.FitScheduler)
     sched.shutdown()
+
+
+# ---------------------------------------------------------- 2-D placement ---
+#
+# The chip-occupancy half of the ledger (docs/scheduling.md "2-D placement"):
+# chip-scoped claims own WHICH chips exclusively, legacy claims keep the
+# bytes-only contract, and FitScheduler(chip_placement=True) first-fits
+# contiguous runs so equal-width jobs co-admit onto disjoint halves.
+
+
+def test_ledger_2d_coadmit_disjoint_refuse_overlap():
+    led = HbmLedger()
+    led.note_chip_pool(8)
+    a = led.try_reserve("a", "job", 40, budget=100, chip_ids=[0, 1, 2, 3])
+    b = led.try_reserve("b", "job", 40, budget=100, chip_ids=[4, 5, 6, 7])
+    assert a is not None and b is not None  # disjoint sets co-admit
+    assert led.occupied_chips() == set(range(8))
+    # overlap refused even with byte headroom on every chip: occupancy is
+    # exclusive (two SPMD programs cannot time-share a chip)
+    assert led.try_reserve("c", "job", 1, budget=100, chip_ids=[3, 4]) is None
+    led.release(b)
+    assert led.occupied_chips() == {0, 1, 2, 3}
+    assert led.try_reserve("c", "job", 1, budget=100, chip_ids=[3, 4]) is None
+    c = led.try_reserve("c", "job", 1, budget=100, chip_ids=[4, 5])
+    assert c is not None  # freed chips return to the pool
+
+
+def test_ledger_legacy_claims_budget_every_chip_but_do_not_occupy():
+    led = HbmLedger()
+    led.note_chip_pool(4)
+    led.reserve("resident", "serve", 70, chips=4)  # legacy: no chip_ids
+    # an unplaced claim does not occupy — placement stays possible...
+    assert led.occupied_chips() == set()
+    # ...but its bytes count on EVERY chip (it may live anywhere), so a
+    # chip-scoped claim sees them in its per-chip budget check
+    assert led.try_reserve("j", "job", 40, budget=100, chip_ids=[0, 1]) is None
+    r = led.try_reserve("j", "job", 25, budget=100, chip_ids=[0, 1])
+    assert r is not None
+    assert led.reserved_bytes_on(0) == 95  # legacy 70 + placed 25
+    assert led.reserved_bytes_on(3) == 70  # legacy only off the placed set
+
+
+def test_ledger_rebind_moves_occupancy_bytes_and_utilization():
+    # the sub-mesh resize move: a recovered sweep (or resumed job) re-points
+    # its claim at a different-width chip set; both dimensions must follow
+    led = HbmLedger()
+    led.note_chip_pool(8)
+    r = led.try_reserve("j", "job", 60, budget=100, chip_ids=[0, 1, 2, 3])
+    assert r is not None and led.occupied_chips() == {0, 1, 2, 3}
+    led.note_admission(100)
+    assert led.utilization() == pytest.approx(60 * 4 / (100 * 8))
+    led.rebind(r, [4, 5])
+    assert led.occupied_chips() == {4, 5}
+    assert r.chips == 2  # chips multiplier follows the set
+    assert led.reserved_bytes_on(0) == 0 and led.reserved_bytes_on(4) == 60
+    assert led.utilization() == pytest.approx(60 * 2 / (100 * 8))
+    # accounting: the released claim's chip-seconds accrued at each width
+    led.release(r)
+    u = led.tenant_usage()["default"]
+    assert u["chip_seconds"] >= 0.0 and u["reservations"] == 1.0
+
+
+def test_pool_gauges_flow_through_ops_plane_report():
+    from spark_rapids_ml_tpu import ops_plane
+
+    led = global_ledger()
+    led.note_chip_pool(8)
+    r = led.reserve("j", "job", 10, tenant="acme", chip_ids=[0, 1, 2])
+    try:
+        tenants = ops_plane.report()["tenants"]
+        assert tenants["_pool"]["chips_busy"] == 3.0
+        assert tenants["_pool"]["chips_total"] == 8.0
+        assert tenants["_pool"]["chips_idle"] == 5.0
+        assert tenants["acme"]["chips_busy"] == 3.0
+    finally:
+        led.release(r)
+    tenants = ops_plane.report()["tenants"]
+    assert tenants["_pool"]["chips_busy"] == 0.0
+    assert tenants["_pool"]["chips_idle"] == 8.0
+
+
+def _mk_wide_kmeans(**kw):
+    """A width-4 (half-mesh) estimator — the 2-D scheduler's placement unit."""
+    est = KMeans(**{"k": 8, "maxIter": 12, "seed": 7, "tol": 0.0, **kw})
+    est.num_workers = 4
+    return est
+
+
+def _occupancy_trace(samples):
+    """Step-integral of occupied chips over the busy window -> (avg, peak)."""
+    busy = [(t, occ) for t, occ in samples if occ > 0]
+    if len(busy) < 2:
+        return 0.0, max((occ for _, occ in samples), default=0)
+    integral = sum(
+        occ * (t1 - t0)
+        for (t0, occ), (t1, _) in zip(busy, busy[1:])
+    )
+    span = busy[-1][0] - busy[0][0]
+    peak = max(occ for _, occ in busy)
+    return (integral / span if span > 0 else 0.0), peak
+
+
+def _sample_occupancy(stop, samples):
+    while not stop.is_set():
+        samples.append(
+            (time.monotonic(), len(global_ledger().occupied_chips()))
+        )
+        time.sleep(0.002)  # blocking-ok: test poll cadence
+
+
+def test_coadmission_occupies_both_halves_and_stays_bit_identical(rng):
+    """The co-admission acceptance pin (ISSUE 19): two half-mesh fits
+    co-admitted onto disjoint chip sets keep BOTH halves of the pool busy —
+    the chip-occupancy integral is >= 1.5x the time-sliced schedule's — and
+    every model is bit-identical to the same fit run alone on the whole
+    pool. (Wall-clock rows/sec is the report-only benchmark lane: on the
+    virtual CPU mesh all 8 "chips" share the same host cores, so occupancy
+    — what a real multi-chip part turns into throughput — is the pinned
+    metric.)"""
+    import threading
+
+    df = _blob_df(rng, n=20000, d=16)
+    ref = _mk_wide_kmeans().fit(df)  # whole-pool sequential reference
+
+    # concurrent: both width-4 jobs co-admit onto disjoint halves; a third
+    # width-4 job must QUEUE on chip overlap alone (no byte budget is set,
+    # so bytes can never be the refusal here)
+    sched = FitScheduler(chip_placement=True)
+    samples, stop = [], threading.Event()
+    poller = threading.Thread(target=_sample_occupancy, args=(stop, samples))
+    try:
+        poller.start()
+        ja = sched.submit(_mk_wide_kmeans(), df, tenant="a")
+        jb = sched.submit(_mk_wide_kmeans(), df, tenant="b")
+        jc = sched.submit(_mk_wide_kmeans(), df, tenant="c")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            st = sched.stats()
+            if st["running"] == 2 and st["queued"] == 1:
+                break
+            time.sleep(0.002)  # blocking-ok: bounded test poll
+        st = sched.stats()
+        assert st["running"] == 2 and st["queued"] == 1
+        assert sorted(st["ledger_occupied_chips"]) == list(range(8))
+        ma = ja.result(timeout=120)
+        mb = jb.result(timeout=120)
+        mc = jc.result(timeout=120)
+    finally:
+        stop.set()
+        poller.join(timeout=5)
+        sched.shutdown()
+    _, peak_conc = _occupancy_trace(samples)
+    assert peak_conc == 8  # both halves genuinely claimed at once
+
+    chips_a = ma._fit_metrics["scheduler"]["chip_ids"]
+    chips_b = mb._fit_metrics["scheduler"]["chip_ids"]
+    chips_c = mc._fit_metrics["scheduler"]["chip_ids"]
+    assert len(chips_a) == len(chips_b) == len(chips_c) == 4
+    assert not set(chips_a) & set(chips_b)  # disjoint co-admission
+    assert set(chips_a) | set(chips_b) == set(range(8))
+
+    # occupancy integral, measured on a CLEAN two-job phase: the 3-job phase
+    # above ends with the queued job running alone (a solo width-4 tail that
+    # dilutes the average when warm compile caches make fits fast), so the
+    # >= 1.5x pin compares exactly the schedules the benchmark lane compares
+    # — the same two jobs co-admitted vs time-sliced
+    sched1 = FitScheduler(chip_placement=True)
+    samples1, stop1 = [], threading.Event()
+    poller1 = threading.Thread(target=_sample_occupancy, args=(stop1, samples1))
+    try:
+        poller1.start()
+        ca = sched1.submit(_mk_wide_kmeans(), df, tenant="a")
+        cb = sched1.submit(_mk_wide_kmeans(), df, tenant="b")
+        mca = ca.result(timeout=120)
+        mcb = cb.result(timeout=120)
+    finally:
+        stop1.set()
+        poller1.join(timeout=5)
+        sched1.shutdown()
+    avg_conc, peak_conc2 = _occupancy_trace(samples1)
+    assert peak_conc2 == 8
+
+    # time-sliced: same jobs, one at a time — half the pool busy at best
+    sched2 = FitScheduler(chip_placement=True, max_concurrent=1)
+    samples2, stop2 = [], threading.Event()
+    poller2 = threading.Thread(target=_sample_occupancy, args=(stop2, samples2))
+    try:
+        poller2.start()
+        sa = sched2.submit(_mk_wide_kmeans(), df, tenant="a")
+        sb = sched2.submit(_mk_wide_kmeans(), df, tenant="b")
+        msa = sa.result(timeout=120)
+        msb = sb.result(timeout=120)
+    finally:
+        stop2.set()
+        poller2.join(timeout=5)
+        sched2.shutdown()
+    avg_sliced, peak_sliced = _occupancy_trace(samples2)
+    assert peak_sliced == 4  # one width-4 claim at a time
+
+    assert avg_sliced > 0
+    ratio = avg_conc / avg_sliced
+    assert ratio >= 1.5, (
+        f"co-admission occupancy {avg_conc:.2f} vs time-sliced "
+        f"{avg_sliced:.2f} (ratio {ratio:.2f} < 1.5)"
+    )
+
+    # placement must not perturb math: every schedule, every chip set,
+    # bit-identical to the whole-pool sequential fit
+    for m in (ma, mb, mc, mca, mcb, msa, msb):
+        np.testing.assert_array_equal(
+            np.asarray(m.cluster_centers_), np.asarray(ref.cluster_centers_)
+        )
+
+
+def test_preempted_job_resumes_on_different_chip_set_bit_identically(rng):
+    """Satellite (c3): a width-4 job preempted off [4..7] resumes on [0..3]
+    once those chips free up — a DIFFERENT equal-width run — and its model
+    stays bit-identical to an uninterrupted fit (checkpoints are chip-set
+    agnostic: host-side solver state, re-placed at restore)."""
+    df = _blob_df(rng, n=6000, d=8)
+    core_mod.config["checkpoint_every_iters"] = 2
+    est_a = _mk_wide_kmeans(maxIter=40)
+    extracted = est_a._pre_process_data(df, for_fit=True, defer_validation=True)
+    need = memory.resident_estimate(est_a, extracted, 4).total()
+    _set_budget(3 * need + 4096)
+    clean = _mk_wide_kmeans(maxIter=40).fit(df)
+
+    # a resident serving claim pins the LEFT half: the job can only land on
+    # [4..7] first
+    serve = global_ledger().reserve(
+        "serve:pin", "serve", 1024, tenant="svc", chip_ids=[0, 1, 2, 3]
+    )
+    sched = FitScheduler(chip_placement=True)
+    try:
+        mark = telemetry.registry().mark()
+        ja = sched.submit(_mk_wide_kmeans(maxIter=40), df, tenant="low")
+        deadline = time.monotonic() + 30.0
+        first_chips = None
+        while time.monotonic() < deadline:
+            if ja.chip_ids is not None:
+                first_chips = tuple(ja.chip_ids)
+                break
+            time.sleep(0.002)  # blocking-ok: bounded test poll
+        assert first_chips == (4, 5, 6, 7)
+        # let it make checkpointed progress before displacing it
+        while time.monotonic() < deadline:
+            d = telemetry.registry().delta(mark)["counters"]
+            if d.get("checkpoint.saves", 0) >= 1:
+                break
+            time.sleep(0.002)  # blocking-ok: bounded test poll
+        jb = sched.submit(
+            _mk_wide_kmeans(maxIter=4), df, tenant="high", priority=10
+        )
+        # the preemptor takes the only free-able run — the one A held
+        while time.monotonic() < deadline:
+            if jb.chip_ids is not None:
+                break
+            time.sleep(0.002)  # blocking-ok: bounded test poll
+        assert tuple(jb.chip_ids or ()) == (4, 5, 6, 7)
+        # the serving replica drains: the left half opens up for A's resume
+        global_ledger().release(serve)
+        serve = None
+        jb.result(timeout=120)
+        # nudge a pass in case B finished before the release (releases do
+        # not reschedule); width-1 filler, lower in FIFO order than A
+        sched.submit(_mk_kmeans(), df, tenant="filler").result(timeout=120)
+        resumed = ja.result(timeout=120)
+    finally:
+        global_ledger().release(serve)
+        sched.shutdown()
+
+    st = resumed._fit_metrics["scheduler"]
+    assert st["preemptions"] == 1 and st["resumes"] == 1
+    assert tuple(st["chip_ids"]) == (0, 1, 2, 3)  # a different run
+    assert tuple(st["chip_ids"]) != first_chips
+    np.testing.assert_array_equal(
+        np.asarray(resumed.cluster_centers_), np.asarray(clean.cluster_centers_)
+    )

@@ -339,3 +339,87 @@ def test_logloss_contiguous_labels_vs_sklearn(rng):
     df = pd.DataFrame({"label": y, "prediction": pred, "probability": list(probs)})
     ev = MulticlassClassificationEvaluator(metricName="logLoss")
     np.testing.assert_allclose(ev.evaluate(df), sk_log_loss(y, probs, labels=[0, 1, 2]), rtol=1e-10)
+
+
+# ----------------------------------------------- SPMD sweep engine gating ---
+#
+# The multi-fit engine no longer falls back under multi-process SPMD
+# (docs/performance.md): eligibility extends to SPMD-capable dense
+# estimators, and held-out scoring allgathers every rank's validation slice
+# so all ranks pick the same winner. Gating and gather are unit-tested here
+# with stub contexts + thread ranks; tests/sweep_worker.py drives the real
+# cross-process path where the backend supports it.
+
+
+def test_engine_eligibility_under_spmd(monkeypatch):
+    from types import SimpleNamespace
+
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+    from spark_rapids_ml_tpu.parallel import TpuContext
+    from spark_rapids_ml_tpu.tuning import _engine_eligible
+
+    lr = LinearRegression()
+    assert _engine_eligible(lr)  # single-controller: any _TpuEstimator
+    assert not _engine_eligible(object())  # foreign estimators never engine
+
+    spmd = SimpleNamespace(is_spmd=True)
+    monkeypatch.setattr(TpuContext, "current", classmethod(lambda cls: spmd))
+    assert _engine_eligible(lr)  # dense + SPMD-capable: engine runs
+    sparse = LogisticRegression(enable_sparse_data_optim=True)
+    assert not _engine_eligible(sparse)  # scoring gather is dense-only
+    no_mp = LinearRegression()
+    no_mp._supports_multiprocess = False
+    assert not _engine_eligible(no_mp)  # estimator cannot fit under SPMD
+
+    single = SimpleNamespace(is_spmd=False)
+    monkeypatch.setattr(TpuContext, "current", classmethod(lambda cls: single))
+    assert _engine_eligible(sparse)  # sparse is fine off SPMD
+
+
+def test_gather_validation_concatenates_in_rank_order(monkeypatch):
+    import threading
+    from types import SimpleNamespace
+
+    from spark_rapids_ml_tpu.parallel import LocalRendezvous, TpuContext
+    from spark_rapids_ml_tpu.tuning import _gather_validation
+
+    rvs = LocalRendezvous.create(2, timeout_s=20.0)
+    by_thread = {}
+    monkeypatch.setattr(
+        TpuContext,
+        "current",
+        classmethod(lambda cls: by_thread.get(threading.get_ident())),
+    )
+    feats = [
+        np.arange(6, dtype=np.float64).reshape(3, 2),
+        10.0 + np.arange(4, dtype=np.float64).reshape(2, 2),
+    ]
+    labels = [np.array([0.0, 1.0, 2.0]), np.array([3.0, 4.0])]
+    out = [None, None]
+    errors = [None, None]
+
+    def worker(r):
+        try:
+            by_thread[threading.get_ident()] = SimpleNamespace(
+                is_spmd=True, rendezvous=rvs[r]
+            )
+            out[r] = _gather_validation(feats[r], labels[r])
+        except BaseException as e:
+            errors[r] = e
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert errors == [None, None]
+    want_f = np.concatenate(feats, axis=0)
+    want_y = np.concatenate(labels, axis=0)
+    for r in range(2):  # every rank scores the SAME globalized rows
+        np.testing.assert_array_equal(out[r][0], want_f)
+        np.testing.assert_array_equal(out[r][1], want_y)
+
+    # identity off SPMD: no copy, no control-plane round
+    f0, y0 = _gather_validation(feats[0], labels[0])
+    assert f0 is feats[0] and y0 is labels[0]
