@@ -90,7 +90,9 @@ ALGOS = (
 # registry/engine are single-device): their rows/sec is already per-chip —
 # dividing by the mesh size would underreport them n_chips-fold on
 # multi-chip rounds and false-fail the lane gate vs single-chip history
-SINGLE_DEVICE_LANES = {"serving", "serving_saturation", "sched_contention"}
+SINGLE_DEVICE_LANES = {
+    "serving", "serving_saturation", "sched_contention", "fleet_scale",
+}
 KNN_QUERIES = int(os.environ.get("BENCH_KNN_QUERIES", 4096))
 KNN_K = int(os.environ.get("BENCH_KNN_K", 64))
 SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 256))
@@ -149,6 +151,21 @@ SCHED_COLS = int(os.environ.get("BENCH_SCHED_COLS", 32))
 SCHED_COADMIT_ALGO = "sched_coadmit"
 SCHED_COADMIT_ROWS = int(os.environ.get("BENCH_SCHED_COADMIT_ROWS", 40_000))
 
+# Optional fleet observability lane (BENCH_FLEET=1): the multi-host scaling
+# sweep on the CPU SPMD harness — N LocalRendezvous ranks streaming work
+# through lockstep rounds WITH periodic fleet ops rounds riding the control
+# plane (benchmark/bench_fleet.py, docs/observability.md "Fleet plane").
+# Reports aggregate rows/sec at the widest rank count (`fleet_scale`), the
+# per-count curve as `fleet_scale_<n>` sub-lanes, and pool utilization vs
+# tenant count as `fleet_util`. Own @RESULT lines; NOT part of the headline
+# geomean until the lane history stabilizes (no BASELINES entry — the PR-10
+# per-lane trajectory gate picks each lane up at its first artifact).
+FLEET_ALGO = "fleet_scale"
+FLEET_RANKS = tuple(
+    int(n) for n in os.environ.get("BENCH_FLEET_RANKS", "1,2,3").split(",") if n
+)
+FLEET_ROWS = int(os.environ.get("BENCH_FLEET_ROWS", 50_000))
+
 
 def bench_algos() -> tuple:
     extra: tuple = ()
@@ -168,6 +185,10 @@ def bench_algos() -> tuple:
         # contention lane ahead of the dense block for the same HBM reason
         # (its per-tenant datasets are freed when the scheduler drains)
         extra += (SCHED_ALGO, SCHED_COADMIT_ALGO)
+    if os.environ.get("BENCH_FLEET"):
+        # fleet lane first: pure host-side harness (numpy + thread barriers),
+        # no device state to collide with anything that follows
+        extra = (FLEET_ALGO,) + extra
     return extra + ALGOS
 
 # Parent retry policy (override for tests): attempts x per-attempt timeout,
@@ -515,6 +536,59 @@ def bench_sched_coadmit_lane() -> tuple:
     }
 
 
+def bench_fleet_lane() -> tuple:
+    """Fleet observability lane (docs/observability.md "Fleet plane"): the
+    multi-host scaling sweep — aggregate rows/sec with the piggybacked ops
+    rounds riding the control plane — plus the utilization-vs-tenants sweep
+    over the 2-D ledger rollup. The lane metric is rows/sec at the widest
+    rank count; each rank count's value and the utilization number ride
+    their own @RESULT lanes so the per-lane trajectory gate sees the curve.
+    A failed ops round here is a correctness failure, not a slow lane: the
+    plane's whole contract is that aggregation never breaks the fit."""
+    from benchmark.bench_fleet import (
+        run_fleet_scaling_bench,
+        run_fleet_utilization_bench,
+    )
+
+    out = run_fleet_scaling_bench(FLEET_RANKS, FLEET_ROWS)
+    util = run_fleet_utilization_bench()
+    _log(
+        f"fleet_scale: {out['rows_per_sec']:,.0f} rows/s aggregate at "
+        f"{int(out['nranks'])} ranks (curve "
+        + ", ".join(f"n={k}: {v:,.0f}" for k, v in out["scale"].items())
+        + f"), {int(out['ops_rounds'])} ops round(s), "
+        f"{int(out['ops_rounds_failed'])} failed; utilization "
+        f"{util['utilization']:.2f} at {int(util['tenants'])} tenants over "
+        f"{int(util['pool_chips'])} chips"
+    )
+    if out["ops_rounds_failed"]:
+        raise RuntimeError(
+            f"fleet_scale lane: {int(out['ops_rounds_failed'])} ops round(s) "
+            "failed on a healthy harness"
+        )
+    # per-count scaling curve + pool utilization: own higher-better
+    # trajectory lanes (no BASELINES entries — never in the geomean)
+    for n, v in out["scale"].items():
+        print(
+            "@RESULT " + json.dumps(
+                {"algo": f"fleet_scale_{n}", "rows_per_sec_chip": v}
+            ),
+            flush=True,
+        )
+    print(
+        "@RESULT " + json.dumps(
+            {"algo": "fleet_util", "rows_per_sec_chip": util["utilization"]}
+        ),
+        flush=True,
+    )
+    return out["rows_per_sec"], None, {
+        "ops_rounds": out["ops_rounds"],
+        "ranks_reporting": out.get("ranks_reporting"),
+        "cluster_healthy": out.get("cluster_healthy"),
+        "utilization": util["sweep"],
+    }
+
+
 def bench_serving_lane() -> tuple:
     """Serving-plane lane (docs/serving.md): mixed-size concurrent predict
     requests against a resident k=SERVE_K model at the protocol width through
@@ -663,6 +737,7 @@ def run_child() -> int:
         OOCORE_ALGO: lambda: bench_oocore_lane(),
         SCHED_ALGO: lambda: bench_scheduler_lane(),
         SCHED_COADMIT_ALGO: lambda: bench_sched_coadmit_lane(),
+        FLEET_ALGO: lambda: bench_fleet_lane(),
         "serving_saturation": lambda: bench_saturation_lane(),
         "serving": lambda: bench_serving_lane(),
         "pca": lambda: bench_pca(dense_data()["X"], dense_data()["w"], mesh),

@@ -1,0 +1,284 @@
+#
+# Multi-host scaling lane for the fleet observability plane
+# (docs/observability.md "Fleet plane").
+#
+# Two scenarios, both on the CPU SPMD harness (LocalRendezvous threads —
+# the same substrate tests/test_parallel.py certifies against the real
+# multi-host control plane):
+#
+#   * scaling — N ranks each stream numpy work slices through lockstep
+#     rendezvous rounds WITH periodic forced ops rounds riding the same
+#     control plane. The lane value is aggregate rows/sec at the widest
+#     rank count; per-count values ride `fleet_scale_<n>` sub-lanes so the
+#     PR-10 per-lane trajectory gate sees the scaling CURVE, not one point
+#     (a fleet-plane overhead regression shows up as the wide counts
+#     flattening while n=1 stays put);
+#
+#   * utilization — per-tenant chip-window reservations against a fresh
+#     2-D ledger, rolled up through the fleet merge (`chips_busy` /
+#     `chips_idle` and per-tenant device-time splits) — utilization vs
+#     tenant count is the number the capacity dashboard plots.
+#
+# `--smoke --write <path>` is the CI transcript (ci/test.sh): a 3-rank
+# aggregation round with crafted distinct per-rank counters, asserting the
+# merged counters equal the per-rank sum, then archiving the merged cluster
+# snapshot next to the verdict JSONs.
+#
+# Excluded from the gated geomean until the lane history stabilizes
+# (bench.py BASELINES carries no entry; trajectory-start gating in
+# benchmark/regression.py makes later promotion cheap).
+#
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def run_fleet_scaling_bench(
+    nranks_list: Sequence[int] = (1, 2, 3),
+    rows_per_rank: int = 50_000,
+    n_cols: int = 64,
+    *,
+    n_rounds: int = 8,
+    ops_every: int = 2,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """One scaling sweep: for each rank count, N threads each run
+    `n_rounds` lockstep iterations of (numpy work slice -> allgather),
+    forcing a fleet ops round every `ops_every` iterations — the
+    aggregation cost rides the measured wall like it does in production.
+    Returns the per-count aggregate rows/sec, the widest count's value as
+    the lane metric, and the last merged cluster view's vitals."""
+    from spark_rapids_ml_tpu import telemetry
+    from spark_rapids_ml_tpu.ops_plane import fleet
+    from spark_rapids_ml_tpu.parallel import LocalRendezvous
+
+    telemetry.enable()
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((rows_per_rank, n_cols), dtype=np.float32)
+    w = rng.standard_normal((n_cols,), dtype=np.float32)
+
+    scale: Dict[int, float] = {}
+    last_view: Optional[Dict[str, Any]] = None
+    for n in nranks_list:
+        n = int(n)
+        fleet.reset()
+        rdvs = LocalRendezvous.create(n, timeout_s=60.0)
+        views: List[Optional[Dict[str, Any]]] = [None] * n
+        errors: List[BaseException] = []
+
+        def work(rank: int) -> None:
+            rdv = rdvs[rank]
+            try:
+                for i in range(n_rounds):
+                    # the work slice: one pass over this rank's rows
+                    float((x @ w).sum())
+                    rdv.allgather(f"step:{i}")
+                    if (i + 1) % ops_every == 0:
+                        v = fleet.ops_round(rdv, force=True)
+                        if v is not None:
+                            views[rank] = v
+            except BaseException as e:  # surfaced after join — a hung
+                errors.append(e)  # thread must not wedge the lane
+                rdv.abort(f"bench rank {rank}: {type(e).__name__}")
+
+        threads = [
+            threading.Thread(target=work, args=(r,), daemon=True) for r in range(n)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(
+                f"fleet scaling lane: rank thread died at n={n}: "
+                f"{type(errors[0]).__name__}: {errors[0]}"
+            )
+        scale[n] = (n * rows_per_rank * n_rounds) / wall if wall else 0.0
+        merged = [v for v in views if v is not None]
+        if merged:
+            last_view = merged[-1]
+
+    widest = int(max(nranks_list))
+    counters = telemetry.registry().snapshot()["counters"]
+    out: Dict[str, Any] = {
+        "rows_per_sec": scale[widest],
+        "nranks": float(widest),
+        "scale": {str(k): round(v, 1) for k, v in sorted(scale.items())},
+        "ops_rounds": float(counters.get("fleet.ops_rounds", 0.0)),
+        "ops_rounds_failed": float(counters.get("fleet.ops_rounds_failed", 0.0)),
+    }
+    if last_view is not None:
+        out["ranks_reporting"] = float(last_view.get("ranks_reporting", 0))
+        out["cluster_healthy"] = bool(
+            (last_view.get("health") or {}).get("healthy", True)
+        )
+    return out
+
+
+def run_fleet_utilization_bench(
+    tenant_counts: Sequence[int] = (1, 2, 4),
+    pool_chips: int = 8,
+    *,
+    bytes_per_tenant: int = 1 << 20,
+    hold_s: float = 0.05,
+) -> Dict[str, Any]:
+    """Utilization-vs-tenants sweep over a fresh 2-D ledger: each tenant
+    claims a disjoint chip window, the fleet rollup reports the pool's
+    chips_busy/chips_idle, and the lane value is the widest sweep's pool
+    utilization (busy / total). Per-tenant device-time splits ride the
+    merged tenants view the same way `opsreport --cluster` renders them."""
+    from spark_rapids_ml_tpu.scheduler import reset_global_ledger
+    from spark_rapids_ml_tpu.scheduler.ledger import merge_tenant_usage
+
+    sweep: Dict[int, Dict[str, float]] = {}
+    for n in tenant_counts:
+        n = int(n)
+        ledger = reset_global_ledger()
+        ledger.note_chip_pool(pool_chips)
+        width = max(1, pool_chips // max(1, n))
+        held = [
+            ledger.reserve(
+                f"bench_fleet:{t}", "fit", bytes_per_tenant,
+                tenant=f"tenant{t}",
+                chip_ids=range(t * width, min(pool_chips, (t + 1) * width)),
+            )
+            for t in range(n)
+        ]
+        time.sleep(hold_s)  # integrate some chip-seconds before the read
+        usage = merge_tenant_usage([ledger.tenant_usage()])
+        for r in held:
+            ledger.release(r)
+        pool = usage.get("_pool") or {}
+        busy = float(pool.get("chips_busy", 0.0))
+        total = float(pool.get("chips_total", pool_chips)) or 1.0
+        sweep[n] = {
+            "utilization": busy / total,
+            "chips_busy": busy,
+            "chips_idle": float(pool.get("chips_idle", 0.0)),
+            "chip_seconds": sum(
+                float(u.get("chip_seconds", 0.0))
+                for t, u in usage.items()
+                if t != "_pool"
+            ),
+        }
+    widest = int(max(tenant_counts))
+    return {
+        "utilization": sweep[widest]["utilization"],
+        "pool_chips": float(pool_chips),
+        "tenants": float(widest),
+        "sweep": {str(k): v for k, v in sorted(sweep.items())},
+    }
+
+
+def run_fleet_smoke(nranks: int = 3) -> Dict[str, Any]:
+    """The CI aggregation smoke: one forced ops round over `nranks`
+    LocalRendezvous threads with crafted DISTINCT per-rank counters (the
+    threaded harness shares one registry, so the payload hook is what makes
+    the sum assertion meaningful). Raises when the merged counters differ
+    from the per-rank sum; returns the merged cluster view for archival."""
+    from spark_rapids_ml_tpu import core, telemetry
+    from spark_rapids_ml_tpu.ops_plane import fleet
+    from spark_rapids_ml_tpu.parallel import LocalRendezvous
+
+    saved = {
+        k: core.config[k]
+        for k in ("metrics_bucket_seconds", "metrics_bucket_count")
+    }
+    core.config["metrics_bucket_seconds"] = 0.25
+    core.config["metrics_bucket_count"] = 8
+    was_enabled = telemetry.enabled()
+    telemetry.registry().reset()
+    telemetry.enable()
+    fleet.reset()
+    try:
+        rdvs = LocalRendezvous.create(nranks, timeout_s=60.0)
+        views: List[Optional[Dict[str, Any]]] = [None] * nranks
+        errors: List[BaseException] = []
+
+        def work(rank: int) -> None:
+            try:
+                payload = dict(
+                    fleet.local_payload(rank),
+                    rank=rank,
+                    counters={"fleet_smoke.work": float(rank + 1)},
+                )
+                views[rank] = fleet.ops_round(
+                    rdvs[rank], force=True, payload=payload
+                )
+            except BaseException as e:
+                errors.append(e)
+                rdvs[rank].abort(f"smoke rank {rank}: {type(e).__name__}")
+
+        threads = [
+            threading.Thread(target=work, args=(r,), daemon=True)
+            for r in range(nranks)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        if errors:
+            raise RuntimeError(
+                f"fleet smoke: rank thread died: "
+                f"{type(errors[0]).__name__}: {errors[0]}"
+            )
+        view = next((v for v in views if v is not None), None)
+        if view is None:
+            raise RuntimeError("fleet smoke: no rank received a merged view")
+        got = view["counters"].get("fleet_smoke.work")
+        want = float(sum(range(1, nranks + 1)))
+        if got != want:
+            raise RuntimeError(
+                f"fleet smoke: merged counter {got!r} != per-rank sum {want!r}"
+            )
+        if view["ranks_reporting"] != nranks or view["missing"]:
+            raise RuntimeError(
+                f"fleet smoke: {view['ranks_reporting']}/{nranks} ranks "
+                f"reporting, missing {view['missing']}"
+            )
+        return view
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+        core.config.update(saved)
+        fleet.reset()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="run the 3-rank CI aggregation smoke and exit")
+    p.add_argument("--nranks", type=int, default=3,
+                   help="rank count for --smoke (default 3)")
+    p.add_argument("--write", metavar="PATH",
+                   help="archive the merged cluster snapshot JSON here")
+    args = p.parse_args(argv)
+    if args.smoke:
+        view = run_fleet_smoke(args.nranks)
+        if args.write:
+            with open(args.write, "w") as f:
+                json.dump({"cluster": view}, f, indent=2, default=str)
+        print(
+            f"fleet smoke OK: {int(view['ranks_reporting'])}/{args.nranks} "
+            f"ranks merged, cluster healthy="
+            f"{(view.get('health') or {}).get('healthy', True)}",
+            file=sys.stderr,
+        )
+        return 0
+    out = run_fleet_scaling_bench()
+    util = run_fleet_utilization_bench()
+    print(json.dumps({"scaling": out, "utilization": util}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

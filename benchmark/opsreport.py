@@ -15,8 +15,19 @@
 # verdict, reason — for the filtered tenant/trace
 # (docs/observability.md "Ops plane").
 #
+# Cluster mode (docs/observability.md "Fleet plane"):
+#
+#   python -m benchmark.opsreport --cluster /path/snapshot_dir --nranks 3
+#   python -m benchmark.opsreport --cluster            # live merged view
+#
+# merges the per-rank `ops_snapshot*.json` files (dropping stale dead-rank
+# data by their `meta` headers) and renders the cluster verdict, straggler
+# lags, and the fleet tenant rollup — NAMING missing/stale ranks.
+#
 # Exit codes: 0 = healthy (or no SLOs configured), 1 = at least one SLO
-# failing, 2 = snapshot unreadable.
+# failing, 2 = snapshot unreadable, 3 = PARTIAL cluster (healthy so far as
+# visible, but some rank snapshots missing or stale — a half-dead fleet is
+# not a healthy one, and not an unreadable one either).
 #
 from __future__ import annotations
 
@@ -24,6 +35,11 @@ import argparse
 import json
 import sys
 from typing import Any, Dict, List, Optional
+
+EXIT_HEALTHY = 0
+EXIT_FAILING = 1
+EXIT_UNREADABLE = 2
+EXIT_PARTIAL = 3
 
 
 def _fmt_burn(v: Optional[float]) -> str:
@@ -182,6 +198,124 @@ def render(
     return "\n".join(lines)
 
 
+def render_cluster(view: Dict[str, Any], issues: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    n = view.get("nranks") or issues.get("nranks") or 0
+    lines.append(
+        f"cluster: {view.get('ranks_reporting', 0)}/{n} rank(s) reporting"
+    )
+    for key, label in (("missing", "missing"), ("stale", "stale"), ("unreadable", "unreadable")):
+        bad = issues.get(key) or []
+        if bad:
+            lines.append(f"  {label} rank(s): {', '.join(str(r) for r in bad)}")
+    for r in sorted(view.get("ranks") or {}):
+        meta = view["ranks"][r]
+        host = meta.get("host") or "?"
+        lines.append(f"  rank {r}: host={host} pid={meta.get('pid')}")
+    health = view.get("health") or {}
+    ok = bool(health.get("healthy", True))
+    lines.append(
+        f"cluster health: {'OK' if ok else 'FAILING'} "
+        f"({health.get('specs', 0)} SLO spec(s) over the merged window)"
+    )
+    for v in health.get("verdicts") or []:
+        mark = "FAIL" if v.get("failing") else "ok"
+        lines.append(
+            f"  [{mark:>4}] {v.get('name')} ({v.get('kind')}): "
+            f"burn fast={_fmt_burn(v.get('fast_burn'))}"
+            f"/{v.get('fast_burn_threshold')}, "
+            f"slow={_fmt_burn(v.get('slow_burn'))}"
+            f"/{v.get('slow_burn_threshold')}"
+        )
+    strag = view.get("straggler") or {}
+    lags = strag.get("lags_s") or {}
+    if lags:
+        lag_s = ", ".join(
+            f"rank {r}={lags[r]*1e3:.1f}ms" for r in sorted(lags, key=lambda x: int(x))
+        )
+        slowest = strag.get("slowest")
+        tail = f" (slowest: rank {slowest})" if slowest is not None else ""
+        lines.append(f"straggler lags: {lag_s}{tail}")
+    tenants = view.get("tenants") or {}
+    pool = tenants.get("_pool") or {}
+    if pool:
+        lines.append(
+            f"fleet chips: busy={pool.get('chips_busy', 0.0):g} "
+            f"idle={pool.get('chips_idle', 0.0):g} "
+            f"total={pool.get('chips_total', 0.0):g}"
+        )
+    named = {t: u for t, u in tenants.items() if t != "_pool"}
+    if named:
+        lines.append("fleet tenant rollup:")
+        for name in sorted(named):
+            u = named[name]
+            lines.append(
+                f"  {name}: {_fmt_bytes(u.get('byte_seconds', 0.0))}·s, "
+                f"{u.get('chip_seconds', 0.0):.3f} chip·s, "
+                f"chips_busy={u.get('chips_busy', 0.0):g}"
+            )
+            dt = u.get("device_time")
+            if dt:
+                lines.append(
+                    f"    device time: execute={dt.get('execute_s', 0.0):.3f}s "
+                    f"compile={dt.get('compile_s', 0.0):.3f}s "
+                    f"host={dt.get('host_s', 0.0):.3f}s "
+                    f"idle={dt.get('idle_s', 0.0):.3f}s"
+                )
+    if view.get("windows_error"):
+        lines.append(f"window merge degraded: {view['windows_error']}")
+    return "\n".join(lines)
+
+
+def _cluster_main(args: Any) -> int:
+    from spark_rapids_ml_tpu.ops_plane import fleet
+
+    if args.snapshot is None:
+        live = fleet.cluster_report()
+        if not live.get("available"):
+            print(
+                "opsreport: no live cluster view (no ops round has merged yet)",
+                file=sys.stderr,
+            )
+            return EXIT_UNREADABLE
+        view = live
+        issues: Dict[str, Any] = {
+            "missing": view.get("missing") or [],
+            "stale": [],
+            "unreadable": [],
+            "nranks": view.get("nranks"),
+        }
+    else:
+        reports, issues = fleet.read_rank_snapshots(args.snapshot, nranks=args.nranks)
+        if not reports:
+            named = issues.get("stale") or issues.get("unreadable") or "none found"
+            print(
+                f"opsreport: no usable rank snapshots in {args.snapshot} "
+                f"(stale/unreadable: {named})",
+                file=sys.stderr,
+            )
+            return EXIT_UNREADABLE
+        view = fleet.merge_reports(
+            reports, expected=issues.get("nranks") or args.nranks
+        )
+    if args.write:
+        with open(args.write, "w") as f:
+            json.dump({"cluster": view, "issues": issues}, f, indent=2, default=str)
+    if args.json:
+        print(json.dumps({"cluster": view, "issues": issues}, default=str))
+    else:
+        print(render_cluster(view, issues))
+    if not (view.get("health") or {}).get("healthy", True):
+        return EXIT_FAILING
+    partial = (
+        (issues.get("missing") or [])
+        or (issues.get("stale") or [])
+        or (issues.get("unreadable") or [])
+        or (view.get("missing") or [])
+    )
+    return EXIT_PARTIAL if partial else EXIT_HEALTHY
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="opsreport",
@@ -198,8 +332,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--write-efficiency", default=None, metavar="PATH",
                    help="archive just the efficiency section (attribution "
                         "splits + compile ledger) as JSON at PATH")
+    p.add_argument("--cluster", action="store_true",
+                   help="fleet mode: treat SNAPSHOT as a DIRECTORY of per-rank "
+                        "ops_snapshot*.json files and render the merged "
+                        "cluster view (omitted = this process's live merged "
+                        "view); exit 3 names a partial cluster")
+    p.add_argument("--nranks", type=int, default=None,
+                   help="expected rank count for --cluster (missing ranks "
+                        "are named; default: inferred from the snapshots)")
     args = p.parse_args(argv)
 
+    if args.cluster:
+        return _cluster_main(args)
     if args.snapshot is not None:
         try:
             with open(args.snapshot) as f:

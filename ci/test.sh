@@ -26,6 +26,12 @@ echo "== ops snapshot artifact (SLO verdicts + decision log + tenant accounting 
 python -m benchmark.opsreport --json --write "$ARTIFACTS/ops_snapshot.json" \
   --write-efficiency "$ARTIFACTS/efficiency_report.json" > /dev/null
 
+echo "== fleet aggregation smoke (3-rank LocalRendezvous ops round; merged counters must equal the per-rank sum)"
+# archives the merged cluster snapshot next to the verdict JSONs
+# (docs/observability.md "Fleet plane")
+python -m benchmark.bench_fleet --smoke --nranks 3 \
+  --write "$ARTIFACTS/cluster_snapshot.json"
+
 echo "== chaos smoke (kill one rank mid-solve; survivors must recover + post-mortem must name it)"
 python ci/chaos_smoke.py
 

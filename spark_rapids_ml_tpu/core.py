@@ -269,6 +269,24 @@ config: Dict[str, Any] = {
     # never guessed from the device model. Seeded from
     # SRML_DEVICE_PEAK_FLOPS.
     "device_peak_flops": os.environ.get("SRML_DEVICE_PEAK_FLOPS") or None,
+    # --- fleet observability plane (ops_plane/fleet.py,
+    # docs/observability.md "Fleet plane") --------------------------------
+    # minimum seconds between live ops rounds (the throttled cross-rank
+    # window exchange piggybacked on the rendezvous control plane). None
+    # (default) = one metrics bucket width (metrics_bucket_seconds) — the
+    # finest cadence at which a new exchange can carry new window data.
+    "fleet_ops_round_seconds": None,
+    # consecutive ops rounds a rank must be the slowest round-exiter (by at
+    # least fleet_straggler_min_lag_s) before the straggler detector fires a
+    # flight-recorder event + audit entry naming it
+    "fleet_straggler_windows": 3,
+    # lag floor (seconds behind the fastest rank's round exit) below which a
+    # rank is never counted as straggling — jitter under this is noise
+    "fleet_straggler_min_lag_s": 0.05,
+    # per-rank ops snapshots older than this (by their meta.t header) are
+    # dropped from the offline cluster merge as stale dead-rank data and
+    # named in the `opsreport --cluster` partial verdict
+    "fleet_stale_snapshot_s": 600.0,
 }
 
 

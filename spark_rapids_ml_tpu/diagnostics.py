@@ -188,11 +188,42 @@ def trace_scope(label: str, ctx: Any = None):
             # protection, and the typed desync guards cover any round-count
             # divergence a one-sided timeout could leave behind.
             try:
-                payload = _TRACE_ROUND_PREFIX + (trace_id if ctx.rank == 0 else "")
+                # the fleet plane piggybacks its ops-round scheduling on this
+                # round (docs/observability.md "Fleet plane"): rank 0 ALONE
+                # evaluates the time throttle and broadcasts the decision as
+                # a `|ops` suffix — a per-rank local throttle would desync
+                # the lockstep round counters. sys.modules probe: trace
+                # exchange must not pay the ops_plane import chain, and a
+                # process that never imported the fleet plane runs zero ops
+                # rounds. Trace ids are hex, so "|" cannot collide.
+                fleet = sys.modules.get(__package__ + ".ops_plane.fleet")
+                flag = (
+                    "|" + fleet.OPS_ROUND_FLAG
+                    if fleet is not None and ctx.rank == 0 and fleet.ops_due()
+                    else ""
+                )
+                payload = _TRACE_ROUND_PREFIX + (trace_id if ctx.rank == 0 else "") + flag
                 gathered = rendezvous.allgather(payload)
                 root = gathered[0]
-                if root.startswith(_TRACE_ROUND_PREFIX) and root[len(_TRACE_ROUND_PREFIX):]:
-                    trace_id = root[len(_TRACE_ROUND_PREFIX):]
+                ops_follows = False
+                if root.startswith(_TRACE_ROUND_PREFIX):
+                    rest = root[len(_TRACE_ROUND_PREFIX):]
+                    rid, sep, tail = rest.partition("|")
+                    if rid:
+                        trace_id = rid
+                    if sep and "ops" in tail.split("|"):
+                        if fleet is None:
+                            # rank 0 runs the fleet plane but this process
+                            # never imported it — import now rather than
+                            # desync the lockstep round rank 0 is entering
+                            from .ops_plane import fleet  # noqa: PLC0415
+                        ops_follows = True
+                if ops_follows:
+                    # every rank saw the same root payload, so every rank
+                    # enters the ops round in lockstep — including ranks
+                    # whose local telemetry is off (they send the bare
+                    # marker). ops_round never raises (non-fatal contract).
+                    fleet.ops_round(rendezvous)
             except Exception as e:
                 record_event("trace_exchange_failed", label=label,
                              error=type(e).__name__)

@@ -32,10 +32,12 @@
 #
 from __future__ import annotations
 
+import os
+import socket
 import time
 from typing import Any, Dict, Optional
 
-from . import audit, drift, efficiency, export, slo
+from . import audit, drift, efficiency, export, fleet, slo
 from .export import ensure_server, start_server, stop_server, write_snapshot
 
 __all__ = [
@@ -43,6 +45,7 @@ __all__ = [
     "drift",
     "efficiency",
     "export",
+    "fleet",
     "slo",
     "report",
     "ensure_server",
@@ -69,22 +72,38 @@ def report(
     tenant: Optional[str] = None,
     trace_id: Optional[str] = None,
     decision_limit: int = 256,
+    cluster: bool = False,
 ) -> Dict[str, Any]:
     """The full ops-plane state as one JSON-able dict: health + SLO verdicts
     (evaluated fresh), rolling-window rates/quantiles, the decision log
     (optionally filtered to one tenant / trace), per-tenant HBM accounting
-    from the shared ledger, drift stats, and the registry snapshot."""
-    from .. import telemetry
+    from the shared ledger, drift stats, and the registry snapshot. The
+    `meta` header (rank/host/pid/t/trace id) and `windows_detail` (the
+    age-indexed window export) are what the fleet plane's offline merger
+    keys on — staleness, dead-rank detection, and cross-rank window
+    alignment (docs/observability.md "Fleet plane"). `cluster=True` adds
+    the last merged LIVE cluster view (`fleet.cluster_report()`)."""
+    from .. import diagnostics, telemetry
     from ..ops import autotune as _autotune
     from ..scheduler.ledger import global_ledger
 
     reg = telemetry.registry()
     health = slo.health(fresh=True)
-    return {
-        "t": time.time(),
+    rank = diagnostics._rank()
+    now = time.time()
+    rep = {
+        "t": now,
+        "meta": {
+            "rank": rank,
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "t": now,
+            "trace_id": diagnostics.trace_tags().get("trace_id"),
+        },
         "health": {k: health[k] for k in ("healthy", "failing", "specs")},
         "slo": health["verdicts"],
         "windows": reg.windows_snapshot(),
+        "windows_detail": reg.windows_export(),
         "decisions": audit.decisions(
             tenant=tenant, trace_id=trace_id, limit=decision_limit
         ),
@@ -96,3 +115,6 @@ def report(
         "autotune": {**_autotune.stats(), "table_path": _autotune.table_path()},
         "telemetry": reg.snapshot(),
     }
+    if cluster:
+        rep["cluster"] = fleet.cluster_report()
+    return rep

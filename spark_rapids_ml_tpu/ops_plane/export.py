@@ -89,7 +89,43 @@ def render_prometheus() -> str:
         sample(pname, reg.window_quantile(name, 0.99), ',quantile="0.99"')
         sample(f"{pname}_count", h.get("count"))
         sample(f"{pname}_sum", h.get("sum"))
+    lines.extend(_cluster_lines())
     return "\n".join(lines) + "\n"
+
+
+def _cluster_lines() -> List[str]:
+    """`rank="cluster"` samples from the last merged fleet view (empty
+    before any ops round — docs/observability.md "Fleet plane"). Merged
+    counters are cluster sums; gauges expose the per-rank min/max/sum
+    rollups as `agg`-labelled samples (a scraper must not mistake a
+    watermark's sum for a value one rank reported)."""
+    from . import fleet as _fleet
+
+    view = _fleet.cluster_view()
+    if not view:
+        return []
+    lines: List[str] = []
+
+    def sample(name: str, value: Any, extra_labels: str = "") -> None:
+        if value is None:
+            return
+        lines.append(f'{name}{{rank="cluster"{extra_labels}}} {float(value):g}')
+
+    for name, v in sorted((view.get("counters") or {}).items()):
+        sample(_prom_name(name), v)
+    for name, g in sorted((view.get("gauges") or {}).items()):
+        pname = _prom_name(name)
+        for agg in ("min", "max", "sum"):
+            sample(pname, g.get(agg), f',agg="{agg}"')
+    for name, h in sorted((view.get("histograms") or {}).items()):
+        pname = _prom_name(name)
+        sample(f"{pname}_count", h.get("count"))
+        sample(f"{pname}_sum", h.get("sum"))
+    health = view.get("health") or {}
+    if "healthy" in health:
+        sample("srml_cluster_healthy", 1.0 if health["healthy"] else 0.0)
+    sample("srml_cluster_ranks_reporting", view.get("ranks_reporting"))
+    return lines
 
 
 # ------------------------------------------------------------- HTTP server --
@@ -120,8 +156,26 @@ def _make_handler():
                     self._send(200, render_prometheus().encode(), "text/plain; version=0.0.4")
                 elif path == "/healthz":
                     verdict = _slo.health(fresh=True)
+                    # the rank-0 exporter also answers for the CLUSTER: the
+                    # last merged fleet view's verdict rides the body, and a
+                    # failing cluster flips 503 even while this rank's own
+                    # windows look healthy (docs/observability.md "Fleet
+                    # plane"). No view merged yet -> local-only, unchanged.
+                    from . import fleet as _fleet
+
+                    cview = _fleet.cluster_view()
+                    healthy = bool(verdict["healthy"])
+                    if cview is not None:
+                        chealth = cview.get("health") or {}
+                        verdict["cluster"] = {
+                            "healthy": chealth.get("healthy", True),
+                            "failing": chealth.get("failing", []),
+                            "ranks_reporting": cview.get("ranks_reporting"),
+                            "missing": cview.get("missing", []),
+                        }
+                        healthy = healthy and bool(chealth.get("healthy", True))
                     body = json.dumps(verdict, default=str).encode()
-                    self._send(200 if verdict["healthy"] else 503, body, "application/json")
+                    self._send(200 if healthy else 503, body, "application/json")
                 elif path in ("/snapshot", "/snapshot.json"):
                     body = json.dumps(_ops.report(), default=str).encode()
                     self._send(200, body, "application/json")
@@ -182,11 +236,24 @@ def ensure_server() -> Optional[Tuple[str, int]]:
     """Start the exporter iff `SRML_METRICS_PORT` is set and no server runs
     yet — the opt-in entry the serving engine, the scheduler, and
     `telemetry.enable()` all call. Best-effort: a busy port logs nothing and
-    returns None (the exporter must never fail the plane it observes)."""
+    returns None (the exporter must never fail the plane it observes).
+
+    Multi-rank hosts (docs/observability.md "Fleet plane"): by default only
+    RANK 0 binds — co-located ranks racing for one port meant every rank
+    but the winner silently lost its scrape surface. `SRML_METRICS_ALL_RANKS=1`
+    opts every rank in at `port + rank`, so each rank's surface is
+    addressable instead of colliding."""
+    from .. import diagnostics
+
     port = os.environ.get("SRML_METRICS_PORT")
     if not port:
         return server_address()
     try:
+        rank = diagnostics._rank()
+        if rank:
+            if os.environ.get("SRML_METRICS_ALL_RANKS", "") not in ("1", "true", "on"):
+                return server_address()
+            return start_server(int(port) + rank)
         return start_server(int(port))
     except (OSError, ValueError):
         return None
@@ -223,7 +290,16 @@ def write_snapshot(
         d = _snapshot_dir()
         if not d:
             return None
-        path = os.path.join(d, "ops_snapshot.json")
+        # per-rank default naming (docs/observability.md "Fleet plane"):
+        # rank 0 keeps the canonical name, co-located ranks write
+        # `ops_snapshot_rank_<r>.json` — the fleet merger
+        # (fleet.read_rank_snapshots / `opsreport --cluster`) scans both,
+        # and multi-rank hosts stop overwriting one file
+        from .. import diagnostics
+
+        rank = diagnostics._rank()
+        name = "ops_snapshot.json" if not rank else f"ops_snapshot_rank_{rank}.json"
+        path = os.path.join(d, name)
     rep = _ops.report()
     tmp = f"{path}.tmp{os.getpid()}"
     try:

@@ -1,0 +1,577 @@
+#
+# Fleet plane: cluster-level aggregation over the per-rank ops planes
+# (docs/observability.md "Fleet plane").
+#
+# Everything PRs 13/17 built is per-process: each rank evaluates SLOs over
+# its own windows, serves its own /metrics, writes its own snapshot. This
+# module answers the CLUSTER questions — "is the fleet healthy", "which rank
+# is the straggler", "what is fleet chip utilization per tenant" — through
+# two transports that share ONE set of merge definitions (telemetry.py's
+# merge_counters / merge_gauges / merge_histograms / merge_windows):
+#
+#   * LIVE ops round — a compact window-snapshot exchange piggybacked on the
+#     rendezvous control plane (the reference's BarrierTaskContext.allGather
+#     analog). Rank 0 alone decides WHEN a round is due (`ops_due`, at most
+#     one per `config["fleet_ops_round_seconds"]`, default one metrics
+#     bucket width) and broadcasts the decision as a `|ops` suffix on the
+#     trace-exchange payload it already sends (diagnostics.trace_scope) —
+#     a local time throttle on every rank would desync the lockstep round
+#     counters, a single decider cannot. The round itself is NON-FATAL at
+#     two layers (the PR-5 trace-exchange contract): a rank that cannot
+#     build its payload sends the bare marker so the round still completes
+#     lockstep, and a failed allgather (dead peer, timeout) records
+#     `ops_round_failed`, ticks `fleet.ops_rounds_failed`, and returns the
+#     survivors to local-only views — the fit's own next round surfaces the
+#     real failure WITH retry protection. Disabled telemetry short-circuits
+#     before any rendezvous use: zero extra rounds, zero records.
+#
+#   * OFFLINE merge — `read_rank_snapshots()` over the per-rank rotating
+#     `ops_snapshot*.json` files (export.write_snapshot). Works post-hoc and
+#     with dead ranks: each snapshot's `meta` header (rank/host/pid/t) lets
+#     the merger DROP stale dead-rank data (`config["fleet_stale_snapshot_s"]`)
+#     and name missing ranks instead of silently averaging them in.
+#
+# Layered on the merged view: cluster SLO verdicts over the merged windows
+# (slo.evaluate_reader x telemetry.MergedWindows — a `min_count` spec floor
+# is what lets a fleet-wide burn trip while every thin per-rank slice stays
+# vacuously healthy), straggler attribution from per-rank rendezvous
+# round-entry/exit stamps (a rank slowest by `fleet_straggler_min_lag_s`
+# for `fleet_straggler_windows` consecutive ops rounds fires a
+# flight-recorder event + an audit entry naming it), and the fleet rollup
+# of the 2-D ledger's chip occupancy (`fleet.chips_busy`/`fleet.chips_idle`
+# gauges, per-tenant device-time sums via ledger.merge_tenant_usage).
+#
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import lockcheck
+
+__all__ = [
+    "ops_due",
+    "ops_round",
+    "note_round_exit",
+    "cluster_view",
+    "cluster_report",
+    "local_payload",
+    "merge_payloads",
+    "merge_reports",
+    "read_rank_snapshots",
+    "reset",
+    "OPS_ROUND_PREFIX",
+    "OPS_ROUND_FLAG",
+]
+
+# Versioned payload prefix (the trace-round convention): a format change is
+# detectable instead of silently misparsed. The bare prefix with no body is
+# the degraded "I could not build a payload" marker — it keeps the round
+# lockstep and is skipped at merge.
+OPS_ROUND_PREFIX = "OPS1:"
+# The flag rank 0 appends to its trace-exchange payload to schedule a round.
+OPS_ROUND_FLAG = "ops"
+
+# Rounds of (epoch, round, t_enter, t_exit) stamps retained per rank for the
+# straggler attributor — enough to cover the rounds between two ops rounds
+# without growing with fit length.
+_MAX_ROUND_EXITS = 64
+
+_LOCK = lockcheck.make_lock("ops_plane.fleet._LOCK")
+_LAST_ROUND_T: Optional[float] = None  # rank-0 throttle clock  # guarded-by: _LOCK
+_LAST_INGEST_KEY: Optional[Tuple[Any, Any]] = None  # guarded-by: _LOCK
+_CLUSTER: Optional[Dict[str, Any]] = None  # last merged live view  # guarded-by: _LOCK
+# rank -> deque of [epoch, round, t_enter, t_exit]; in a real deployment
+# each process only ever holds its own rank's stamps, in the threaded
+# LocalRendezvous harness all ranks share this dict (keyed apart by rank)
+_ROUND_EXITS: Dict[int, "deque[List[Any]]"] = {}  # guarded-by: _LOCK
+_STRAGGLER_STREAKS: Dict[int, int] = {}  # guarded-by: _LOCK
+
+
+def _cfg(key: str, default: Any) -> Any:
+    """Config knob via lazy core import (the slo._specs pattern)."""
+    try:
+        from ..core import config
+
+        v = config.get(key)
+        return default if v is None else v
+    except Exception:  # pragma: no cover - config must never fail the plane
+        return default
+
+
+def _interval_s() -> float:
+    from .. import telemetry
+
+    v = _cfg("fleet_ops_round_seconds", None)
+    if v is None:
+        return float(telemetry.registry().bucket_seconds())
+    return max(0.0, float(v))
+
+
+# ------------------------------------------------------------- live round --
+
+
+def ops_due(now: Optional[float] = None) -> bool:
+    """Rank 0's throttle decision for the piggybacked ops round: True at
+    most once per `fleet_ops_round_seconds` (default: one metrics bucket
+    width), and never while telemetry is disabled. ONLY rank 0 calls this —
+    every other rank follows the `|ops` flag rank 0 broadcasts, so the
+    fleet agrees on whether a round happens without a clock agreement."""
+    from .. import telemetry
+
+    if not telemetry.enabled():
+        return False
+    global _LAST_ROUND_T
+    t = time.monotonic() if now is None else float(now)
+    interval = _interval_s()
+    with _LOCK:
+        if _LAST_ROUND_T is None or t - _LAST_ROUND_T >= interval:
+            _LAST_ROUND_T = t
+            return True
+    return False
+
+
+def note_round_exit(
+    rank: int, round_index: Any, epoch: Any, t_enter: float, t_exit: float
+) -> None:
+    """Stamp one rendezvous round's entry/exit wall-clock for `rank`
+    (called from the base allgather's telemetry branch via sys.modules
+    probe). The stamps ride the next ops-round payload; the merger turns
+    cross-rank deltas into straggler lags. Bounded per rank."""
+    with _LOCK:
+        dq = _ROUND_EXITS.get(int(rank))
+        if dq is None:
+            dq = _ROUND_EXITS[int(rank)] = deque(maxlen=_MAX_ROUND_EXITS)
+        dq.append([epoch, round_index, float(t_enter), float(t_exit)])
+
+
+def local_payload(rank: Optional[int] = None) -> Dict[str, Any]:
+    """This rank's compact ops-round payload: identity meta, cumulative
+    counters/gauges/histograms, the age-indexed window export, per-tenant
+    ledger usage, and the recent rendezvous round stamps."""
+    from .. import diagnostics, telemetry
+
+    reg = telemetry.registry()
+    snap = reg.snapshot()
+    r = diagnostics._rank() if rank is None else int(rank)
+    with _LOCK:
+        exits = [list(e) for e in _ROUND_EXITS.get(r, ())]
+    tenants: Dict[str, Any] = {}
+    try:
+        from ..scheduler.ledger import global_ledger
+
+        tenants = global_ledger().tenant_usage()
+    except Exception:  # pragma: no cover - the ledger is optional here
+        tenants = {}
+    return {
+        "v": 1,
+        "rank": r,
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "t": time.time(),
+        "trace_id": diagnostics.trace_tags().get("trace_id"),
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "hists": snap["histograms"],
+        "windows": reg.windows_export(),
+        "tenants": tenants,
+        "round_exits": exits,
+    }
+
+
+def ops_round(
+    rendezvous: Any,
+    *,
+    force: bool = False,
+    payload: Optional[Dict[str, Any]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Run ONE ops round over `rendezvous` — every rank must call in
+    lockstep (the trace-scope piggyback guarantees that for the implicit
+    path; harnesses call with `force=True`). Returns the merged cluster
+    view, or None when degraded to local-only (failed round, local
+    telemetry off). NON-FATAL by contract: no exception escapes.
+
+    `payload` is a TEST HOOK: a crafted per-rank payload exchanged instead
+    of `local_payload()` (the threaded LocalRendezvous harness shares one
+    registry across "ranks", so distinct-rank assertions need it)."""
+    from .. import diagnostics, telemetry
+
+    body = ""
+    try:
+        if payload is not None:
+            body = json.dumps(payload)
+        elif telemetry.enabled():
+            body = json.dumps(local_payload(getattr(rendezvous, "rank", None)))
+    except Exception:
+        # degraded: the bare marker keeps the round lockstep — peers merge
+        # without this rank and name it missing
+        body = ""
+    try:
+        gathered = rendezvous.allgather(OPS_ROUND_PREFIX + body)
+    except Exception as e:
+        # a dead peer / timeout degrades THIS rank to local-only views; the
+        # fit's own next round surfaces the real failure with retry
+        # protection (the trace-exchange contract, diagnostics.trace_scope)
+        diagnostics.record_event("ops_round_failed", error=type(e).__name__)
+        if telemetry.enabled():
+            telemetry.registry().inc("fleet.ops_rounds_failed")
+        return None
+    if not telemetry.enabled() and payload is None:
+        return None  # participated for lockstep only; nothing recorded
+    try:
+        return _ingest_round(
+            gathered,
+            epoch=getattr(rendezvous, "_epoch", None),
+            round_index=getattr(rendezvous, "_round", None),
+            nranks=int(getattr(rendezvous, "nranks", len(gathered))),
+        )
+    except Exception as e:  # pragma: no cover - merge must never fail a fit
+        diagnostics.record_event("ops_round_failed", error=type(e).__name__)
+        if telemetry.enabled():
+            telemetry.registry().inc("fleet.ops_rounds_failed")
+        return None
+
+
+def _parse_gathered(gathered: List[str]) -> List[Dict[str, Any]]:
+    payloads: List[Dict[str, Any]] = []
+    for item in gathered:
+        if not isinstance(item, str) or not item.startswith(OPS_ROUND_PREFIX):
+            continue
+        raw = item[len(OPS_ROUND_PREFIX):]
+        if not raw:
+            continue  # degraded bare marker
+        try:
+            p = json.loads(raw)
+        except (ValueError, TypeError):
+            continue  # unparseable peers are merged around, never fatal
+        if isinstance(p, dict):
+            payloads.append(p)
+    return payloads
+
+
+def _ingest_round(
+    gathered: List[str], *, epoch: Any, round_index: Any, nranks: int
+) -> Optional[Dict[str, Any]]:
+    """Merge one gathered round into the cluster view. Idempotent per
+    (epoch, round): in the threaded LocalRendezvous harness every "rank"
+    thread lands here with the SAME gathered list — the first merges and
+    fires events, the rest get the cached view (a real multi-process fleet
+    never dedups: each process is its own fleet-plane instance)."""
+    from .. import telemetry
+
+    global _LAST_INGEST_KEY, _CLUSTER
+    key = (epoch, round_index) if round_index is not None else None
+    with _LOCK:
+        if key is not None and _LAST_INGEST_KEY == key:
+            # another "rank" thread of this process already claimed this
+            # round's merge — return its view (or None if it is still
+            # merging; the next round refreshes). Re-merging here would
+            # double-advance the straggler streaks.
+            return dict(_CLUSTER) if _CLUSTER is not None else None
+        _LAST_INGEST_KEY = key
+    payloads = _parse_gathered(gathered)
+    view = merge_payloads(payloads, expected=nranks)
+    events = _update_stragglers(view)
+    with _LOCK:
+        _CLUSTER = view
+    reg = telemetry.registry()
+    if telemetry.enabled():
+        reg.inc("fleet.ops_rounds")
+        reg.gauge("fleet.ranks_reporting", float(len(payloads)))
+        lags = (view.get("straggler") or {}).get("lags_s") or {}
+        if lags:
+            reg.gauge("rendezvous.straggler_lag_s", max(lags.values()))
+        pool = (view.get("tenants") or {}).get("_pool") or {}
+        if "chips_busy" in pool:
+            reg.gauge("fleet.chips_busy", float(pool["chips_busy"]))
+        if "chips_idle" in pool:
+            reg.gauge("fleet.chips_idle", float(pool["chips_idle"]))
+    _fire_straggler_events(events)
+    return view
+
+
+# ------------------------------------------------------------------ merge --
+
+
+def merge_payloads(
+    payloads: List[Dict[str, Any]], *, expected: Optional[int] = None
+) -> Dict[str, Any]:
+    """Merge per-rank payloads (live round or snapshot-derived) into the
+    cluster view, delegating every metric-surface merge to telemetry.py's
+    one set of definitions. Ranks that sent nothing usable are NAMED in
+    `missing`, never silently averaged in."""
+    from .. import telemetry
+    from ..scheduler import ledger as _ledger
+    from . import slo as _slo
+
+    by_rank: Dict[int, Dict[str, Any]] = {}
+    for p in payloads:
+        try:
+            by_rank[int(p.get("rank", 0))] = p
+        except (TypeError, ValueError):
+            continue
+    ranks_meta = {
+        r: {
+            "host": p.get("host"),
+            "pid": p.get("pid"),
+            "t": p.get("t"),
+            "trace_id": p.get("trace_id"),
+        }
+        for r, p in by_rank.items()
+    }
+    n = int(expected) if expected else (max(by_rank) + 1 if by_rank else 0)
+    missing = sorted(set(range(n)) - set(by_rank))
+    ordered = [by_rank[r] for r in sorted(by_rank)]
+    counters = telemetry.merge_counters([p.get("counters") or {} for p in ordered])
+    gauges = telemetry.merge_gauges(
+        {r: (by_rank[r].get("gauges") or {}) for r in by_rank}
+    )
+    hists = telemetry.merge_histograms([p.get("hists") or {} for p in ordered])
+    windows: Optional[Dict[str, Any]] = None
+    windows_error: Optional[str] = None
+    try:
+        windows = telemetry.merge_windows(
+            [p["windows"] for p in ordered if p.get("windows")]
+        )
+    except ValueError as e:
+        windows_error = str(e)
+    tenants = _ledger.merge_tenant_usage([p.get("tenants") or {} for p in ordered])
+    view: Dict[str, Any] = {
+        "t": time.time(),
+        "nranks": n,
+        "ranks_reporting": len(by_rank),
+        "ranks": ranks_meta,
+        "missing": missing,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+        "windows": windows,
+        "tenants": tenants,
+        "straggler": {"lags_s": _round_lags(ordered)},
+    }
+    if windows_error:
+        view["windows_error"] = windows_error
+    # cluster SLO verdict over the MERGED window: gauge ceilings judge the
+    # per-rank max (breached anywhere = breached)
+    reader = telemetry.MergedWindows(
+        windows, {name: e["max"] for name, e in gauges.items()}
+    )
+    try:
+        view["health"] = _slo.cluster_health(reader)
+    except Exception:  # pragma: no cover - a bad spec never fails the merge
+        view["health"] = {"healthy": True, "failing": [], "specs": 0, "verdicts": []}
+    return view
+
+
+def _round_lags(payloads: List[Dict[str, Any]]) -> Dict[int, float]:
+    """Per-rank straggler lag from the exchanged round stamps: for every
+    (epoch, round) at least two ranks stamped, a rank's lag is how long
+    AFTER the first arrival it entered the round (exit deltas are
+    barrier-flattened — everyone leaves when the last rank arrives, so
+    arrival skew measured on the same exit-correlated round is the
+    attributable delta). A rank's reported lag is its worst over the
+    stamped rounds."""
+    by_round: Dict[Tuple[Any, Any], Dict[int, float]] = {}
+    for p in payloads:
+        try:
+            r = int(p.get("rank", 0))
+        except (TypeError, ValueError):
+            continue
+        for stamp in p.get("round_exits") or []:
+            try:
+                e, rnd, t_enter = stamp[0], stamp[1], float(stamp[2])
+            except (TypeError, ValueError, IndexError):
+                continue
+            by_round.setdefault((e, rnd), {})[r] = t_enter
+    lags: Dict[int, float] = {}
+    for times in by_round.values():
+        if len(times) < 2:
+            continue
+        t0 = min(times.values())
+        for r, t in times.items():
+            lags[r] = max(lags.get(r, 0.0), t - t0)
+    return lags
+
+
+def _update_stragglers(view: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Advance the consecutive-slowest streaks from one merged view; return
+    the flag events to fire (outside the lock). A rank must be the slowest
+    by at least `fleet_straggler_min_lag_s` for `fleet_straggler_windows`
+    consecutive ops rounds; firing resets its streak so one sustained
+    straggle names it once per K rounds, not every round."""
+    lags: Dict[int, float] = (view.get("straggler") or {}).get("lags_s") or {}
+    min_lag = float(_cfg("fleet_straggler_min_lag_s", 0.05))
+    k = max(1, int(_cfg("fleet_straggler_windows", 3)))
+    slowest: Optional[int] = None
+    if lags:
+        slowest = max(lags, key=lambda r: lags[r])
+        if lags[slowest] < min_lag:
+            slowest = None
+    events: List[Dict[str, Any]] = []
+    with _LOCK:
+        for r in list(_STRAGGLER_STREAKS):
+            if r != slowest:
+                _STRAGGLER_STREAKS.pop(r)
+        if slowest is not None:
+            streak = _STRAGGLER_STREAKS.get(slowest, 0) + 1
+            if streak >= k:
+                events.append(
+                    {"rank": slowest, "lag_s": lags[slowest], "rounds": streak}
+                )
+                streak = 0
+            _STRAGGLER_STREAKS[slowest] = streak
+        streaks = dict(_STRAGGLER_STREAKS)
+    view["straggler"]["slowest"] = slowest
+    view["straggler"]["streaks"] = streaks
+    return events
+
+
+def _fire_straggler_events(events: List[Dict[str, Any]]) -> None:
+    from .. import diagnostics, telemetry
+    from . import audit
+
+    for ev in events:
+        diagnostics.record_event(
+            "straggler_detected",
+            rank=ev["rank"], lag_s=ev["lag_s"], rounds=ev["rounds"],
+        )
+        audit.record_decision(
+            "straggler",
+            "fleet",
+            "flagged",
+            subject=f"rank:{ev['rank']}",
+            reason=(
+                f"slowest rank for {ev['rounds']} consecutive ops rounds "
+                f"(lag {ev['lag_s']:.3f}s)"
+            ),
+            lag_s=ev["lag_s"],
+        )
+        if telemetry.enabled():
+            telemetry.registry().inc("fleet.stragglers_flagged")
+
+
+# ---------------------------------------------------------------- offline --
+
+
+def _report_to_payload(rep: Dict[str, Any]) -> Dict[str, Any]:
+    """Shape one per-rank `ops_plane.report()` snapshot like a live
+    payload so both transports share merge_payloads."""
+    meta = rep.get("meta") or {}
+    tel = rep.get("telemetry") or {}
+    return {
+        "rank": meta.get("rank", 0),
+        "host": meta.get("hostname"),
+        "pid": meta.get("pid"),
+        "t": meta.get("t"),
+        "trace_id": meta.get("trace_id"),
+        "counters": tel.get("counters") or {},
+        "gauges": tel.get("gauges") or {},
+        "hists": tel.get("histograms") or {},
+        "windows": rep.get("windows_detail"),
+        "tenants": rep.get("tenants") or {},
+        "round_exits": meta.get("round_exits") or [],
+    }
+
+
+def merge_reports(
+    reports: List[Dict[str, Any]], *, expected: Optional[int] = None
+) -> Dict[str, Any]:
+    """Offline transport: merge per-rank `ops_plane.report()` snapshot dicts
+    into the cluster view. No events fire (post-hoc analysis must not
+    rewrite the audit trail of the run it examines)."""
+    return merge_payloads(
+        [_report_to_payload(r) for r in reports], expected=expected
+    )
+
+
+_SNAPSHOT_NAME_RE = None
+
+
+def read_rank_snapshots(
+    directory: str,
+    *,
+    nranks: Optional[int] = None,
+    stale_s: Optional[float] = None,
+    now: Optional[float] = None,
+) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    """Scan `directory` for current-generation per-rank snapshots
+    (`ops_snapshot.json` = rank 0, `ops_snapshot_rank_<r>.json` for r>0 —
+    rotated `.1`.. generations are skipped) and return `(reports, issues)`.
+    `issues` names every rank that is `missing` (expected but no file),
+    `stale` (meta.t older than `stale_s`, default
+    `config["fleet_stale_snapshot_s"]` — dropped from `reports`), or
+    `unreadable` — the `opsreport --cluster` partial-fleet verdict."""
+    import re as _re
+
+    global _SNAPSHOT_NAME_RE
+    if _SNAPSHOT_NAME_RE is None:
+        _SNAPSHOT_NAME_RE = _re.compile(r"^ops_snapshot(?:_rank_(\d+))?\.json$")
+    if stale_s is None:
+        stale_s = float(_cfg("fleet_stale_snapshot_s", 600.0))
+    t_now = time.time() if now is None else float(now)
+    reports: List[Dict[str, Any]] = []
+    seen: Dict[int, str] = {}
+    issues: Dict[str, Any] = {"missing": [], "stale": [], "unreadable": []}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return [], {"missing": [], "stale": [], "unreadable": [str(directory)]}
+    for name in names:
+        m = _SNAPSHOT_NAME_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            issues["unreadable"].append(name)
+            continue
+        meta = rep.get("meta") or {}
+        rank = int(meta.get("rank", int(m.group(1) or 0)))
+        t = meta.get("t") or rep.get("t")
+        if stale_s and t is not None and t_now - float(t) > stale_s:  # wallclock-ok: staleness compares the snapshot's own wall-clock meta.t stamp (written by another process — monotonic clocks don't cross processes)
+            issues["stale"].append(rank)
+            seen.setdefault(rank, name)
+            continue
+        if rank in seen and rank not in issues["stale"]:
+            continue  # first (canonical) file for a rank wins
+        seen[rank] = name
+        reports.append(rep)
+    have = {int((r.get("meta") or {}).get("rank", 0)) for r in reports}
+    n = int(nranks) if nranks else (max(seen) + 1 if seen else 0)
+    issues["missing"] = sorted(set(range(n)) - have - set(issues["stale"]))
+    issues["nranks"] = n
+    return reports, issues
+
+
+# ------------------------------------------------------------------- views --
+
+
+def cluster_view() -> Optional[Dict[str, Any]]:
+    """The last merged LIVE cluster view (None before any ops round)."""
+    with _LOCK:
+        return dict(_CLUSTER) if _CLUSTER is not None else None
+
+
+def cluster_report() -> Dict[str, Any]:
+    """The `report(cluster=True)` section: the last live view plus how old
+    it is, or `available: False` before any round completed."""
+    view = cluster_view()
+    if view is None:
+        return {"available": False}
+    return {"available": True, "age_s": max(0.0, time.time() - view["t"]), **view}
+
+
+def reset() -> None:
+    """Forget throttle/streak/view state (test isolation)."""
+    global _LAST_ROUND_T, _LAST_INGEST_KEY, _CLUSTER
+    with _LOCK:
+        _LAST_ROUND_T = None
+        _LAST_INGEST_KEY = None
+        _CLUSTER = None
+        _ROUND_EXITS.clear()
+        _STRAGGLER_STREAKS.clear()

@@ -46,6 +46,8 @@ from ..utils import lockcheck
 
 __all__ = [
     "evaluate",
+    "evaluate_reader",
+    "cluster_health",
     "maybe_evaluate",
     "health",
     "last_verdicts",
@@ -104,6 +106,13 @@ def _eval_one(spec: Dict[str, Any], reg: Any, horizon: float) -> Dict[str, Any]:
             return None
         frac, count = got
         v.setdefault("samples", {})[f"{window_s:g}s"] = count
+        # statistical floor (docs/observability.md "SLO specs"): a window
+        # holding fewer than `min_count` samples is treated as no-traffic —
+        # healthy — so a 3-request blip cannot page. This is also what makes
+        # CLUSTER evaluation meaningful: each rank's thin slice can sit
+        # under the floor while the merged fleet window clears it and burns.
+        if count < int(spec.get("min_count", 0)):
+            return None
         return frac / budget if budget > 0 else (float("inf") if frac else 0.0)
 
     try:
@@ -195,6 +204,45 @@ def evaluate(force: bool = True) -> List[Dict[str, Any]]:
         if telemetry.enabled():
             reg.inc("slo.clears")
     return verdicts
+
+
+def evaluate_reader(
+    reader: Any,
+    specs: Optional[List[Dict[str, Any]]] = None,
+    horizon: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Stateless evaluation of SLO specs against ANY windowed reader —
+    `_eval_one` only needs `window_fraction_over` / `rate` /
+    `window_quantile` / `snapshot()["gauges"]`, which both the live registry
+    and `telemetry.MergedWindows` (the fleet plane's merged CLUSTER window)
+    provide. No trip/clear state is touched: cluster verdicts are a view,
+    the per-process monitors stay the event source."""
+    specs = _specs() if specs is None else [s for s in specs if isinstance(s, dict)]
+    if not specs:
+        return []
+    if horizon is None:
+        try:
+            horizon = float(reader.window_horizon_s())
+        except Exception:
+            horizon = DEFAULT_SLOW_WINDOW_S
+    return [_eval_one(s, reader, horizon) for s in specs]
+
+
+def cluster_health(
+    reader: Any, specs: Optional[List[Dict[str, Any]]] = None
+) -> Dict[str, Any]:
+    """Cluster-wide health verdict over a merged fleet window — same shape
+    as `health()`, evaluated via `evaluate_reader` (docs/observability.md
+    "Fleet plane")."""
+    verdicts = evaluate_reader(reader, specs)
+    failing = [v["name"] for v in verdicts if v["failing"]]
+    return {
+        "healthy": not failing,
+        "failing": failing,
+        "specs": len(verdicts),
+        "verdicts": verdicts,
+        "t": time.time(),
+    }
 
 
 def maybe_evaluate() -> None:

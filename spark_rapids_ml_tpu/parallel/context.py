@@ -19,6 +19,7 @@ import json
 import os
 import re
 import socket
+import sys
 import threading
 import time
 from typing import List, Optional, Tuple
@@ -221,6 +222,7 @@ class Rendezvous:
             if not telemetry.enabled():
                 out = self._allgather_impl(payload)
             else:
+                t_enter = time.time()
                 with telemetry.span(
                     "rendezvous.allgather",
                     nranks=self.nranks, round=round_index, epoch=epoch,
@@ -229,6 +231,22 @@ class Rendezvous:
                 reg = telemetry.registry()
                 reg.inc("rendezvous.rounds")
                 reg.inc("rendezvous.payload_bytes", len(payload))
+                # fleet-plane straggler stamps (sys.modules probe — the
+                # control plane never pays the ops_plane import chain; a
+                # process without the fleet plane records nothing). Entry +
+                # exit wall-clock per (epoch, round) ride the next ops-round
+                # payload so the merger can attribute cross-rank skew.
+                fleet = sys.modules.get(
+                    (__package__ or "spark_rapids_ml_tpu.parallel").rsplit(".", 1)[0]
+                    + ".ops_plane.fleet"
+                )
+                if fleet is not None:
+                    try:
+                        fleet.note_round_exit(
+                            self.rank, round_index, epoch, t_enter, time.time()
+                        )
+                    except Exception:  # pragma: no cover - stamps are best-effort
+                        pass
         except BaseException as e:
             diagnostics.record_event(
                 "rdv_fail", round=round_index, error=type(e).__name__

@@ -41,7 +41,13 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..utils import lockcheck
 
-__all__ = ["HbmReservation", "HbmLedger", "global_ledger", "reset_global_ledger"]
+__all__ = [
+    "HbmReservation",
+    "HbmLedger",
+    "global_ledger",
+    "reset_global_ledger",
+    "merge_tenant_usage",
+]
 
 
 def _now() -> float:
@@ -477,3 +483,27 @@ def reset_global_ledger() -> HbmLedger:
     with _GLOBAL_LOCK:
         _GLOBAL = HbmLedger()
     return _GLOBAL
+
+
+def merge_tenant_usage(
+    usages: Sequence[Dict[str, Dict[str, float]]]
+) -> Dict[str, Dict[str, float]]:
+    """Fleet rollup of per-host `tenant_usage()` maps (ops_plane.fleet,
+    docs/observability.md "Fleet plane"): every numeric term sums across
+    hosts — byte/chip-seconds, live bytes/reservations, chips_busy, the
+    `_pool` pseudo-tenant's chips_total/chips_idle (each host owns disjoint
+    chips, so occupancy adds), and the per-kind `device_time` splits. Hosts
+    that never saw a tenant simply contribute nothing for it."""
+    out: Dict[str, Dict[str, float]] = {}
+    for usage in usages:
+        for tenant, u in (usage or {}).items():
+            acc = out.setdefault(str(tenant), {})
+            for k, v in (u or {}).items():
+                if k == "device_time" and isinstance(v, dict):
+                    dt = acc.setdefault("device_time", {})  # type: ignore[assignment]
+                    for kind, s in v.items():
+                        if isinstance(s, (int, float)):
+                            dt[kind] = dt.get(kind, 0.0) + float(s)
+                elif isinstance(v, (int, float)):
+                    acc[k] = acc.get(k, 0.0) + float(v)
+    return out

@@ -65,6 +65,11 @@ __all__ = [
     "quantile_of",
     "summarize_histogram",
     "tenant_metric",
+    "merge_counters",
+    "merge_gauges",
+    "merge_histograms",
+    "merge_windows",
+    "MergedWindows",
 ]
 
 # Span records kept in-process (the JSONL sink receives every record; the
@@ -300,6 +305,197 @@ def quantile_of(values: List[float], q: float) -> Optional[float]:
     return float(ordered[idx])
 
 
+# --------------------------------------------------------- merge semantics --
+#
+# THE cross-rank merge definitions (docs/observability.md "Fleet plane") —
+# both fleet transports (the live ops round and the offline snapshot merge)
+# delegate here so they cannot drift: counters SUM; gauges keep every
+# per-rank value plus min/max/sum (averaging a watermark would lie); window
+# histograms merge per-bucket with exact counts/sums preserved and sample
+# multisets concatenated (bounded at `_MAX_BUCKET_SAMPLES` per bucket PER
+# RANK — quantiles over the merged window are approximate past the cap,
+# exactly as approximate as each rank's own view). Merging is associative
+# and rank-order-independent, and merging a single rank is the identity
+# (pinned in tests/test_fleet.py).
+
+
+def merge_counters(per_rank: List[Dict[str, float]]) -> Dict[str, float]:
+    """Sum counter dicts across ranks (missing names = 0 contribution)."""
+    out: Dict[str, float] = {}
+    for counters in per_rank:
+        for name, v in (counters or {}).items():
+            out[name] = out.get(name, 0.0) + float(v)
+    return out
+
+
+def merge_gauges(per_rank: Dict[Any, Dict[str, float]]) -> Dict[str, Dict[str, Any]]:
+    """Merge gauge dicts keyed by rank: each name keeps the full per-rank
+    map plus min/max/sum rollups. Rank keys may be ints or their JSON string
+    round-trips; the merged `by_rank` map is keyed by int rank."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for rank in sorted(per_rank, key=lambda r: int(r)):
+        for name, v in (per_rank[rank] or {}).items():
+            e = out.setdefault(
+                name,
+                {"by_rank": {}, "min": float("inf"), "max": float("-inf"), "sum": 0.0},
+            )
+            v = float(v)
+            e["by_rank"][int(rank)] = v
+            e["min"] = min(e["min"], v)
+            e["max"] = max(e["max"], v)
+            e["sum"] += v
+    return out
+
+
+def merge_histograms(
+    per_rank: List[Dict[str, Dict[str, float]]]
+) -> Dict[str, Dict[str, float]]:
+    """Merge cumulative histogram summaries: counts/sums add, min/max fold."""
+    out: Dict[str, Dict[str, float]] = {}
+    for hists in per_rank:
+        for name, h in (hists or {}).items():
+            e = out.setdefault(
+                name,
+                {"count": 0.0, "sum": 0.0, "min": float("inf"), "max": float("-inf")},
+            )
+            e["count"] += float(h.get("count", 0.0))
+            e["sum"] += float(h.get("sum", 0.0))
+            e["min"] = min(e["min"], float(h.get("min", float("inf"))))
+            e["max"] = max(e["max"], float(h.get("max", float("-inf"))))
+    return out
+
+
+def merge_windows(exports: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge `windows_export()` payloads from several ranks, aligned by
+    bucket AGE (newest first). Exports must share one bucket width — a
+    heterogeneous fleet has no meaningful common window and raises
+    ValueError (the fleet plane treats that rank's payload as unusable, it
+    never averages misaligned buckets). Per-bucket counts and sums stay
+    exact; merged sample lists are the sorted concatenation."""
+    exports = [e for e in exports if e]
+    if not exports:
+        return {"bucket_seconds": None, "bucket_count": 0, "counters": {}, "hists": {}, "ranks": 0}
+    bucket_s = float(exports[0]["bucket_seconds"])
+    for e in exports[1:]:
+        if abs(float(e["bucket_seconds"]) - bucket_s) > 1e-9:
+            raise ValueError(
+                "merge_windows: mismatched bucket_seconds "
+                f"({e['bucket_seconds']} vs {bucket_s}) — ranks must share "
+                "metrics_bucket_seconds for their windows to align"
+            )
+    n = max(int(e["bucket_count"]) for e in exports)
+    counters: Dict[str, List[float]] = {}
+    hists: Dict[str, Dict[str, List[Any]]] = {}
+    for e in exports:
+        for name, vals in (e.get("counters") or {}).items():
+            acc = counters.setdefault(name, [0.0] * n)
+            for i, v in enumerate(vals[:n]):
+                acc[i] += float(v)
+        for name, h in (e.get("hists") or {}).items():
+            hacc = hists.setdefault(
+                name,
+                {
+                    "counts": [0.0] * n,
+                    "sums": [0.0] * n,
+                    "samples": [[] for _ in range(n)],
+                },
+            )
+            m = min(n, len(h["counts"]))
+            for i in range(m):
+                hacc["counts"][i] += float(h["counts"][i])
+                hacc["sums"][i] += float(h["sums"][i])
+                hacc["samples"][i].extend(h["samples"][i])
+    for h in hists.values():
+        h["samples"] = [sorted(s) for s in h["samples"]]
+    return {
+        "bucket_seconds": bucket_s,
+        "bucket_count": n,
+        "counters": counters,
+        "hists": hists,
+        "ranks": len(exports),
+    }
+
+
+class MergedWindows:
+    """Read-side view over a `merge_windows()` result that duck-types the
+    registry's windowed readers (`rate` / `window_count` / `window_quantile`
+    / `window_fraction_over` / `snapshot()["gauges"]`) so the SLO evaluator
+    runs unchanged over a CLUSTER window (ops_plane.slo.evaluate_reader).
+    The merged export is a static snapshot: "now" is the newest bucket, and
+    a `window_s` selects the newest ``round(window_s / bucket)`` buckets."""
+
+    def __init__(
+        self,
+        merged: Optional[Dict[str, Any]],
+        gauges: Optional[Dict[str, float]] = None,
+    ) -> None:
+        self._m = merged or {
+            "bucket_seconds": None,
+            "bucket_count": 0,
+            "counters": {},
+            "hists": {},
+        }
+        # cluster gauge view for gauge_ceiling specs: name -> the value the
+        # ceiling should judge (the fleet plane passes per-rank MAX — a
+        # ceiling breached anywhere is breached)
+        self._gauges = dict(gauges or {})
+
+    def _k(self, window_s: Optional[float]) -> int:
+        bucket_s = self._m.get("bucket_seconds") or 0.0
+        n = int(self._m.get("bucket_count") or 0)
+        if not bucket_s or not n:
+            return 0
+        horizon = bucket_s * n
+        span = horizon if window_s is None else min(max(float(window_s), bucket_s), horizon)
+        return max(1, min(n, int(round(span / bucket_s))))
+
+    def bucket_seconds(self) -> float:
+        return float(self._m.get("bucket_seconds") or 0.0)
+
+    def window_horizon_s(self) -> float:
+        return self.bucket_seconds() * int(self._m.get("bucket_count") or 0)
+
+    def rate(self, name: str, window_s: Optional[float] = None) -> Optional[float]:
+        vals = (self._m.get("counters") or {}).get(name)
+        k = self._k(window_s)
+        if vals is None or not k:
+            return None
+        span = k * float(self._m["bucket_seconds"])
+        return sum(vals[:k]) / span if span > 0 else None
+
+    def window_samples(self, name: str, window_s: Optional[float] = None) -> List[float]:
+        h = (self._m.get("hists") or {}).get(name)
+        if h is None:
+            return []
+        out: List[float] = []
+        for i in range(min(self._k(window_s), len(h["samples"]))):
+            out.extend(h["samples"][i])
+        return out
+
+    def window_count(self, name: str, window_s: Optional[float] = None) -> float:
+        h = (self._m.get("hists") or {}).get(name)
+        if h is None:
+            return 0.0
+        return float(sum(h["counts"][: self._k(window_s)]))
+
+    def window_quantile(
+        self, name: str, q: float, window_s: Optional[float] = None
+    ) -> Optional[float]:
+        return quantile_of(self.window_samples(name, window_s), q)
+
+    def window_fraction_over(
+        self, name: str, threshold: float, window_s: Optional[float] = None
+    ) -> Optional[Tuple[float, int]]:
+        samples = self.window_samples(name, window_s)
+        if not samples:
+            return None
+        bad = sum(1 for s in samples if s > threshold)
+        return bad / len(samples), len(samples)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"gauges": dict(self._gauges)}
+
+
 # ---------------------------------------------------------------- registry --
 
 
@@ -526,6 +722,48 @@ class MetricsRegistry:
             "horizon_s": horizon,
             "rates": rates,
             "quantiles": quantiles,
+        }
+
+    def windows_export(self) -> Dict[str, Any]:
+        """Merge-form export of the rolling windows (docs/observability.md
+        "Fleet plane"): per-counter per-bucket increment sums and
+        per-histogram per-bucket (count, sum, sorted samples), all indexed by
+        bucket AGE (newest first). Ring heads are per-process
+        ``time.monotonic()`` bucket indices with no cross-process meaning, so
+        age is the only alignment the fleet merger can use — cross-rank skew
+        is bounded by one bucket width. Samples are sorted here so the merge
+        is canonical: merging one export is the identity, and merge order
+        cannot change the result. Taken under one lock hold at one clock
+        instant, like `windows_snapshot`."""
+        now = time.monotonic()
+        with self._lock:
+            bucket_s, n = self._win()
+            counters: Dict[str, List[float]] = {}
+            for name, ring in self._win_counters.items():
+                b = int(now // ring.bucket_s)
+                if ring.head is None or b > ring.head:
+                    ring._advance(b)
+                assert ring.head is not None
+                counters[name] = [
+                    float(ring.vals[(ring.head - i) % ring.n]) for i in range(ring.n)
+                ]
+            hists: Dict[str, Dict[str, List[Any]]] = {}
+            for name, hring in self._win_hists.items():
+                b = int(now // hring.bucket_s)
+                if hring.head is None or b > hring.head:
+                    hring._advance(b)
+                assert hring.head is not None
+                idx = [(hring.head - i) % hring.n for i in range(hring.n)]
+                hists[name] = {
+                    "counts": [float(hring.counts[i]) for i in idx],
+                    "sums": [float(hring.sums[i]) for i in idx],
+                    "samples": [sorted(hring.samples[i]) for i in idx],
+                }
+        return {
+            "bucket_seconds": bucket_s,
+            "bucket_count": n,
+            "counters": counters,
+            "hists": hists,
         }
 
     def convergence_trace(self, solver: str) -> List[List[float]]:

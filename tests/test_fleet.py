@@ -1,0 +1,555 @@
+#
+# Fleet plane tests (docs/observability.md "Fleet plane"): the one set of
+# merge definitions (counters sum; gauges keep per-rank values + min/max/sum;
+# age-aligned window merges preserve exact counts/sums and are associative
+# and rank-order independent), the live ops round over LocalRendezvous
+# (3-rank aggregation, lockstep piggyback on trace_scope, two-layer
+# non-fatality, zero cost while telemetry is off), cluster SLO evaluation
+# where a `min_count` floor lets the MERGED window trip while every thin
+# per-rank slice stays vacuously healthy (rank-0 /healthz flips 503),
+# straggler attribution naming the laggard rank in the flight recorder AND
+# the audit trail, the per-rank snapshot meta header + rank-aware naming +
+# exporter port policy, and `opsreport --cluster`'s partial-fleet exit code.
+# All without a TPU.
+#
+import json
+import os
+import socket
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from benchmark import opsreport
+from spark_rapids_ml_tpu import core, diagnostics, ops_plane, telemetry
+from spark_rapids_ml_tpu.ops_plane import audit, export, fleet, slo
+from spark_rapids_ml_tpu.parallel import LocalRendezvous
+from spark_rapids_ml_tpu.scheduler.ledger import merge_tenant_usage
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet():
+    """Fleet module state is process-global; this file runs BEFORE
+    test_ops_plane.py alphabetically, and a leftover merged cluster view
+    would flip its /healthz assertions."""
+    fleet.reset()
+    audit.clear()
+    diagnostics.flight_recorder().reset()
+    yield
+    fleet.reset()
+    audit.clear()
+
+
+@pytest.fixture
+def tele():
+    """Fresh enabled registry with FAST window buckets; restore after."""
+    saved = {
+        k: core.config[k] for k in ("metrics_bucket_seconds", "metrics_bucket_count")
+    }
+    core.config["metrics_bucket_seconds"] = 0.05
+    core.config["metrics_bucket_count"] = 20  # 1s horizon
+    telemetry.registry().reset()
+    telemetry.enable()
+    yield telemetry.registry()
+    telemetry.disable()
+    telemetry.registry().reset()
+    core.config.update(saved)
+
+
+@pytest.fixture
+def slo_cfg():
+    saved = core.config["slo"]
+    slo.reset()
+    yield
+    core.config["slo"] = saved
+    slo.reset()
+
+
+def _run_ranks(nranks, fn, timeout_s=60.0):
+    """Run fn(rank, rendezvous) on one thread per rank; re-raise the first
+    thread error in the caller (a hung lockstep bug must fail, not wedge)."""
+    rdvs = LocalRendezvous.create(nranks, timeout_s=30.0)
+    results = [None] * nranks
+    errors = []
+
+    def work(rank):
+        try:
+            results[rank] = fn(rank, rdvs[rank])
+        except BaseException as e:
+            errors.append(e)
+            rdvs[rank].abort(f"test rank {rank}: {type(e).__name__}")
+
+    threads = [
+        threading.Thread(target=work, args=(r,), daemon=True) for r in range(nranks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout_s)
+    assert not any(t.is_alive() for t in threads), "rank thread hung"
+    if errors:
+        raise errors[0]
+    return results
+
+
+def _mk_export(samples_newest_first, bucket_seconds=0.05, bucket_count=20,
+               name="fleet_test.lat_s", counters=None):
+    """Craft one rank's age-indexed window export from per-bucket sample
+    lists (newest first), the shape `windows_export()` emits."""
+    buckets = [sorted(float(v) for v in b) for b in samples_newest_first]
+    buckets += [[] for _ in range(bucket_count - len(buckets))]
+    return {
+        "bucket_seconds": bucket_seconds,
+        "bucket_count": bucket_count,
+        "counters": {
+            k: list(v) + [0.0] * (bucket_count - len(v))
+            for k, v in (counters or {}).items()
+        },
+        "hists": {
+            name: {
+                "counts": [float(len(b)) for b in buckets],
+                "sums": [float(sum(b)) for b in buckets],
+                "samples": buckets,
+            }
+        },
+    }
+
+
+# ----------------------------------------------------- merge semantics ------
+
+
+def test_merge_counters_sum():
+    m = telemetry.merge_counters(
+        [{"a": 1.0, "b": 2.0}, {"a": 10.0}, {"b": 0.5, "c": 4.0}]
+    )
+    assert m == {"a": 11.0, "b": 2.5, "c": 4.0}
+
+
+def test_merge_gauges_keep_per_rank_and_min_max_sum():
+    m = telemetry.merge_gauges({0: {"g": 2.0}, 2: {"g": 8.0}, 1: {"g": 5.0}})
+    assert m["g"]["by_rank"] == {0: 2.0, 1: 5.0, 2: 8.0}
+    assert (m["g"]["min"], m["g"]["max"], m["g"]["sum"]) == (2.0, 8.0, 15.0)
+
+
+def test_merge_histograms_exact_counts_sums():
+    m = telemetry.merge_histograms(
+        [
+            {"h": {"count": 3.0, "sum": 6.0, "min": 1.0, "max": 3.0}},
+            {"h": {"count": 2.0, "sum": 9.0, "min": 4.0, "max": 5.0}},
+        ]
+    )
+    assert m["h"] == {"count": 5.0, "sum": 15.0, "min": 1.0, "max": 5.0}
+
+
+def test_merge_windows_exact_associative_order_independent():
+    a = _mk_export([[0.01, 0.02], [0.03]])
+    b = _mk_export([[1.0], []])
+    c = _mk_export([[], [0.5, 0.6]])
+    merged = telemetry.merge_windows([a, b, c])
+    h = merged["hists"]["fleet_test.lat_s"]
+    # exact counts/sums per age bucket, never approximated
+    assert h["counts"][0] == 3.0 and h["counts"][1] == 3.0
+    assert h["sums"][0] == pytest.approx(0.01 + 0.02 + 1.0)
+    assert h["sums"][1] == pytest.approx(0.03 + 0.5 + 0.6)
+    # rank-order independence + associativity (canonical sorted-sample form)
+    assert telemetry.merge_windows([c, a, b]) == merged
+    left = telemetry.merge_windows([telemetry.merge_windows([a, b]), c])
+    right = telemetry.merge_windows([a, telemetry.merge_windows([b, c])])
+    for view in (left, right):
+        assert view["hists"] == merged["hists"]
+        assert view["counters"] == merged["counters"]
+
+
+def test_merge_single_rank_identity(tele):
+    tele.inc("fleet_test.work", 3.0)
+    for v in (0.3, 0.1, 0.2):
+        tele.observe("fleet_test.lat_s", v)
+    e = tele.windows_export()
+    m = telemetry.merge_windows([e])
+    assert m["counters"] == e["counters"]
+    assert m["hists"] == e["hists"]
+    assert m["ranks"] == 1
+
+
+def test_merged_p99_brackets_per_rank_p99s():
+    fast = _mk_export([[0.01] * 20])
+    slow = _mk_export([[0.9] * 20])
+    q = lambda e: telemetry.MergedWindows(  # noqa: E731
+        telemetry.merge_windows([e])
+    ).window_quantile("fleet_test.lat_s", 0.99)
+    merged_q = telemetry.MergedWindows(
+        telemetry.merge_windows([fast, slow])
+    ).window_quantile("fleet_test.lat_s", 0.99)
+    assert q(fast) <= merged_q <= q(slow)
+
+
+def test_merge_windows_bucket_mismatch_raises():
+    with pytest.raises(ValueError):
+        telemetry.merge_windows(
+            [_mk_export([[]], bucket_seconds=0.05), _mk_export([[]], bucket_seconds=0.1)]
+        )
+
+
+def test_merge_tenant_usage_sums_device_time():
+    merged = merge_tenant_usage(
+        [
+            {"t1": {"byte_seconds": 1.0, "chips_busy": 2.0,
+                    "device_time": {"execute_s": 1.0, "idle_s": 0.5}}},
+            {"t1": {"byte_seconds": 3.0, "device_time": {"execute_s": 2.0}},
+             "_pool": {"chips_busy": 4.0, "chips_idle": 4.0}},
+        ]
+    )
+    assert merged["t1"]["byte_seconds"] == 4.0
+    assert merged["t1"]["chips_busy"] == 2.0
+    assert merged["t1"]["device_time"] == {"execute_s": 3.0, "idle_s": 0.5}
+    assert merged["_pool"]["chips_busy"] == 4.0
+
+
+# ----------------------------------------------------------- live round -----
+
+
+def _rank_payload(rank, **over):
+    p = fleet.local_payload(rank)
+    p.update(rank=rank, **over)
+    return p
+
+
+def test_three_rank_round_merges_counters(tele):
+    views = _run_ranks(
+        3,
+        lambda r, rdv: fleet.ops_round(
+            rdv, force=True,
+            payload=_rank_payload(r, counters={"fleet_test.work": float(r + 1)}),
+        ),
+    )
+    view = next(v for v in views if v is not None)
+    # merged counters equal the per-rank sum — the acceptance identity
+    assert view["counters"]["fleet_test.work"] == 6.0
+    assert view["ranks_reporting"] == 3 and view["missing"] == []
+    assert set(view["ranks"]) == {0, 1, 2}
+    assert view["ranks"][1]["pid"] == os.getpid()
+    # the merged view is the process's cluster view now
+    assert fleet.cluster_view()["counters"]["fleet_test.work"] == 6.0
+    rep = ops_plane.report(cluster=True)
+    assert rep["cluster"]["available"] is True
+    assert rep["cluster"]["ranks_reporting"] == 3
+    assert telemetry.registry().snapshot()["counters"]["fleet.ops_rounds"] == 1.0
+
+
+def test_ops_due_throttles_to_interval(tele):
+    assert fleet.ops_due(now=100.0) is True
+    assert fleet.ops_due(now=100.01) is False  # within one bucket width
+    assert fleet.ops_due(now=100.06) is True  # past it
+    telemetry.disable()
+    assert fleet.ops_due(now=200.0) is False  # disabled: never due
+
+
+def test_trace_scope_piggybacks_ops_round(tele):
+    def fit(rank, rdv):
+        ctx = types.SimpleNamespace(rank=rank, is_spmd=True, rendezvous=rdv)
+        with diagnostics.trace_scope("fleet-fit", ctx):
+            pass
+        return rdv._round
+
+    rounds = _run_ranks(2, fit)
+    # exactly the trace round + the piggybacked ops round, on every rank
+    assert rounds == [2, 2]
+    assert fleet.cluster_view() is not None
+    assert telemetry.registry().snapshot()["counters"]["fleet.ops_rounds"] == 1.0
+
+
+def test_disabled_telemetry_adds_no_rounds_and_records_nothing(tele):
+    telemetry.disable()
+
+    def fit(rank, rdv):
+        ctx = types.SimpleNamespace(rank=rank, is_spmd=True, rendezvous=rdv)
+        with diagnostics.trace_scope("fleet-fit", ctx):
+            pass
+        return rdv._round
+
+    rounds = _run_ranks(2, fit)
+    assert rounds == [1, 1]  # ONLY the trace round: zero extra rounds
+    assert fleet.cluster_view() is None
+    snap = telemetry.registry().snapshot()
+    assert "fleet.ops_rounds" not in snap["counters"]
+
+
+def test_ops_round_payload_failure_degrades_to_bare_marker(tele, monkeypatch):
+    monkeypatch.setattr(
+        fleet, "local_payload",
+        lambda rank=None: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    views = _run_ranks(2, lambda r, rdv: fleet.ops_round(rdv, force=True))
+    # the round still completed lockstep; every rank is NAMED missing, the
+    # fit is untouched
+    view = next(v for v in views if v is not None)
+    assert view["ranks_reporting"] == 0
+    assert view["missing"] == [0, 1]
+
+
+def test_ops_round_dead_peer_degrades_survivors_nonfatally(tele):
+    def fit(rank, rdv):
+        if rank == 1:
+            rdv.abort("chaos: rank 1 died mid-round")
+            return "aborted"
+        return fleet.ops_round(
+            rdv, force=True, payload=_rank_payload(rank)
+        )
+
+    views = _run_ranks(2, fit)
+    assert views[0] is None  # survivor degraded to local-only, no raise
+    assert views[1] == "aborted"
+    kinds = [e["kind"] for e in diagnostics.flight_recorder().events()]
+    assert "ops_round_failed" in kinds
+    counters = telemetry.registry().snapshot()["counters"]
+    assert counters["fleet.ops_rounds_failed"] == 1.0
+    assert "fleet.ops_rounds" not in counters  # nothing merged
+
+
+# ------------------------------------------------------- cluster health -----
+
+
+def _min_count_spec(min_count=10):
+    return {
+        "name": "fleet_lat", "kind": "latency", "histogram": "fleet_test.lat_s",
+        "threshold_s": 0.1, "objective": 0.9, "min_count": min_count,
+        "fast_burn": 1.0,
+    }
+
+
+def _skewed_rank_windows():
+    """3 ranks x 4 samples: each rank's slice is under the min_count floor
+    (vacuously healthy alone), but the merged 12-sample window burns —
+    rank 2's chaos-delayed serves are 4/12 = 33% over a 10% budget."""
+    return [
+        _mk_export([[0.01] * 4]),
+        _mk_export([[0.01] * 4]),
+        _mk_export([[1.0] * 4]),
+    ]
+
+
+def test_min_count_floor_trips_cluster_not_ranks(tele, slo_cfg):
+    core.config["slo"] = [_min_count_spec()]
+    exports = _skewed_rank_windows()
+    for e in exports:  # each rank alone: below the floor, no verdict fires
+        reader = telemetry.MergedWindows(telemetry.merge_windows([e]))
+        health = slo.cluster_health(reader)
+        assert health["healthy"], "a thin per-rank slice must stay healthy"
+    merged = telemetry.MergedWindows(telemetry.merge_windows(exports))
+    health = slo.cluster_health(merged)
+    assert not health["healthy"]
+    assert health["failing"] == ["fleet_lat"]
+
+
+def test_cluster_failure_flips_rank0_healthz(tele, slo_cfg):
+    core.config["slo"] = [_min_count_spec()]
+    host, port = export.start_server(0)
+    try:
+        # no cluster view yet + empty local windows: healthy
+        resp = urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
+        assert resp.status == 200
+        exports = _skewed_rank_windows()
+        _run_ranks(
+            3,
+            lambda r, rdv: fleet.ops_round(
+                rdv, force=True, payload=_rank_payload(r, windows=exports[r])
+            ),
+        )
+        # local verdict alone is still healthy (this rank's windows are
+        # empty); the merged cluster view flips the probe to 503
+        assert slo.health(fresh=True)["healthy"]
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
+        assert exc_info.value.code == 503
+        verdict = json.loads(exc_info.value.read())
+        assert verdict["cluster"]["healthy"] is False
+        assert verdict["cluster"]["failing"] == ["fleet_lat"]
+        # the /metrics surface carries the rank="cluster" rollup
+        text = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5
+        ).read().decode()
+        assert 'srml_cluster_healthy{rank="cluster"} 0' in text
+        assert 'srml_cluster_ranks_reporting{rank="cluster"} 3' in text
+    finally:
+        export.stop_server()
+
+
+# ----------------------------------------------------------- stragglers -----
+
+
+def test_straggler_named_in_flight_recorder_and_audit(tele):
+    saved = {
+        k: core.config[k]
+        for k in ("fleet_straggler_windows", "fleet_straggler_min_lag_s")
+    }
+    core.config["fleet_straggler_windows"] = 3
+    core.config["fleet_straggler_min_lag_s"] = 0.05
+    try:
+        def fit(rank, rdv):
+            base = 1000.0
+            for i in range(3):  # 3 consecutive ops rounds, rank 2 lagging
+                lag = 0.2 if rank == 2 else 0.0
+                fleet.ops_round(
+                    rdv, force=True,
+                    payload=_rank_payload(
+                        rank,
+                        round_exits=[[0, i, base + i + lag, base + i + 0.3]],
+                    ),
+                )
+
+        _run_ranks(3, fit)
+        view = fleet.cluster_view()
+        assert view["straggler"]["lags_s"][2] == pytest.approx(0.2)
+        events = [
+            e for e in diagnostics.flight_recorder().events()
+            if e["kind"] == "straggler_detected"
+        ]
+        assert len(events) == 1 and events[0]["rank"] == 2
+        assert events[0]["rounds"] == 3
+        flagged = [d for d in audit.decisions() if d["kind"] == "straggler"]
+        assert len(flagged) == 1
+        assert flagged[0]["subject"] == "rank:2"
+        assert flagged[0]["verdict"] == "flagged"
+        counters = telemetry.registry().snapshot()["counters"]
+        assert counters["fleet.stragglers_flagged"] == 1.0
+        assert (
+            telemetry.registry().snapshot()["gauges"]["rendezvous.straggler_lag_s"]
+            == pytest.approx(0.2)
+        )
+    finally:
+        core.config.update(saved)
+
+
+def test_straggler_below_min_lag_never_fires(tele):
+    def fit(rank, rdv):
+        base = 1000.0
+        for i in range(4):
+            lag = 0.001 if rank == 1 else 0.0  # below the 50ms floor
+            fleet.ops_round(
+                rdv, force=True,
+                payload=_rank_payload(
+                    rank, round_exits=[[0, i, base + i + lag, base + i + 0.3]]
+                ),
+            )
+
+    _run_ranks(2, fit)
+    assert [d for d in audit.decisions() if d["kind"] == "straggler"] == []
+
+
+# ------------------------------------------------- snapshots + exporters ----
+
+
+def test_report_meta_header(tele):
+    rep = ops_plane.report()
+    meta = rep["meta"]
+    assert meta["rank"] == 0
+    assert meta["hostname"] == socket.gethostname()
+    assert meta["pid"] == os.getpid()
+    assert meta["t"] == pytest.approx(time.time(), abs=60.0)
+    assert "trace_id" in meta  # None outside a trace, the id inside one
+    assert "windows_detail" in rep  # what the offline merger keys on
+
+
+def test_write_snapshot_rank_aware_naming(tele, tmp_path):
+    saved = core.config["ops_snapshot_dir"]
+    core.config["ops_snapshot_dir"] = str(tmp_path)
+    try:
+        diagnostics.set_process_rank(2)
+        path = export.write_snapshot()
+        assert os.path.basename(path) == "ops_snapshot_rank_2.json"
+        diagnostics.set_process_rank(0)
+        path = export.write_snapshot()
+        assert os.path.basename(path) == "ops_snapshot.json"
+        with open(path) as f:
+            assert json.load(f)["meta"]["rank"] == 0
+    finally:
+        diagnostics._PROCESS_RANK = None
+        core.config["ops_snapshot_dir"] = saved
+
+
+def test_ensure_server_rank0_only_by_default(tele, monkeypatch):
+    monkeypatch.setenv("SRML_METRICS_PORT", "12345")
+    monkeypatch.delenv("SRML_METRICS_ALL_RANKS", raising=False)
+    diagnostics.set_process_rank(1)
+    try:
+        # rank 1 without the opt-in binds NOTHING (no port collision)
+        assert export.ensure_server() is None
+        assert export.server_address() is None
+    finally:
+        diagnostics._PROCESS_RANK = None
+
+
+def test_ensure_server_all_ranks_offsets_port(tele, monkeypatch):
+    with socket.socket() as s:  # a known-free port for rank 1 to land on
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+    monkeypatch.setenv("SRML_METRICS_PORT", str(free - 1))
+    monkeypatch.setenv("SRML_METRICS_ALL_RANKS", "1")
+    diagnostics.set_process_rank(1)
+    try:
+        addr = export.ensure_server()
+        assert addr is not None and addr[1] == free  # base port + rank
+    finally:
+        export.stop_server()
+        diagnostics._PROCESS_RANK = None
+
+
+# ---------------------------------------------------- offline + opsreport ---
+
+
+def _write_rank_snapshot(directory, rank, t=None):
+    rep = ops_plane.report()
+    rep["meta"] = dict(rep["meta"], rank=rank, t=t or time.time())
+    name = "ops_snapshot.json" if rank == 0 else f"ops_snapshot_rank_{rank}.json"
+    with open(os.path.join(directory, name), "w") as f:
+        json.dump(rep, f, default=str)
+
+
+def test_read_rank_snapshots_names_missing_and_stale(tele, tmp_path):
+    _write_rank_snapshot(tmp_path, 0)
+    _write_rank_snapshot(tmp_path, 1, t=time.time() - 10_000)  # stale
+    reports, issues = fleet.read_rank_snapshots(str(tmp_path), nranks=3)
+    assert [r["meta"]["rank"] for r in reports] == [0]
+    assert issues["stale"] == [1]
+    assert issues["missing"] == [2]
+    view = fleet.merge_reports(reports, expected=3)
+    assert view["missing"] == [1, 2]  # named, never silently averaged in
+
+
+def test_opsreport_cluster_partial_exit_code(tele, tmp_path, capsys):
+    _write_rank_snapshot(tmp_path, 0)
+    _write_rank_snapshot(tmp_path, 1)
+    rc = opsreport.main(["--cluster", str(tmp_path), "--nranks", "3"])
+    out = capsys.readouterr().out
+    assert rc == opsreport.EXIT_PARTIAL  # half-dead fleet: distinct verdict
+    assert "2/3 rank(s) reporting" in out
+    assert "missing rank(s): 2" in out
+    _write_rank_snapshot(tmp_path, 2)
+    rc = opsreport.main(["--cluster", str(tmp_path), "--nranks", "3"])
+    assert rc == opsreport.EXIT_HEALTHY
+    assert "3/3 rank(s) reporting" in capsys.readouterr().out
+
+
+def test_opsreport_cluster_no_snapshots_unreadable(tele, tmp_path, capsys):
+    rc = opsreport.main(["--cluster", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == opsreport.EXIT_UNREADABLE
+
+
+def test_opsreport_cluster_live_view(tele, capsys):
+    _run_ranks(
+        3,
+        lambda r, rdv: fleet.ops_round(
+            rdv, force=True,
+            payload=_rank_payload(r, counters={"fleet_test.work": 1.0}),
+        ),
+    )
+    rc = opsreport.main(["--cluster"])
+    out = capsys.readouterr().out
+    assert rc == opsreport.EXIT_HEALTHY
+    assert "3/3 rank(s) reporting" in out
